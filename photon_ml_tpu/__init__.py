@@ -1,0 +1,26 @@
+"""photon-ml-tpu: a TPU-native framework with the capabilities of LinkedIn Photon-ML.
+
+Large-scale Generalized Linear Models (linear / logistic / Poisson regression,
+smoothed-hinge linear SVM) and GAME/GLMix mixed-effect models (fixed effect +
+per-entity random effects + factored/matrix-factorization coordinates) trained
+by block coordinate descent — re-designed for TPU:
+
+- Losses/objectives are pure jit-compiled functions (value / gradient /
+  Hessian-vector) over struct-of-array batches; feature normalization is folded
+  in algebraically so sparse inputs are never densified (mirrors the contract
+  of reference ValueAndGradientAggregator.scala:35-79).
+- Optimizers (L-BFGS, OWL-QN, TRON) run entirely on device as
+  ``lax.while_loop`` programs (reference: photon-lib optimization/*.scala,
+  which wrapped Breeze on the Spark driver).
+- The fixed-effect coordinate is data-parallel over a ``jax.sharding.Mesh``
+  with ``psum`` all-reduce replacing Spark ``treeAggregate``.
+- Random effects are millions of independent small solves batched with ``vmap``
+  over padded entity blocks sharded across devices (reference:
+  RandomEffectCoordinate.scala join+mapValues).
+"""
+
+from photon_ml_tpu import types
+from photon_ml_tpu.types import TaskType
+
+__version__ = "0.1.0"
+__all__ = ["types", "TaskType", "__version__"]

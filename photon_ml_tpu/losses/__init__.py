@@ -1,0 +1,22 @@
+from photon_ml_tpu.losses.pointwise import (
+    LogisticLoss,
+    PointwiseLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+    loss_for_task,
+)
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.losses.objective import GlmObjective, make_glm_objective
+
+__all__ = [
+    "LogisticLoss",
+    "PointwiseLoss",
+    "PoissonLoss",
+    "SmoothedHingeLoss",
+    "SquaredLoss",
+    "loss_for_task",
+    "NormalizationContext",
+    "GlmObjective",
+    "make_glm_objective",
+]

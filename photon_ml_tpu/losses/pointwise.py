@@ -1,0 +1,158 @@
+"""Pointwise GLM losses: l(z, y) and its first/second derivatives w.r.t. the
+margin z.
+
+Reference parity: function/glm/PointwiseLossFunction.scala:36 (`lossAndDzLoss`,
+`DzzLoss`) with implementations LogisticLossFunction.scala:45 (numerically
+stable via log1pExp), SquaredLossFunction.scala:32, PoissonLossFunction.scala:31
+and the Rennie smoothed hinge (svm/SmoothedHingeLossFunction.scala:30).
+
+Each loss is a plain class of static vectorized functions so it can be closed
+over in jit as a static argument. Labels follow reference conventions:
+logistic/hinge labels are {0, 1} (hinge converts to ±1 internally).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.math_utils import log1p_exp
+
+
+class PointwiseLoss:
+    """Interface: value(z, y), d1(z, y), d2(z, y) — all elementwise."""
+
+    #: whether d2 is available (hinge is DiffFunction-only in the reference,
+    #: so TRON must be rejected for it: OptimizerFactory.scala)
+    has_hessian: bool = True
+
+    @staticmethod
+    def value(z: jax.Array, y: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    @staticmethod
+    def d1(z: jax.Array, y: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    @staticmethod
+    def d2(z: jax.Array, y: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+
+class LogisticLoss(PointwiseLoss):
+    """Negative log-likelihood of the Bernoulli/logit model, y in {0, 1}.
+
+    l(z, y) = log(1 + e^z) - y*z  (stable form; reference
+    LogisticLossFunction.scala:70-77 branches on the label sign to avoid
+    overflow — softplus does the equivalent internally).
+    """
+
+    @staticmethod
+    def value(z: jax.Array, y: jax.Array) -> jax.Array:
+        # y=1: log1pExp(-z); y=0: log1pExp(z). Both equal softplus(z) - y*z.
+        return log1p_exp(z) - y * z
+
+    @staticmethod
+    def d1(z: jax.Array, y: jax.Array) -> jax.Array:
+        return jax.nn.sigmoid(z) - y
+
+    @staticmethod
+    def d2(z: jax.Array, y: jax.Array) -> jax.Array:
+        s = jax.nn.sigmoid(z)
+        return s * (1.0 - s)
+
+
+class SquaredLoss(PointwiseLoss):
+    """l(z, y) = (z - y)^2 / 2 (reference SquaredLossFunction.scala:32)."""
+
+    @staticmethod
+    def value(z: jax.Array, y: jax.Array) -> jax.Array:
+        d = z - y
+        return 0.5 * d * d
+
+    @staticmethod
+    def d1(z: jax.Array, y: jax.Array) -> jax.Array:
+        return z - y
+
+    @staticmethod
+    def d2(z: jax.Array, y: jax.Array) -> jax.Array:
+        return jnp.ones_like(z)
+
+
+class PoissonLoss(PointwiseLoss):
+    """l(z, y) = e^z - y*z (reference PoissonLossFunction.scala:31)."""
+
+    @staticmethod
+    def value(z: jax.Array, y: jax.Array) -> jax.Array:
+        return jnp.exp(z) - y * z
+
+    @staticmethod
+    def d1(z: jax.Array, y: jax.Array) -> jax.Array:
+        return jnp.exp(z) - y
+
+    @staticmethod
+    def d2(z: jax.Array, y: jax.Array) -> jax.Array:
+        return jnp.exp(z)
+
+
+class SmoothedHingeLoss(PointwiseLoss):
+    """Rennie smoothed hinge, labels {0,1} mapped to t=±1 (reference
+    svm/SmoothedHingeLossFunction.scala:30). With u = t*z:
+
+        l = 0          if u >= 1
+        l = (1-u)^2/2  if 0 < u < 1
+        l = 1/2 - u    if u <= 0
+
+    First-derivative only (no Hessian in the reference either).
+    """
+
+    has_hessian = False
+
+    @staticmethod
+    def _t(y: jax.Array) -> jax.Array:
+        return jnp.where(y > 0.5, 1.0, -1.0)
+
+    @staticmethod
+    def value(z: jax.Array, y: jax.Array) -> jax.Array:
+        u = SmoothedHingeLoss._t(y) * z
+        quad = 0.5 * (1.0 - u) * (1.0 - u)
+        return jnp.where(u >= 1.0, 0.0, jnp.where(u <= 0.0, 0.5 - u, quad))
+
+    @staticmethod
+    def d1(z: jax.Array, y: jax.Array) -> jax.Array:
+        t = SmoothedHingeLoss._t(y)
+        u = t * z
+        dz_du = jnp.where(u >= 1.0, 0.0, jnp.where(u <= 0.0, -1.0, u - 1.0))
+        return dz_du * t
+
+    @staticmethod
+    def d2(z: jax.Array, y: jax.Array) -> jax.Array:
+        # Not used by LBFGS/OWLQN; provided for completeness (piecewise 2nd
+        # derivative of the quadratic region).
+        u = SmoothedHingeLoss._t(y) * z
+        return jnp.where((u > 0.0) & (u < 1.0), 1.0, 0.0)
+
+
+def loss_for_task(task: TaskType) -> Type[PointwiseLoss]:
+    """TaskType -> loss class (reference ModelTraining.scala:127-149)."""
+    return {
+        TaskType.LOGISTIC_REGRESSION: LogisticLoss,
+        TaskType.LINEAR_REGRESSION: SquaredLoss,
+        TaskType.POISSON_REGRESSION: PoissonLoss,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SmoothedHingeLoss,
+    }[task]
+
+
+def mean_function(task: TaskType, z: jax.Array) -> jax.Array:
+    """Link-inverse posterior mean (reference GeneralizedLinearModel.scala:68-117).
+
+    logistic -> sigmoid, poisson -> exp, linear/SVM -> identity margin.
+    """
+    if task is TaskType.LOGISTIC_REGRESSION:
+        return jax.nn.sigmoid(z)
+    if task is TaskType.POISSON_REGRESSION:
+        return jnp.exp(z)
+    return z

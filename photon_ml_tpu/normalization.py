@@ -1,0 +1,110 @@
+"""Feature normalization folded into the objective algebraically.
+
+Top-level module (not under losses/) because both ops.data and losses.objective
+depend on it: the context is a flax pytree that travels WITH the data batch so
+jit treats factor/shift as traced arguments, never as baked-in constants.
+
+Reference parity: normalization/NormalizationContext.scala:39 — the transform
+x -> (x - shift) .* factor is NEVER materialized on the data; instead the
+objective uses effective coefficients ``ew = factor .* w`` and a scalar margin
+correction ``- dot(shift, ew)`` (ValueAndGradientAggregator.scala:35-79), so
+sparse feature batches stay sparse. ``transform_model_coefficients`` maps the
+trained coefficients back to the original feature space
+(NormalizationContext.scala:71-82).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from photon_ml_tpu.types import NormalizationType
+
+
+@struct.dataclass
+class NormalizationContext:
+    """factor/shift are [d] arrays or None (no-op). When shift is present an
+    intercept must exist; the intercept's slot has factor 1, shift 0
+    (enforced by the factory, reference NormalizationContext.scala:95-145)."""
+
+    factor: Optional[jax.Array] = None
+    shift: Optional[jax.Array] = None
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factor is None and self.shift is None
+
+    def effective_coefficients(self, w: jax.Array) -> jax.Array:
+        return w * self.factor if self.factor is not None else w
+
+    def margin_shift(self, ew: jax.Array) -> jax.Array:
+        """Scalar correction subtracted from every margin."""
+        if self.shift is None:
+            return jnp.zeros((), dtype=ew.dtype)
+        return jnp.dot(self.shift, ew)
+
+    def apply_to_gradient(self, raw: jax.Array, csum: jax.Array) -> jax.Array:
+        """Map d(loss)/d(ew) pieces to d(loss)/dw.
+
+        raw = X^T c, csum = sum(c); grad_j = factor_j * (raw_j - shift_j*csum).
+        """
+        g = raw
+        if self.shift is not None:
+            g = g - self.shift * csum
+        if self.factor is not None:
+            g = g * self.factor
+        return g
+
+    def transform_model_coefficients(self, w: jax.Array, intercept_index: Optional[int]) -> jax.Array:
+        """Trained-in-normalized-space w -> original-space coefficients
+        (reference NormalizationContext.scala:71-82): w_orig = factor .* w,
+        intercept_orig = intercept - dot(shift, factor .* w)."""
+        w_orig = w * self.factor if self.factor is not None else w
+        if self.shift is not None:
+            if intercept_index is None:
+                raise ValueError("shift normalization requires an intercept")
+            correction = jnp.dot(self.shift, w_orig)
+            w_orig = w_orig.at[intercept_index].add(-correction)
+        return w_orig
+
+
+def build_normalization_context(
+    norm_type: NormalizationType,
+    mean: jax.Array,
+    variance: jax.Array,
+    max_magnitude: jax.Array,
+    intercept_index: Optional[int],
+) -> NormalizationContext:
+    """Factory from feature summary statistics (reference
+    NormalizationContext.scala:95-145).
+
+    - SCALE_WITH_STANDARD_DEVIATION: factor = 1/std
+    - SCALE_WITH_MAX_MAGNITUDE:      factor = 1/max|x|
+    - STANDARDIZATION:               factor = 1/std, shift = mean (needs intercept)
+    """
+    if norm_type is NormalizationType.NONE:
+        return NormalizationContext()
+
+    std = jnp.sqrt(variance)
+    inv_std = jnp.where(std > 0, 1.0 / jnp.maximum(std, 1e-30), 1.0)
+    if norm_type is NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factor, shift = inv_std, None
+    elif norm_type is NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        mm = jnp.abs(max_magnitude)
+        factor = jnp.where(mm > 0, 1.0 / jnp.maximum(mm, 1e-30), 1.0)
+        shift = None
+    elif norm_type is NormalizationType.STANDARDIZATION:
+        if intercept_index is None:
+            raise ValueError("STANDARDIZATION requires an intercept feature")
+        factor, shift = inv_std, mean
+    else:
+        raise ValueError(f"unknown normalization type {norm_type}")
+
+    if intercept_index is not None:
+        factor = factor.at[intercept_index].set(1.0)
+        if shift is not None:
+            shift = shift.at[intercept_index].set(0.0)
+    return NormalizationContext(factor=factor, shift=shift)

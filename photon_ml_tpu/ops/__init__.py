@@ -1,0 +1,4 @@
+from photon_ml_tpu.ops.features import DenseFeatures, EllFeatures, FeatureMatrix
+from photon_ml_tpu.ops.data import LabeledData
+
+__all__ = ["DenseFeatures", "EllFeatures", "FeatureMatrix", "LabeledData"]

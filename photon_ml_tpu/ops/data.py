@@ -1,0 +1,78 @@
+"""Struct-of-arrays labeled data batch.
+
+Reference parity: photon-lib data/LabeledPoint.scala:32 — (label, features,
+offset, weight) — except batched: one pytree holds n examples. Padding rows
+are encoded as weight 0 (an algebraic no-op in every objective term); there is
+deliberately no separate mask field.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.ops.features import FeatureMatrix
+
+
+@struct.dataclass
+class LabeledData:
+    """A batch of (label, features, offset, weight) examples.
+
+    labels/offsets/weights: [n]; padding rows must have weight 0.
+
+    ``norm`` is the NormalizationContext folded into any objective evaluated
+    over this batch; it lives in the data pytree (traced jit argument) so
+    factor/shift arrays are never baked into compiled programs as constants.
+    """
+
+    features: FeatureMatrix
+    labels: jax.Array
+    offsets: jax.Array
+    weights: jax.Array
+    norm: Optional[NormalizationContext] = None
+
+    @classmethod
+    def create(
+        cls,
+        features: FeatureMatrix,
+        labels: jax.Array,
+        offsets: Optional[jax.Array] = None,
+        weights: Optional[jax.Array] = None,
+        norm: Optional[NormalizationContext] = None,
+    ) -> "LabeledData":
+        labels = jnp.asarray(labels, dtype=jnp.float32)
+        n = labels.shape[-1]
+        if offsets is None:
+            offsets = jnp.zeros((n,), dtype=jnp.float32)
+        if weights is None:
+            weights = jnp.ones((n,), dtype=jnp.float32)
+        return cls(
+            features=features,
+            labels=labels,
+            offsets=offsets,
+            weights=weights,
+            norm=norm,
+        )
+
+    @property
+    def num_rows(self) -> int:
+        return self.labels.shape[-1]
+
+    @property
+    def dim(self) -> int:
+        return self.features.dim
+
+    def total_weight(self) -> jax.Array:
+        return jnp.sum(self.weights)
+
+    def with_offsets(self, offsets: jax.Array) -> "LabeledData":
+        """Replace offsets (the residual trick: Coordinate.scala:59-62)."""
+        return self.replace(offsets=offsets)
+
+    def add_to_offsets(self, scores: jax.Array) -> "LabeledData":
+        """addScoresToOffsets (reference FixedEffectDataSet.scala:44-54)."""
+        return self.replace(offsets=self.offsets + scores)

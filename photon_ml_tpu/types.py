@@ -1,0 +1,68 @@
+"""Core shared types and enums.
+
+Reference parity: photon-lib constants (TaskType.scala:20-24, Types.scala:21-43,
+MathConst.scala). Spark-specific storage levels have no equivalent here.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Type aliases mirroring reference Types.scala:21-43. Sample ids are positions
+# into dense arrays rather than RDD keys.
+CoordinateId = str
+FeatureShardId = str
+REType = str  # random effect type, e.g. "userId"
+REId = str  # a single random effect entity id
+
+
+class TaskType(enum.Enum):
+    """Training task (reference TaskType.scala:20-24)."""
+
+    LINEAR_REGRESSION = "linear_regression"
+    LOGISTIC_REGRESSION = "logistic_regression"
+    POISSON_REGRESSION = "poisson_regression"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "smoothed_hinge_loss_linear_svm"
+
+    @property
+    def is_classification(self) -> bool:
+        return self in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )
+
+
+class NormalizationType(enum.Enum):
+    """Feature normalization modes (reference NormalizationType)."""
+
+    NONE = "none"
+    SCALE_WITH_MAX_MAGNITUDE = "scale_with_max_magnitude"
+    SCALE_WITH_STANDARD_DEVIATION = "scale_with_standard_deviation"
+    STANDARDIZATION = "standardization"
+
+
+class RegularizationType(enum.Enum):
+    """Regularization family (reference RegularizationType)."""
+
+    NONE = "none"
+    L1 = "l1"
+    L2 = "l2"
+    ELASTIC_NET = "elastic_net"
+
+
+class ConvergenceReason(enum.Enum):
+    """Why an optimizer stopped (reference util/ConvergenceReason.scala:21).
+
+    Encoded as int32 device-side; see opt/solver_state.py.
+    """
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    OBJECTIVE_NOT_IMPROVING = 4
+
+
+# Numerical constants (reference constants/MathConst.scala).
+POSITIVE_RESPONSE_THRESHOLD = 0.5
+EPSILON = 1e-7

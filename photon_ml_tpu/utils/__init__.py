@@ -1,0 +1,4 @@
+from photon_ml_tpu.utils import math_utils
+from photon_ml_tpu.utils.timer import Timed, Timer
+
+__all__ = ["math_utils", "Timed", "Timer"]

@@ -1,0 +1,30 @@
+"""Numerically-stable scalar math helpers.
+
+Reference parity: photon-lib util/MathUtils.scala (log1pExp) plus small
+helpers used throughout the objective/optimizer stack.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def log1p_exp(x: jax.Array) -> jax.Array:
+    """log(1 + exp(x)) without overflow (reference MathUtils.log1pExp).
+
+    Implemented via ``jax.nn.softplus`` which is the numerically-stable
+    formulation ``max(x, 0) + log1p(exp(-|x|))``.
+    """
+    return jax.nn.softplus(x)
+
+
+def is_almost_zero(x: jax.Array, eps: float = 1e-15) -> jax.Array:
+    """|x| < eps (reference MathUtils.isAlmostZero)."""
+    return jnp.abs(x) < eps
+
+
+def safe_div(num: jax.Array, den: jax.Array, eps: float = 1e-15) -> jax.Array:
+    """num / den, returning 0 where |den| < eps (used for masked means)."""
+    safe_den = jnp.where(jnp.abs(den) < eps, 1.0, den)
+    return jnp.where(jnp.abs(den) < eps, 0.0, num / safe_den)

@@ -1,0 +1,42 @@
+"""Phase timing utilities (reference util/Timed.scala:33, Timer.scala:182).
+
+The reference wraps every driver phase in ``Timed { }`` blocks writing to a
+driver-side logger; here the same pattern is a context manager that logs
+wall-clock per phase and can be queried afterwards (bench/driver code uses it).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+class Timer:
+    """Accumulates named phase durations."""
+
+    def __init__(self) -> None:
+        self.durations: Dict[str, float] = {}
+
+    @contextmanager
+    def time(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            self.durations[name] = self.durations.get(name, 0.0) + elapsed
+            logger.info("phase %s took %.3fs", name, elapsed)
+
+
+@contextmanager
+def Timed(name: str) -> Iterator[None]:
+    """Standalone timed block, logging at INFO."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.info("phase %s took %.3fs", name, time.perf_counter() - start)

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-virtual-device CPU platform BEFORE jax initializes.
+
+This is the TPU-world analog of the reference's SparkTestUtils.sparkTest
+(`local[4]` in-process Spark, SparkTestUtils.scala:61-77): multi-device
+semantics are simulated in one process so sharding/collective code paths are
+exercised without real hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(seed=42)

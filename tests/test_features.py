@@ -1,0 +1,40 @@
+"""ELL builder edge cases flagged in review: duplicates, empty input, overflow."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.features import from_scipy_like
+
+
+def test_empty_matrix_plain_lists():
+    ell = from_scipy_like([], [], [], (4, 3))
+    assert ell.values.shape == (4, 1)
+    np.testing.assert_allclose(ell.matvec(jnp.ones(3)), np.zeros(4))
+
+
+def test_duplicate_entries_coalesced():
+    # two entries at (0, 2): 1.5 + 2.5 = 4.0; rmatvec_sq must see 4^2 not 1.5^2+2.5^2
+    ell = from_scipy_like([0, 0, 1], [2, 2, 0], [1.5, 2.5, 3.0], (2, 3))
+    w = jnp.array([1.0, 1.0, 1.0])
+    np.testing.assert_allclose(ell.matvec(w), [4.0, 3.0])
+    c = jnp.array([1.0, 0.0])
+    np.testing.assert_allclose(ell.rmatvec_sq(c), [0.0, 0.0, 16.0])
+
+
+def test_max_nnz_overflow_raises():
+    with pytest.raises(ValueError, match="exceeds max_nnz"):
+        from_scipy_like([0, 0, 0], [0, 1, 2], [1.0, 1.0, 1.0], (1, 3), max_nnz=2)
+
+
+def test_max_nnz_padding():
+    ell = from_scipy_like([0], [1], [2.0], (2, 3), max_nnz=4)
+    assert ell.values.shape == (2, 4)
+    np.testing.assert_allclose(ell.to_dense().matrix, [[0.0, 2.0, 0.0], [0.0, 0.0, 0.0]])
+
+
+def test_out_of_range_indices_raise():
+    with pytest.raises(ValueError, match="column index out of range"):
+        from_scipy_like([0], [5], [1.0], (1, 3))
+    with pytest.raises(ValueError, match="row index out of range"):
+        from_scipy_like([4], [0], [1.0], (2, 3))

@@ -1,0 +1,166 @@
+"""Unit tests for pointwise losses and the GLM objective.
+
+Mirrors the reference's derivative checks
+(photon-api/src/test/.../function/glm/LogisticLossFunctionTest.scala etc.):
+analytic d1/d2 vs finite differences, objective grad vs jax.grad, Hessian-vector
+vs jvp-of-grad, normalization-folding equivalence vs explicitly transformed data.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.losses import (
+    LogisticLoss,
+    NormalizationContext,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+    make_glm_objective,
+)
+from photon_ml_tpu.ops import DenseFeatures, EllFeatures, LabeledData
+
+LOSSES = [LogisticLoss, SquaredLoss, PoissonLoss, SmoothedHingeLoss]
+
+
+@pytest.mark.parametrize("loss", LOSSES)
+def test_d1_matches_autodiff(loss):
+    # offset avoids the hinge's kinks at u in {0, 1}
+    z = jnp.linspace(-3.0, 3.0, 41) + 0.0131
+    for y in (0.0, 1.0, 3.0) if loss is PoissonLoss else (0.0, 1.0):
+        y_arr = jnp.full_like(z, y)
+        d1_auto = jax.vmap(jax.grad(lambda zz, yy: loss.value(zz, yy)))(z, y_arr)
+        np.testing.assert_allclose(loss.d1(z, y_arr), d1_auto, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("loss", [LogisticLoss, SquaredLoss, PoissonLoss])
+def test_d2_matches_autodiff(loss):
+    z = jnp.linspace(-3.0, 3.0, 41) + 0.0131
+    y_arr = jnp.ones_like(z)
+    d2_auto = jax.vmap(jax.grad(jax.grad(lambda zz, yy: loss.value(zz, yy))))(z, y_arr)
+    np.testing.assert_allclose(loss.d2(z, y_arr), d2_auto, rtol=2e-4, atol=1e-5)
+
+
+def test_logistic_stability_large_margins():
+    z = jnp.array([-1e4, 1e4])
+    y = jnp.array([1.0, 0.0])
+    v = LogisticLoss.value(z, y)
+    assert bool(jnp.all(jnp.isfinite(v)))
+    np.testing.assert_allclose(v, [1e4, 1e4], rtol=1e-6)
+
+
+def _random_data(rng, n=32, d=7, dense=True):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    if dense:
+        feats = DenseFeatures(matrix=jnp.asarray(X))
+    else:
+        mask = rng.random((n, d)) < 0.4
+        X = X * mask
+        rows, cols = np.nonzero(X)
+        from photon_ml_tpu.ops.features import from_scipy_like
+
+        feats = from_scipy_like(rows, cols, X[rows, cols], (n, d))
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    offsets = rng.normal(size=n).astype(np.float32) * 0.1
+    weights = rng.random(n).astype(np.float32) + 0.5
+    return LabeledData.create(feats, jnp.asarray(y), jnp.asarray(offsets), jnp.asarray(weights))
+
+
+@pytest.mark.parametrize("dense", [True, False])
+@pytest.mark.parametrize("loss", [LogisticLoss, SquaredLoss, PoissonLoss])
+def test_objective_grad_matches_autodiff(rng, loss, dense):
+    data = _random_data(rng, dense=dense)
+    obj = make_glm_objective(loss)
+    w = jnp.asarray(rng.normal(size=7).astype(np.float32)) * 0.3
+    l2 = jnp.float32(0.7)
+    v, g = obj.value_and_grad(w, data, l2)
+    v_ref = obj.value(w, data, l2)
+    g_auto = jax.grad(lambda ww: obj.value(ww, data, l2))(w)
+    np.testing.assert_allclose(v, v_ref, rtol=1e-5)
+    np.testing.assert_allclose(g, g_auto, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("dense", [True, False])
+def test_hessian_vec_matches_autodiff(rng, dense):
+    data = _random_data(rng, dense=dense)
+    obj = make_glm_objective(LogisticLoss)
+    w = jnp.asarray(rng.normal(size=7).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.normal(size=7).astype(np.float32))
+    l2 = jnp.float32(0.3)
+    hv = obj.hessian_vec(w, v, data, l2)
+    grad_fn = lambda ww: obj.value_and_grad(ww, data, l2)[1]
+    hv_auto = jax.jvp(grad_fn, (w,), (v,))[1]
+    np.testing.assert_allclose(hv, hv_auto, rtol=1e-4, atol=1e-4)
+
+
+def test_hessian_diag_matches_full_hessian(rng):
+    data = _random_data(rng, n=16, d=5)
+    obj = make_glm_objective(LogisticLoss)
+    w = jnp.asarray(rng.normal(size=5).astype(np.float32)) * 0.3
+    l2 = jnp.float32(0.2)
+    H = jax.hessian(lambda ww: obj.value(ww, data, l2))(w)
+    np.testing.assert_allclose(
+        obj.hessian_diag(w, data, l2), jnp.diag(H), rtol=1e-2, atol=1e-3
+    )
+
+
+def test_normalization_folding_equivalent_to_materialized(rng):
+    """Objective with (factor, shift) folded in == objective on explicitly
+    transformed dense features (the reference's core normalization invariant,
+    NormalizationTest.scala)."""
+    n, d = 24, 6
+    X = rng.normal(size=(n, d)).astype(np.float32) * 3 + 1.5
+    X[:, -1] = 1.0  # intercept column
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    factor = (1.0 / (np.std(X, axis=0) + 1e-9)).astype(np.float32)
+    shift = np.mean(X, axis=0).astype(np.float32)
+    factor[-1], shift[-1] = 1.0, 0.0
+
+    norm = NormalizationContext(factor=jnp.asarray(factor), shift=jnp.asarray(shift))
+    data_raw = LabeledData.create(
+        DenseFeatures(matrix=jnp.asarray(X)), jnp.asarray(y), norm=norm
+    )
+    Xn = (X - shift) * factor
+    data_norm = LabeledData.create(DenseFeatures(matrix=jnp.asarray(Xn)), jnp.asarray(y))
+
+    obj_folded = make_glm_objective(LogisticLoss)
+    obj_plain = make_glm_objective(LogisticLoss)
+
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    l2 = jnp.float32(0.5)
+    v_f, g_f = obj_folded.value_and_grad(w, data_raw, l2)
+    v_p, g_p = obj_plain.value_and_grad(w, data_norm, l2)
+    np.testing.assert_allclose(v_f, v_p, rtol=1e-4)
+    np.testing.assert_allclose(g_f, g_p, rtol=1e-3, atol=1e-3)
+
+    vec = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    np.testing.assert_allclose(
+        obj_folded.hessian_vec(w, vec, data_raw, l2),
+        obj_plain.hessian_vec(w, vec, data_norm, l2),
+        rtol=1e-3,
+        atol=1e-3,
+    )
+    np.testing.assert_allclose(
+        obj_folded.hessian_diag(w, data_raw, l2),
+        obj_plain.hessian_diag(w, data_norm, l2),
+        rtol=1e-3,
+        atol=1e-3,
+    )
+
+
+def test_ell_matches_dense(rng):
+    n, d = 20, 9
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[rng.random((n, d)) < 0.5] = 0.0
+    rows, cols = np.nonzero(X)
+    from photon_ml_tpu.ops.features import from_scipy_like
+
+    ell = from_scipy_like(rows, cols, X[rows, cols], (n, d))
+    dense = DenseFeatures(matrix=jnp.asarray(X))
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    np.testing.assert_allclose(ell.matvec(w), dense.matvec(w), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ell.rmatvec(c), dense.rmatvec(c), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(ell.rmatvec_sq(c), dense.rmatvec_sq(c), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(ell.to_dense().matrix, X, rtol=1e-6)
