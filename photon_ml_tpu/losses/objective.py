@@ -58,9 +58,14 @@ def make_glm_objective(loss: Type[PointwiseLoss]) -> GlmObjective:
         ew = norm.effective_coefficients(w)
         return data.features.matvec(ew) - norm.margin_shift(ew) + data.offsets
 
+    def _wmask(weights: jax.Array, terms: jax.Array) -> jax.Array:
+        # weight-0 padding rows must be exact no-ops even when the unweighted
+        # term overflows to inf (0 * inf = NaN would poison the sum)
+        return jnp.where(weights > 0, weights * terms, 0.0)
+
     def value(w: jax.Array, data: LabeledData, l2: jax.Array) -> jax.Array:
         z = margins(w, data)
-        loss_sum = jnp.sum(data.weights * loss.value(z, data.labels))
+        loss_sum = jnp.sum(_wmask(data.weights, loss.value(z, data.labels)))
         return loss_sum + 0.5 * l2 * jnp.dot(w, w)
 
     def value_and_grad(
@@ -68,8 +73,8 @@ def make_glm_objective(loss: Type[PointwiseLoss]) -> GlmObjective:
     ) -> Tuple[jax.Array, jax.Array]:
         norm = _norm_of(data)
         z = margins(w, data)
-        loss_sum = jnp.sum(data.weights * loss.value(z, data.labels))
-        c = data.weights * loss.d1(z, data.labels)
+        loss_sum = jnp.sum(_wmask(data.weights, loss.value(z, data.labels)))
+        c = _wmask(data.weights, loss.d1(z, data.labels))
         raw = data.features.rmatvec(c)
         grad = norm.apply_to_gradient(raw, jnp.sum(c))
         return loss_sum + 0.5 * l2 * jnp.dot(w, w), grad + l2 * w
@@ -84,7 +89,7 @@ def make_glm_objective(loss: Type[PointwiseLoss]) -> GlmObjective:
         z = margins(w, data)
         ev = norm.effective_coefficients(v)
         zv = data.features.matvec(ev) - norm.margin_shift(ev)
-        c2 = data.weights * loss.d2(z, data.labels) * zv
+        c2 = _wmask(data.weights, loss.d2(z, data.labels) * zv)
         raw = data.features.rmatvec(c2)
         return norm.apply_to_gradient(raw, jnp.sum(c2)) + l2 * v
 
@@ -98,7 +103,7 @@ def make_glm_objective(loss: Type[PointwiseLoss]) -> GlmObjective:
         """
         norm = _norm_of(data)
         z = margins(w, data)
-        a = data.weights * loss.d2(z, data.labels)
+        a = _wmask(data.weights, loss.d2(z, data.labels))
         sq = data.features.rmatvec_sq(a)
         if norm.shift is not None:
             lin = data.features.rmatvec(a)

@@ -62,7 +62,7 @@ class NormalizationContext:
         """Trained-in-normalized-space w -> original-space coefficients
         (reference NormalizationContext.scala:71-82): w_orig = factor .* w,
         intercept_orig = intercept - dot(shift, factor .* w)."""
-        w_orig = w * self.factor if self.factor is not None else w
+        w_orig = self.effective_coefficients(w)
         if self.shift is not None:
             if intercept_index is None:
                 raise ValueError("shift normalization requires an intercept")

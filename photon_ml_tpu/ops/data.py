@@ -46,10 +46,16 @@ class LabeledData:
     ) -> "LabeledData":
         labels = jnp.asarray(labels, dtype=jnp.float32)
         n = labels.shape[-1]
-        if offsets is None:
-            offsets = jnp.zeros((n,), dtype=jnp.float32)
-        if weights is None:
-            weights = jnp.ones((n,), dtype=jnp.float32)
+        offsets = (
+            jnp.zeros((n,), dtype=jnp.float32)
+            if offsets is None
+            else jnp.asarray(offsets, dtype=jnp.float32)
+        )
+        weights = (
+            jnp.ones((n,), dtype=jnp.float32)
+            if weights is None
+            else jnp.asarray(weights, dtype=jnp.float32)
+        )
         return cls(
             features=features,
             labels=labels,

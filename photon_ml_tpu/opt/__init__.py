@@ -1,0 +1,23 @@
+from photon_ml_tpu.opt.config import (
+    GlmOptimizationConfiguration,
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+)
+from photon_ml_tpu.opt.lbfgs import lbfgs_solve
+from photon_ml_tpu.opt.owlqn import owlqn_solve
+from photon_ml_tpu.opt.solve import solve
+from photon_ml_tpu.opt.state import SolveResult
+from photon_ml_tpu.opt.tron import tron_solve
+
+__all__ = [
+    "GlmOptimizationConfiguration",
+    "OptimizerConfig",
+    "OptimizerType",
+    "RegularizationContext",
+    "lbfgs_solve",
+    "owlqn_solve",
+    "tron_solve",
+    "solve",
+    "SolveResult",
+]

@@ -1,0 +1,222 @@
+"""OWL-QN: Orthant-Wise Limited-memory Quasi-Newton for L1 / elastic net.
+
+Reference parity: optimization/OWLQN.scala:40, which wrapped
+``breeze.optimize.OWLQN``; the L1 weight is applied at the optimizer level —
+never inside the smooth objective (the L2 part of elastic net stays in the
+objective). Algorithm follows Andrew & Gao (2007):
+
+- pseudo-gradient: subgradient of f(w) + l1*||w||_1 choosing the orthant of
+  steepest descent at w_j = 0
+- two-loop direction computed from SMOOTH gradient history, then aligned
+  (projected) against the pseudo-gradient
+- line search over orthant-projected points pi(w + t*d; xi) with a
+  backtracking sufficient-decrease condition on F = f + l1*||w||_1
+  (Breeze's OWLQN uses the same backtracking scheme)
+
+Box constraints are not supported with L1 (same restriction as the reference).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.losses.objective import GlmObjective
+from photon_ml_tpu.opt.config import OptimizerConfig
+from photon_ml_tpu.opt.lbfgs import two_loop_direction
+from photon_ml_tpu.opt.state import SolveResult, absolute_tolerances
+from photon_ml_tpu.types import ConvergenceReason
+
+
+def pseudo_gradient(w: jax.Array, g: jax.Array, l1: jax.Array) -> jax.Array:
+    """Subgradient of f + l1*|w|_1 with steepest-descent tie-breaking at 0."""
+    at_zero = w == 0
+    pg_nonzero = g + l1 * jnp.sign(w)
+    # at w_j = 0 the subdifferential is [g - l1, g + l1]; the minimal-norm
+    # element is 0 if the interval contains 0, else the closest endpoint.
+    pg_zero = jnp.where(g + l1 < 0, g + l1, jnp.where(g - l1 > 0, g - l1, 0.0))
+    return jnp.where(at_zero, pg_zero, pg_nonzero)
+
+
+def _project_orthant(w: jax.Array, xi: jax.Array) -> jax.Array:
+    """pi(w; xi): zero out coordinates that left the orthant xi."""
+    return jnp.where(jnp.sign(w) == xi, w, 0.0)
+
+
+class _OwlqnState(NamedTuple):
+    w: jax.Array
+    f: jax.Array          # smooth f (no L1)
+    g: jax.Array          # smooth gradient
+    F: jax.Array          # f + l1*|w|_1
+    s_hist: jax.Array
+    y_hist: jax.Array
+    rho: jax.Array
+    count: jax.Array
+    it: jax.Array
+    reason: jax.Array
+    history: jax.Array
+
+
+def owlqn_solve(
+    objective: GlmObjective,
+    w0: jax.Array,
+    data,
+    l2_weight: jax.Array,
+    l1_weight: jax.Array,
+    config: OptimizerConfig = OptimizerConfig(),
+) -> SolveResult:
+    if config.constraint_lower is not None or config.constraint_upper is not None:
+        raise ValueError("box constraints are not supported with L1 (OWL-QN)")
+    m = config.history_length
+    max_iter = config.max_iterations
+    dim = w0.shape[-1]
+    dtype = w0.dtype
+    l1 = jnp.asarray(l1_weight, dtype=dtype)
+
+    f0, g0 = objective.value_and_grad(w0, data, l2_weight)
+    F0 = f0 + l1 * jnp.sum(jnp.abs(w0))
+    pg0 = pseudo_gradient(w0, g0, l1)
+    pg0_norm = jnp.linalg.norm(pg0)
+    abs_f_tol, abs_g_tol = absolute_tolerances(F0, pg0_norm, config.tolerance)
+
+    history0 = jnp.full((max_iter + 1,), jnp.nan, dtype=dtype).at[0].set(F0)
+    init = _OwlqnState(
+        w=w0,
+        f=f0,
+        g=g0,
+        F=F0,
+        s_hist=jnp.zeros((m, dim), dtype=dtype),
+        y_hist=jnp.zeros((m, dim), dtype=dtype),
+        rho=jnp.zeros((m,), dtype=dtype),
+        count=jnp.int32(0),
+        it=jnp.int32(0),
+        reason=jnp.int32(ConvergenceReason.NOT_CONVERGED.value),
+        history=history0,
+    )
+
+    GAMMA = 1e-4  # sufficient-decrease constant (Andrew & Gao use 1e-4)
+    BACKTRACK = 0.5
+
+    def cond(s: _OwlqnState):
+        return (s.reason == ConvergenceReason.NOT_CONVERGED.value) & (s.it < max_iter)
+
+    def body(s: _OwlqnState) -> _OwlqnState:
+        pg = pseudo_gradient(s.w, s.g, l1)
+        d = two_loop_direction(pg, s.s_hist, s.y_hist, s.rho, s.count)
+        # align direction with -pg (zero disagreeing coordinates)
+        d = jnp.where(d * pg < 0, d, 0.0)
+        # orthant to search in: sign(w), or sign(-pg) where w = 0
+        xi = jnp.where(s.w != 0, jnp.sign(s.w), jnp.sign(-pg))
+        dirderiv = jnp.dot(pg, d)  # negative if descent
+
+        t0 = jnp.where(s.count == 0, 1.0 / jnp.maximum(jnp.linalg.norm(d), 1e-12), 1.0)
+
+        class _LS(NamedTuple):
+            t: jax.Array
+            i: jax.Array
+            w_t: jax.Array
+            f_t: jax.Array
+            g_t: jax.Array
+            F_t: jax.Array
+            ok: jax.Array
+
+        def ls_cond(c: _LS):
+            return (~c.ok) & (c.i < config.max_line_search_iterations)
+
+        def ls_body(c: _LS) -> _LS:
+            w_t = _project_orthant(s.w + c.t * d, xi)
+            f_t, g_t = objective.value_and_grad(w_t, data, l2_weight)
+            F_t = f_t + l1 * jnp.sum(jnp.abs(w_t))
+            # sufficient decrease vs directional derivative of F along the
+            # PROJECTED step (Andrew & Gao eq. for the projected path)
+            ok = F_t <= s.F + GAMMA * jnp.dot(pg, w_t - s.w)
+            return _LS(
+                t=jnp.where(ok, c.t, c.t * BACKTRACK),
+                i=c.i + 1,
+                w_t=w_t,
+                f_t=f_t,
+                g_t=g_t,
+                F_t=F_t,
+                ok=ok,
+            )
+
+        ls0 = _LS(
+            t=t0.astype(dtype),
+            i=jnp.int32(0),
+            w_t=s.w,
+            f_t=s.f,
+            g_t=s.g,
+            F_t=s.F,
+            ok=jnp.bool_(False),
+        )
+        ls = jax.lax.while_loop(ls_cond, ls_body, ls0)
+
+        w_new = jnp.where(ls.ok, ls.w_t, s.w)
+        f_new = jnp.where(ls.ok, ls.f_t, s.f)
+        g_new = jnp.where(ls.ok, ls.g_t, s.g)
+        F_new = jnp.where(ls.ok, ls.F_t, s.F)
+
+        s_vec = w_new - s.w
+        y_vec = g_new - s.g
+        sy = jnp.dot(s_vec, y_vec)
+        good_pair = sy > 1e-10 * jnp.maximum(jnp.dot(y_vec, y_vec), 1e-30)
+        slot = jnp.mod(s.count, m)
+        s_hist = jnp.where(good_pair, s.s_hist.at[slot].set(s_vec), s.s_hist)
+        y_hist = jnp.where(good_pair, s.y_hist.at[slot].set(y_vec), s.y_hist)
+        rho = jnp.where(good_pair, s.rho.at[slot].set(1.0 / jnp.maximum(sy, 1e-30)), s.rho)
+        count = jnp.where(good_pair, s.count + 1, s.count)
+
+        it = s.it + 1
+        pg_new = pseudo_gradient(w_new, g_new, l1)
+        g_conv = jnp.linalg.norm(pg_new) <= abs_g_tol
+        f_conv = ls.ok & (jnp.abs(s.F - F_new) <= abs_f_tol)
+        no_step = ~ls.ok
+        reason = jnp.where(
+            g_conv,
+            ConvergenceReason.GRADIENT_CONVERGED.value,
+            jnp.where(
+                f_conv,
+                ConvergenceReason.FUNCTION_VALUES_CONVERGED.value,
+                jnp.where(
+                    no_step,
+                    ConvergenceReason.OBJECTIVE_NOT_IMPROVING.value,
+                    jnp.where(
+                        it >= max_iter,
+                        ConvergenceReason.MAX_ITERATIONS.value,
+                        ConvergenceReason.NOT_CONVERGED.value,
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+
+        return _OwlqnState(
+            w=w_new,
+            f=f_new,
+            g=g_new,
+            F=F_new,
+            s_hist=s_hist,
+            y_hist=y_hist,
+            rho=rho,
+            count=count,
+            it=it,
+            reason=reason,
+            history=s.history.at[it].set(F_new),
+        )
+
+    out = jax.lax.while_loop(cond, body, init)
+    reason = jnp.where(
+        out.reason == ConvergenceReason.NOT_CONVERGED.value,
+        jnp.int32(ConvergenceReason.MAX_ITERATIONS.value),
+        out.reason,
+    )
+    pg_final = pseudo_gradient(out.w, out.g, l1)
+    return SolveResult(
+        w=out.w,
+        value=out.F,
+        grad_norm=jnp.linalg.norm(pg_final),
+        iterations=out.it,
+        reason=reason,
+        value_history=out.history,
+    )

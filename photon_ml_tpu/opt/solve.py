@@ -1,0 +1,46 @@
+"""Solver dispatch: pick LBFGS / OWL-QN / TRON from configuration.
+
+Reference parity: OptimizerFactory.scala:27 — OWL-QN is selected automatically
+whenever the regularization has a positive L1 component; TRON is rejected for
+first-order-only objectives. ``l2_weight``/``l1_weight`` are traced scalars so
+λ sweeps reuse one compiled program.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.losses.objective import GlmObjective
+from photon_ml_tpu.opt.config import GlmOptimizationConfiguration, OptimizerType
+from photon_ml_tpu.opt.lbfgs import lbfgs_solve
+from photon_ml_tpu.opt.owlqn import owlqn_solve
+from photon_ml_tpu.opt.state import SolveResult
+from photon_ml_tpu.opt.tron import tron_solve
+
+
+def solve(
+    objective: GlmObjective,
+    w0,
+    data,
+    configuration: GlmOptimizationConfiguration,
+    l2_weight=None,
+    l1_weight=None,
+) -> SolveResult:
+    """Run the configured solver. The optimizer CHOICE is static (python
+    branch, resolved at trace time); the regularization WEIGHTS are traced.
+
+    l2_weight / l1_weight default to the values implied by the configuration
+    but may be overridden with traced scalars (warm-started λ sweeps).
+    """
+    cfg = configuration.optimizer_config
+    l2 = jnp.asarray(configuration.l2_weight if l2_weight is None else l2_weight, dtype=w0.dtype)
+    l1_static = configuration.l1_weight
+    use_owlqn = (l1_weight is not None) or l1_static > 0
+    if use_owlqn:
+        l1 = jnp.asarray(l1_static if l1_weight is None else l1_weight, dtype=w0.dtype)
+        if cfg.optimizer is OptimizerType.TRON:
+            raise ValueError("TRON does not support L1 regularization (use LBFGS/OWL-QN)")
+        return owlqn_solve(objective, w0, data, l2, l1, cfg)
+    if cfg.optimizer is OptimizerType.TRON:
+        return tron_solve(objective, w0, data, l2, cfg)
+    return lbfgs_solve(objective, w0, data, l2, cfg)

@@ -1,0 +1,185 @@
+"""Optimizer tests, modeled on the reference's OptimizerTest/OptimizerIntegTest
+(photon-lib src/test + src/integTest): drive each solver against known
+objectives and check convergence invariants, cross-solver agreement, and
+vmap batchability (the random-effect execution mode).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.losses import LogisticLoss, SquaredLoss, make_glm_objective
+from photon_ml_tpu.ops import DenseFeatures, LabeledData
+from photon_ml_tpu.opt import (
+    GlmOptimizationConfiguration,
+    OptimizerConfig,
+    RegularizationContext,
+    lbfgs_solve,
+    owlqn_solve,
+    solve,
+    tron_solve,
+)
+from photon_ml_tpu.types import ConvergenceReason, RegularizationType
+
+
+def _linreg_problem(rng, n=64, d=8, noise=0.01):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = X @ w_true + noise * rng.normal(size=n).astype(np.float32)
+    data = LabeledData.create(DenseFeatures(matrix=jnp.asarray(X)), jnp.asarray(y))
+    return data, w_true
+
+
+def _logreg_problem(rng, n=256, d=6):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32) * 2
+    p = 1 / (1 + np.exp(-(X @ w_true)))
+    y = (rng.random(n) < p).astype(np.float32)
+    data = LabeledData.create(DenseFeatures(matrix=jnp.asarray(X)), jnp.asarray(y))
+    return data, w_true
+
+
+@pytest.mark.parametrize("solver", [lbfgs_solve, tron_solve])
+def test_quadratic_exact_solution(rng, solver):
+    """Least squares with tiny L2 has a closed-form optimum; both second-order
+    capable solvers must find it."""
+    data, w_true = _linreg_problem(rng)
+    obj = make_glm_objective(SquaredLoss)
+    l2 = jnp.float32(1e-3)
+    res = solver(obj, jnp.zeros(8), data, l2)
+    X = np.asarray(data.features.matrix)
+    y = np.asarray(data.labels)
+    w_exact = np.linalg.solve(X.T @ X + 1e-3 * np.eye(8), X.T @ y)
+    np.testing.assert_allclose(res.w, w_exact, rtol=1e-3, atol=1e-3)
+    assert int(res.reason) != ConvergenceReason.NOT_CONVERGED.value
+
+
+@pytest.mark.parametrize("solver", [lbfgs_solve, tron_solve])
+def test_logistic_converges_and_gradient_small(rng, solver):
+    data, _ = _logreg_problem(rng)
+    obj = make_glm_objective(LogisticLoss)
+    res = solver(obj, jnp.zeros(6), data, jnp.float32(1.0))
+    # gradient at the optimum must be tiny relative to the initial one
+    _, g0 = obj.value_and_grad(jnp.zeros(6), data, jnp.float32(1.0))
+    assert float(res.grad_norm) < 1e-3 * float(jnp.linalg.norm(g0))
+
+
+def test_lbfgs_tron_agree(rng):
+    data, _ = _logreg_problem(rng)
+    obj = make_glm_objective(LogisticLoss)
+    l2 = jnp.float32(0.5)
+    r1 = lbfgs_solve(obj, jnp.zeros(6), data, l2)
+    r2 = tron_solve(obj, jnp.zeros(6), data, l2)
+    np.testing.assert_allclose(r1.w, r2.w, rtol=1e-2, atol=1e-3)
+    np.testing.assert_allclose(r1.value, r2.value, rtol=1e-4)
+
+
+def test_monotone_decrease(rng):
+    data, _ = _logreg_problem(rng)
+    obj = make_glm_objective(LogisticLoss)
+    res = lbfgs_solve(obj, jnp.zeros(6), data, jnp.float32(0.1))
+    h = np.asarray(res.value_history)
+    h = h[~np.isnan(h)]
+    assert len(h) >= 2
+    assert np.all(np.diff(h) <= 1e-5), f"objective increased: {h}"
+
+
+def test_owlqn_produces_sparse_solution(rng):
+    """Strong L1 must zero out coefficients; weak L1 must fit well."""
+    data, w_true = _linreg_problem(rng, n=128, d=10, noise=0.0)
+    obj = make_glm_objective(SquaredLoss)
+    strong = owlqn_solve(obj, jnp.zeros(10), data, jnp.float32(0.0), jnp.float32(500.0))
+    weak = owlqn_solve(obj, jnp.zeros(10), data, jnp.float32(0.0), jnp.float32(1e-4))
+    n_zero_strong = int(jnp.sum(jnp.abs(strong.w) < 1e-8))
+    assert n_zero_strong >= 5, f"strong L1 left {10 - n_zero_strong} nonzeros"
+    np.testing.assert_allclose(weak.w, w_true, rtol=1e-2, atol=1e-2)
+
+
+def test_owlqn_matches_lbfgs_when_l1_zero(rng):
+    data, _ = _logreg_problem(rng)
+    obj = make_glm_objective(LogisticLoss)
+    l2 = jnp.float32(0.5)
+    r_owl = owlqn_solve(obj, jnp.zeros(6), data, l2, jnp.float32(0.0))
+    r_lb = lbfgs_solve(obj, jnp.zeros(6), data, l2)
+    np.testing.assert_allclose(r_owl.value, r_lb.value, rtol=1e-3)
+
+
+def test_box_constraints_respected(rng):
+    data, _ = _linreg_problem(rng)
+    cfg = OptimizerConfig.lbfgs(constraint_lower=-0.1, constraint_upper=0.1)
+    obj = make_glm_objective(SquaredLoss)
+    res = lbfgs_solve(obj, jnp.zeros(8), data, jnp.float32(0.0), cfg)
+    assert float(jnp.max(res.w)) <= 0.1 + 1e-6
+    assert float(jnp.min(res.w)) >= -0.1 - 1e-6
+    # and some coefficient should be AT the boundary (active constraint)
+    assert float(jnp.max(jnp.abs(res.w))) > 0.1 - 1e-4
+
+
+def test_vmap_batched_solves(rng):
+    """vmap over independent problems == solving each separately — the
+    random-effect execution mode (reference RandomEffectCoordinate's
+    mapValues local solves)."""
+    obj = make_glm_objective(SquaredLoss)
+    n_prob, n, d = 5, 32, 4
+    Xs = rng.normal(size=(n_prob, n, d)).astype(np.float32)
+    ws = rng.normal(size=(n_prob, d)).astype(np.float32)
+    ys = np.einsum("pnd,pd->pn", Xs, ws).astype(np.float32)
+    datas = LabeledData.create(
+        DenseFeatures(matrix=jnp.asarray(Xs)),
+        jnp.asarray(ys),
+        offsets=jnp.zeros((n_prob, n)),
+        weights=jnp.ones((n_prob, n)),
+    )
+    l2 = jnp.float32(1e-3)
+    batched = jax.vmap(lambda dd: lbfgs_solve(obj, jnp.zeros(d), dd, l2))(datas)
+    for p in range(n_prob):
+        single = lbfgs_solve(
+            obj,
+            jnp.zeros(d),
+            jax.tree.map(lambda a: a[p], datas),
+            l2,
+        )
+        np.testing.assert_allclose(batched.w[p], single.w, rtol=5e-2, atol=5e-3)
+        np.testing.assert_allclose(batched.w[p], ws[p], rtol=5e-2, atol=5e-3)
+
+
+def test_solve_dispatch(rng):
+    data, _ = _logreg_problem(rng)
+    obj = make_glm_objective(LogisticLoss)
+    cfg_l1 = GlmOptimizationConfiguration(
+        regularization=RegularizationContext(RegularizationType.ELASTIC_NET, alpha=0.5),
+        regularization_weight=1.0,
+    )
+    res = solve(obj, jnp.zeros(6), data, cfg_l1)
+    assert res.w.shape == (6,)
+    cfg_tron = GlmOptimizationConfiguration(
+        optimizer_config=OptimizerConfig.tron(),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    res2 = solve(obj, jnp.zeros(6), data, cfg_tron)
+    np.testing.assert_allclose(res2.grad_norm, 0.0, atol=5e-2)
+    with pytest.raises(ValueError, match="TRON does not support L1"):
+        solve(
+            obj,
+            jnp.zeros(6),
+            data,
+            GlmOptimizationConfiguration(
+                optimizer_config=OptimizerConfig.tron(),
+                regularization=RegularizationContext(RegularizationType.L1),
+                regularization_weight=1.0,
+            ),
+        )
+
+
+def test_warm_start_lambda_sweep_no_recompile(rng):
+    """l2_weight is traced: two λ values must hit the same compiled program
+    (the reference's warm-start sweep, ModelTraining.scala:160-206)."""
+    data, _ = _logreg_problem(rng)
+    obj = make_glm_objective(LogisticLoss)
+    jitted = jax.jit(lambda w0, dd, l2: lbfgs_solve(obj, w0, dd, l2))
+    r_high = jitted(jnp.zeros(6), data, jnp.float32(100.0))
+    r_low = jitted(r_high.w, data, jnp.float32(0.1))
+    assert jitted._cache_size() == 1
+    assert float(r_low.value) < float(r_high.value)
