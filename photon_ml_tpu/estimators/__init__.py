@@ -1,0 +1,3 @@
+from photon_ml_tpu.estimators.model_training import GlmFit, train_glm
+
+__all__ = ["GlmFit", "train_glm"]

@@ -1,0 +1,4 @@
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+
+__all__ = ["Coefficients", "GeneralizedLinearModel"]

@@ -1,0 +1,37 @@
+"""Model coefficients: means + optional variances.
+
+Reference parity: model/Coefficients.scala:31 — means and optional variances
+(the reference stores Breeze dense/sparse vectors; here both are device
+arrays; sparsity of a trained model is represented by zeros, with the IO layer
+writing only nonzeros like the reference's Avro writer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class Coefficients:
+    means: jax.Array                      # [d]
+    variances: Optional[jax.Array] = None  # [d] or None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, features) -> jax.Array:
+        """Dot product with a FeatureMatrix batch (reference
+        Coefficients.scala:53)."""
+        return features.matvec(self.means)
+
+    def l2_norm(self) -> jax.Array:
+        return jnp.linalg.norm(self.means)
+
+    @classmethod
+    def zeros(cls, dim: int, dtype=jnp.float32) -> "Coefficients":
+        return cls(means=jnp.zeros((dim,), dtype=dtype))

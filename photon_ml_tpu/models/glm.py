@@ -1,0 +1,57 @@
+"""Per-task generalized linear models.
+
+Reference parity: supervised/model/GeneralizedLinearModel.scala:33
+(computeScore / computeMean contract :68-117), LogisticRegressionModel.scala:31
+(mean = sigmoid), LinearRegressionModel / PoissonRegressionModel (mean = exp),
+SmoothedHingeLossLinearSVMModel, BinaryClassifier.predictClassWithThreshold.
+One class parametrized by TaskType replaces the reference's four subclasses:
+the task only changes the link-inverse and threshold semantics, and a static
+enum field keeps the pytree jit-friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from photon_ml_tpu.losses.pointwise import mean_function
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.types import POSITIVE_RESPONSE_THRESHOLD, TaskType
+
+
+@struct.dataclass
+class GeneralizedLinearModel:
+    coefficients: Coefficients
+    task: TaskType = struct.field(pytree_node=False, default=TaskType.LOGISTIC_REGRESSION)
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    def compute_score(self, features) -> jax.Array:
+        """Margin z = X @ w (no offset; reference computeScore)."""
+        return self.coefficients.compute_score(features)
+
+    def compute_mean(self, features, offsets=None) -> jax.Array:
+        """Posterior mean via the task link-inverse (reference computeMean)."""
+        z = self.compute_score(features)
+        if offsets is not None:
+            z = z + offsets
+        return mean_function(self.task, z)
+
+    def predict_class(
+        self, features, offsets=None, threshold: float = POSITIVE_RESPONSE_THRESHOLD
+    ) -> jax.Array:
+        """Binary prediction (reference BinaryClassifier.predictClassWithThreshold);
+        only meaningful for classification tasks."""
+        if not self.task.is_classification:
+            raise ValueError(f"predict_class is not defined for {self.task}")
+        mean = self.compute_mean(features, offsets)
+        # SVM margins are thresholded at 0, probabilities at `threshold`
+        cut = 0.0 if self.task is TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM else threshold
+        return (mean > cut).astype(jnp.float32)
+
+    @classmethod
+    def zeros(cls, dim: int, task: TaskType) -> "GeneralizedLinearModel":
+        return cls(coefficients=Coefficients.zeros(dim), task=task)

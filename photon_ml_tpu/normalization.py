@@ -70,6 +70,44 @@ class NormalizationContext:
             w_orig = w_orig.at[intercept_index].add(-correction)
         return w_orig
 
+    def inverse_transform_model_coefficients(
+        self, w_orig: jax.Array, intercept_index: Optional[int]
+    ) -> jax.Array:
+        """Original-space coefficients -> normalized-space (exact inverse of
+        ``transform_model_coefficients``; used to warm-start a normalized
+        solve from a saved original-space model)."""
+        w = w_orig
+        if self.shift is not None:
+            if intercept_index is None:
+                raise ValueError("shift normalization requires an intercept")
+            correction = jnp.dot(self.shift, w_orig)
+            w = w.at[intercept_index].add(correction)
+        if self.factor is not None:
+            w = w / self.factor
+        return w
+
+    def transform_model_variances(
+        self, v: jax.Array, intercept_index: Optional[int]
+    ) -> jax.Array:
+        """Normalized-space coefficient variances -> original space.
+
+        Delta method on the linear map w_orig = factor .* w (and the
+        intercept's shift correction, treating coefficients as independent):
+        var_orig = factor^2 .* var; var_intercept += sum((shift*factor)^2 var).
+        (The reference pushes variances through the same transform as means —
+        GeneralizedLinearOptimizationProblem.scala:94-95 — which drops the
+        square; this is the mathematically consistent version.)
+        """
+        v_orig = v * self.factor * self.factor if self.factor is not None else v
+        if self.shift is not None:
+            if intercept_index is None:
+                raise ValueError("shift normalization requires an intercept")
+            extra = jnp.sum((self.shift * self.shift) * v_orig) - (
+                self.shift[intercept_index] ** 2
+            ) * v_orig[intercept_index]
+            v_orig = v_orig.at[intercept_index].add(extra)
+        return v_orig
+
 
 def build_normalization_context(
     norm_type: NormalizationType,

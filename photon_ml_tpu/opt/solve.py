@@ -30,14 +30,24 @@ def solve(
     branch, resolved at trace time); the regularization WEIGHTS are traced.
 
     l2_weight / l1_weight default to the values implied by the configuration
-    but may be overridden with traced scalars (warm-started λ sweeps).
+    but may be overridden (warm-started λ sweeps). An explicit ``l1_weight``
+    is authoritative: a concrete 0 / 0.0 disables OWL-QN even if the
+    configuration's own regularization_weight implies L1; a traced scalar
+    selects OWL-QN (the choice must be static under jit).
     """
     cfg = configuration.optimizer_config
     l2 = jnp.asarray(configuration.l2_weight if l2_weight is None else l2_weight, dtype=w0.dtype)
-    l1_static = configuration.l1_weight
-    use_owlqn = (l1_weight is not None) or l1_static > 0
+    if l1_weight is None:
+        use_owlqn = configuration.l1_weight > 0
+        l1_value = configuration.l1_weight
+    elif isinstance(l1_weight, (int, float)) and float(l1_weight) == 0.0:
+        use_owlqn = False
+        l1_value = 0.0
+    else:
+        use_owlqn = True
+        l1_value = l1_weight
     if use_owlqn:
-        l1 = jnp.asarray(l1_static if l1_weight is None else l1_weight, dtype=w0.dtype)
+        l1 = jnp.asarray(l1_value, dtype=w0.dtype)
         if cfg.optimizer is OptimizerType.TRON:
             raise ValueError("TRON does not support L1 regularization (use LBFGS/OWL-QN)")
         return owlqn_solve(objective, w0, data, l2, l1, cfg)

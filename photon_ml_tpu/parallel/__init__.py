@@ -1,0 +1,13 @@
+from photon_ml_tpu.parallel.mesh import (
+    data_parallel_mesh,
+    pad_batch_to_multiple,
+    replicate,
+    shard_batch,
+)
+
+__all__ = [
+    "data_parallel_mesh",
+    "pad_batch_to_multiple",
+    "replicate",
+    "shard_batch",
+]

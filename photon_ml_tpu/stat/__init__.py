@@ -1,0 +1,3 @@
+from photon_ml_tpu.stat.summary import BasicStatisticalSummary, summarize
+
+__all__ = ["BasicStatisticalSummary", "summarize"]
