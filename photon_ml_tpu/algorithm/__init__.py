@@ -1,0 +1,17 @@
+from photon_ml_tpu.algorithm.coordinate import (
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.algorithm.coordinate_descent import (
+    CoordinateDescent,
+    CoordinateDescentResult,
+)
+
+__all__ = [
+    "Coordinate",
+    "FixedEffectCoordinate",
+    "RandomEffectCoordinate",
+    "CoordinateDescent",
+    "CoordinateDescentResult",
+]

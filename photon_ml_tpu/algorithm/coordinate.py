@@ -1,0 +1,119 @@
+"""Coordinates: the per-block training units of GAME coordinate descent.
+
+Reference parity: algorithm/Coordinate.scala:27 (updateModel with residual
+offsets :59-62 — ``dataSet.addScoresToOffsets(score)`` then optimize the
+coordinate alone), FixedEffectCoordinate.scala:34 (whole-data GLM solve;
+score :159-166) and RandomEffectCoordinate.scala:39 (per-entity local solves;
+active+passive scoring :157-187).
+
+A coordinate owns its (device-resident) dataset and knows how to (a) train
+its model given residual offsets from all other coordinates, and (b) produce
+raw per-row scores aligned with the global row order.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.random_effect import RandomEffectDataset
+from photon_ml_tpu.estimators.model_training import train_glm
+from photon_ml_tpu.estimators.random_effect import (
+    score_random_effects,
+    train_random_effects,
+)
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.ops.data import LabeledData
+from photon_ml_tpu.opt.config import GlmOptimizationConfiguration
+from photon_ml_tpu.types import TaskType
+
+
+class Coordinate(abc.ABC):
+    """One block of the GAME model (reference Coordinate.scala:27)."""
+
+    @abc.abstractmethod
+    def update_model(self, model, residual_scores: np.ndarray):
+        """Train this coordinate against residual scores from the others
+        (the offsets trick, Coordinate.scala:59-62). model may be None
+        (first pass) or the previous model (warm start)."""
+
+    @abc.abstractmethod
+    def score(self, model) -> np.ndarray:
+        """Raw scores x.w per row of THIS coordinate's training data,
+        aligned to global row order, zeros for rows it does not cover."""
+
+
+@dataclasses.dataclass
+class FixedEffectCoordinate(Coordinate):
+    """Global GLM over one feature shard (reference
+    FixedEffectCoordinate.scala:34). ``data`` carries the GAME-level base
+    offsets; residual scores are added on top per update."""
+
+    data: LabeledData
+    task: TaskType
+    configuration: GlmOptimizationConfiguration
+    down_sampling_seed: int = 0
+
+    def update_model(
+        self, model: Optional[GeneralizedLinearModel], residual_scores: np.ndarray
+    ) -> GeneralizedLinearModel:
+        data = self.data.replace(
+            offsets=self.data.offsets + jnp.asarray(residual_scores)
+        )
+        rate = self.configuration.down_sampling_rate
+        if rate < 1.0:
+            # DownSampler (reference BinaryClassificationDownSampler /
+            # DefaultDownSampler): sample rows by zeroing weights and
+            # rescaling survivors so the objective stays unbiased.
+            rng = np.random.default_rng(self.down_sampling_seed)
+            n = data.num_rows
+            if self.task is TaskType.LOGISTIC_REGRESSION:
+                neg = np.asarray(data.labels) <= 0.5
+                keep = rng.random(n) < rate
+                keep = np.where(neg, keep, True)
+                scale = np.where(neg, 1.0 / rate, 1.0)
+            else:
+                keep = rng.random(n) < rate
+                scale = np.full(n, 1.0 / rate)
+            w = np.asarray(data.weights) * keep * scale
+            data = data.replace(weights=jnp.asarray(w.astype(np.float32)))
+        fit = train_glm(
+            data,
+            self.task,
+            self.configuration,
+            initial_model=model,
+        )[0]
+        return fit.model
+
+    def score(self, model: GeneralizedLinearModel) -> np.ndarray:
+        return np.asarray(model.compute_score(self.data.features))
+
+
+@dataclasses.dataclass
+class RandomEffectCoordinate(Coordinate):
+    """Per-entity GLMs over one feature shard (reference
+    RandomEffectCoordinate.scala:39). Residual offsets are re-grouped into
+    the entity blocks on each update."""
+
+    dataset: RandomEffectDataset
+    task: TaskType
+    configuration: GlmOptimizationConfiguration
+    base_offsets: np.ndarray  # GAME-level offsets, original row order
+
+    def update_model(
+        self, model: Optional[RandomEffectModel], residual_scores: np.ndarray
+    ) -> RandomEffectModel:
+        ds = self.dataset.update_offsets(self.base_offsets + residual_scores)
+        new_model, _ = train_random_effects(
+            ds, self.task, self.configuration, initial_model=model
+        )
+        return new_model
+
+    def score(self, model: RandomEffectModel) -> np.ndarray:
+        return score_random_effects(model, self.dataset)

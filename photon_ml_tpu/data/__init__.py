@@ -1,0 +1,13 @@
+from photon_ml_tpu.data.random_effect import (
+    RandomEffectDataConfiguration,
+    RandomEffectDataset,
+    ReBucket,
+    build_random_effect_dataset,
+)
+
+__all__ = [
+    "RandomEffectDataConfiguration",
+    "RandomEffectDataset",
+    "ReBucket",
+    "build_random_effect_dataset",
+]

@@ -1,0 +1,73 @@
+"""GAME training data: per-row responses + feature shards + id tags.
+
+Reference parity: data/GameDatum.scala:38 (response/offset/weight, a
+featureShardContainer, and idTagToValueMap naming the entity each row belongs
+to for every random-effect type) and data/GameConverters.scala:29 (DataFrame
+row -> GameDatum). Struct-of-arrays instead of an RDD of per-row objects:
+one numpy column per field, features kept as COO per shard so both the
+fixed-effect ELL layout and the random-effect grouped blocks can be built
+from the same source without re-reading input.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class FeatureShard:
+    """One feature bag/shard in COO form over its own feature space
+    (reference "feature shards" merged from feature bags,
+    AvroDataReader.scala:84-145)."""
+
+    rows: np.ndarray
+    cols: np.ndarray
+    vals: np.ndarray
+    dim: int
+
+    def slice_rows(self, row_mask: np.ndarray) -> "FeatureShard":
+        """Subset to rows where mask is True, renumbering rows densely."""
+        keep = row_mask[self.rows]
+        new_index = np.cumsum(row_mask) - 1
+        return FeatureShard(
+            rows=new_index[self.rows[keep]],
+            cols=self.cols[keep],
+            vals=self.vals[keep],
+            dim=self.dim,
+        )
+
+
+@dataclasses.dataclass
+class GameData:
+    """All rows of a GAME train/validation set (host container; device
+    arrays are built per-coordinate)."""
+
+    labels: np.ndarray                      # [n]
+    feature_shards: Dict[str, FeatureShard]
+    id_tags: Dict[str, np.ndarray]          # re_type -> per-row entity id (str)
+    offsets: Optional[np.ndarray] = None    # [n]
+    weights: Optional[np.ndarray] = None    # [n]
+
+    def __post_init__(self) -> None:
+        n = len(self.labels)
+        self.labels = np.asarray(self.labels, dtype=np.float32)
+        self.offsets = (
+            np.zeros(n, dtype=np.float32)
+            if self.offsets is None
+            else np.asarray(self.offsets, dtype=np.float32)
+        )
+        self.weights = (
+            np.ones(n, dtype=np.float32)
+            if self.weights is None
+            else np.asarray(self.weights, dtype=np.float32)
+        )
+        for t, ids in self.id_tags.items():
+            if len(ids) != n:
+                raise ValueError(f"id tag {t} has {len(ids)} rows, expected {n}")
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.labels)

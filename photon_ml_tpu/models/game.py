@@ -1,0 +1,114 @@
+"""GAME model: named sub-models summed into one score.
+
+Reference parity: model/GameModel.scala:32 (map coordinateId -> sub-model,
+``score`` sums sub-model scores, task-consistency check :163) and
+model/{FixedEffectModel,RandomEffectModel}.scala scoring semantics: a
+fixed-effect model scores every row; a random-effect model scores rows whose
+entity it has seen (others contribute 0 — the reference's left join default).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameData
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.ops.features import from_scipy_like
+from photon_ml_tpu.types import TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateMeta:
+    """What a coordinate consumes: which feature shard, and (for random
+    effects) which id tag names its entity."""
+
+    feature_shard: str
+    random_effect_type: Optional[str] = None
+
+
+SubModel = Union[GeneralizedLinearModel, RandomEffectModel]
+
+
+@dataclasses.dataclass
+class GameModel:
+    models: Dict[str, SubModel]
+    meta: Dict[str, CoordinateMeta]
+    task: TaskType
+
+    def __post_init__(self) -> None:
+        for cid in self.models:
+            if cid not in self.meta:
+                raise ValueError(f"coordinate {cid} missing metadata")
+
+    def score_coordinate(self, cid: str, data: GameData) -> np.ndarray:
+        """Raw scores of one sub-model over arbitrary GameData rows."""
+        model = self.models[cid]
+        m = self.meta[cid]
+        shard = data.feature_shards[m.feature_shard]
+        if isinstance(model, GeneralizedLinearModel):
+            feats = from_scipy_like(
+                shard.rows, shard.cols, shard.vals, (data.num_rows, shard.dim)
+            )
+            return np.asarray(model.compute_score(feats))
+        assert m.random_effect_type is not None
+        entity_ids = data.id_tags[m.random_effect_type]
+        return _score_re_rows(model, shard, entity_ids, data.num_rows)
+
+    def score(self, data: GameData) -> np.ndarray:
+        """Sum of sub-model scores per row (no offsets; reference
+        GameModel.score). Evaluation adds data.offsets on top."""
+        total = np.zeros(data.num_rows, dtype=np.float32)
+        for cid in self.models:
+            total += self.score_coordinate(cid, data)
+        return total
+
+
+def _score_re_rows(
+    model: RandomEffectModel, shard, entity_ids, num_rows: int
+) -> np.ndarray:
+    """Vectorized scoring of arbitrary rows against per-entity local models.
+
+    Per nonzero (r, c, v): find c in the entity's sorted local feature list
+    (batched searchsorted via boolean-sum) and accumulate v * w_local. Rows
+    whose entity is unseen score 0 (reference RandomEffectModel left join).
+    Features outside the entity's projected space are dropped (reference
+    index-map projection semantics).
+    """
+    out = np.zeros(num_rows, dtype=np.float32)
+    if len(shard.rows) == 0:
+        return out
+    locs = [model.entity_to_loc.get(str(e)) for e in entity_ids]
+    bucket_of_row = np.array([l[0] if l is not None else -1 for l in locs], dtype=np.int64)
+    erow_of_row = np.array([l[1] if l is not None else 0 for l in locs], dtype=np.int64)
+
+    rows = np.asarray(shard.rows, dtype=np.int64)
+    cols = np.asarray(shard.cols, dtype=np.int64)
+    vals = np.asarray(shard.vals, dtype=np.float32)
+    nz_bucket = bucket_of_row[rows]
+
+    for b in range(len(model.coefficients)):
+        sel = nz_bucket == b
+        if not sel.any():
+            continue
+        r = rows[sel]
+        c = cols[sel]
+        v = vals[sel]
+        e = erow_of_row[r]
+        pidx = np.asarray(model.proj_indices[b])   # [Eb, Db], valid prefix sorted
+        pval = np.asarray(model.proj_valid[b])
+        w = np.asarray(model.coefficients[b])
+        Db = pidx.shape[1]
+        pe = pidx[e]          # [nnz, Db]
+        ve = pval[e]
+        j = ((pe < c[:, None]) & ve).sum(axis=1)
+        j_clip = np.minimum(j, Db - 1)
+        match = (j < Db) & ve[np.arange(len(j)), j_clip] & (
+            pe[np.arange(len(j)), j_clip] == c
+        )
+        contrib = np.where(match, v * w[e, j_clip], 0.0)
+        np.add.at(out, r, contrib.astype(np.float32))
+    return out

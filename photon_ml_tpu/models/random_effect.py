@@ -1,0 +1,65 @@
+"""Random-effect model: one small GLM per entity, stored as padded blocks.
+
+Reference parity: model/RandomEffectModel.scala:38 — an RDD[(REId, GLM)]
+scored via join by entity id — and RandomEffectModelInProjectedSpace (models
+live in per-entity projected space and are projected back for export). Here
+the per-bucket coefficient blocks [E, D_local] mirror the dataset layout;
+scoring is an einsum against the matching bucket, and export materializes
+per-entity sparse global-space coefficient maps through proj_indices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.types import TaskType
+
+
+@dataclasses.dataclass
+class RandomEffectModel:
+    """Per-bucket local-space coefficients, parallel to a
+    RandomEffectDataset's buckets."""
+
+    random_effect_type: str
+    task: TaskType
+    coefficients: List[jax.Array]            # per bucket [E_b, D_b]
+    variances: List[Optional[jax.Array]]     # per bucket [E_b, D_b] or None
+    proj_indices: List[jax.Array]            # per bucket [E_b, D_b] int32
+    proj_valid: List[jax.Array]              # per bucket [E_b, D_b] bool
+    entity_ids: List[List[str]]
+    entity_to_loc: Dict[str, Tuple[int, int]]
+    global_dim: int
+
+    @property
+    def num_entities(self) -> int:
+        return sum(len(ids) for ids in self.entity_ids)
+
+    def coefficients_for(self, entity_id: str) -> Optional[Dict[int, float]]:
+        """Global-space sparse coefficients {feature_index: value} for one
+        entity (host-side; model export / serving by id)."""
+        loc = self.entity_to_loc.get(str(entity_id))
+        if loc is None:
+            return None
+        b, e = loc
+        w = np.asarray(self.coefficients[b][e])
+        idx = np.asarray(self.proj_indices[b][e])
+        valid = np.asarray(self.proj_valid[b][e])
+        return {int(i): float(v) for i, v, ok in zip(idx, w, valid) if ok}
+
+    def items(self) -> Iterator[Tuple[str, Dict[int, float]]]:
+        """Iterate (entity_id, sparse global coefficients) — export order."""
+        for b, ids in enumerate(self.entity_ids):
+            w_b = np.asarray(self.coefficients[b])
+            idx_b = np.asarray(self.proj_indices[b])
+            val_b = np.asarray(self.proj_valid[b])
+            for e, eid in enumerate(ids):
+                yield eid, {
+                    int(i): float(v)
+                    for i, v, ok in zip(idx_b[e], w_b[e], val_b[e])
+                    if ok
+                }

@@ -22,7 +22,12 @@ import jax.numpy as jnp
 from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import OptimizerConfig
 from photon_ml_tpu.opt.linesearch import strong_wolfe_search
-from photon_ml_tpu.opt.state import SolveResult, absolute_tolerances
+from photon_ml_tpu.opt.state import (
+    SolveResult,
+    absolute_tolerances,
+    function_values_converged,
+    gradient_converged,
+)
 from photon_ml_tpu.types import ConvergenceReason
 
 
@@ -35,7 +40,6 @@ class _LbfgsState(NamedTuple):
     rho: jax.Array        # [m] 1/(s.y)
     count: jax.Array      # int32 number of valid history pairs
     it: jax.Array         # int32 outer iteration
-    f_prev: jax.Array
     reason: jax.Array     # int32 ConvergenceReason
     history: jax.Array    # [max_iter+1] objective values
 
@@ -118,7 +122,6 @@ def lbfgs_solve(
         rho=jnp.zeros((m,), dtype=dtype),
         count=jnp.int32(0),
         it=jnp.int32(0),
-        f_prev=jnp.inf,
         reason=jnp.int32(ConvergenceReason.NOT_CONVERGED.value),
         history=history0,
     )
@@ -172,19 +175,20 @@ def lbfgs_solve(
         it = s.it + 1
         # Convergence checks (reference Optimizer.scala:131-145). A failed
         # line search that produced no movement terminates with
-        # OBJECTIVE_NOT_IMPROVING.
+        # OBJECTIVE_NOT_IMPROVING — f_conv is gated on success so a stalled
+        # search is never misreported as converged.
         no_step = (~ls.success) | (ls.t <= 0)
-        f_conv = jnp.abs(s.f - f_new) <= abs_f_tol
-        g_conv = jnp.linalg.norm(g_new) <= abs_g_tol
+        f_conv = ls.success & function_values_converged(s.f, f_new, abs_f_tol)
+        g_conv = gradient_converged(jnp.linalg.norm(g_new), abs_g_tol)
         reason = jnp.where(
             g_conv,
             ConvergenceReason.GRADIENT_CONVERGED.value,
             jnp.where(
-                f_conv,
-                ConvergenceReason.FUNCTION_VALUES_CONVERGED.value,
+                no_step,
+                ConvergenceReason.OBJECTIVE_NOT_IMPROVING.value,
                 jnp.where(
-                    no_step,
-                    ConvergenceReason.OBJECTIVE_NOT_IMPROVING.value,
+                    f_conv,
+                    ConvergenceReason.FUNCTION_VALUES_CONVERGED.value,
                     jnp.where(
                         it >= max_iter,
                         ConvergenceReason.MAX_ITERATIONS.value,
@@ -203,7 +207,6 @@ def lbfgs_solve(
             rho=rho,
             count=count,
             it=it,
-            f_prev=s.f,
             reason=reason,
             history=s.history.at[it].set(f_new),
         )

@@ -26,7 +26,12 @@ import jax.numpy as jnp
 from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import OptimizerConfig
 from photon_ml_tpu.opt.lbfgs import two_loop_direction
-from photon_ml_tpu.opt.state import SolveResult, absolute_tolerances
+from photon_ml_tpu.opt.state import (
+    SolveResult,
+    absolute_tolerances,
+    function_values_converged,
+    gradient_converged,
+)
 from photon_ml_tpu.types import ConvergenceReason
 
 
@@ -109,7 +114,6 @@ def owlqn_solve(
         d = jnp.where(d * pg < 0, d, 0.0)
         # orthant to search in: sign(w), or sign(-pg) where w = 0
         xi = jnp.where(s.w != 0, jnp.sign(s.w), jnp.sign(-pg))
-        dirderiv = jnp.dot(pg, d)  # negative if descent
 
         t0 = jnp.where(s.count == 0, 1.0 / jnp.maximum(jnp.linalg.norm(d), 1e-12), 1.0)
 
@@ -170,8 +174,8 @@ def owlqn_solve(
 
         it = s.it + 1
         pg_new = pseudo_gradient(w_new, g_new, l1)
-        g_conv = jnp.linalg.norm(pg_new) <= abs_g_tol
-        f_conv = ls.ok & (jnp.abs(s.F - F_new) <= abs_f_tol)
+        g_conv = gradient_converged(jnp.linalg.norm(pg_new), abs_g_tol)
+        f_conv = ls.ok & function_values_converged(s.F, F_new, abs_f_tol)
         no_step = ~ls.ok
         reason = jnp.where(
             g_conv,

@@ -9,6 +9,7 @@ first-order-only objectives. ``l2_weight``/``l1_weight`` are traced scalars so
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import GlmOptimizationConfiguration, OptimizerType
@@ -40,7 +41,7 @@ def solve(
     if l1_weight is None:
         use_owlqn = configuration.l1_weight > 0
         l1_value = configuration.l1_weight
-    elif isinstance(l1_weight, (int, float)) and float(l1_weight) == 0.0:
+    elif isinstance(l1_weight, (int, float, np.floating, np.integer)) and float(l1_weight) == 0.0:
         use_owlqn = False
         l1_value = 0.0
     else:

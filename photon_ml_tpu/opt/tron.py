@@ -21,7 +21,13 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import OptimizerConfig
-from photon_ml_tpu.opt.state import SolveResult, absolute_tolerances
+from photon_ml_tpu.opt.lbfgs import _project_box
+from photon_ml_tpu.opt.state import (
+    SolveResult,
+    absolute_tolerances,
+    function_values_converged,
+    gradient_converged,
+)
 from photon_ml_tpu.types import ConvergenceReason
 
 # Trust-region update constants (reference TRON.scala:97-98 / LIBLINEAR).
@@ -150,12 +156,7 @@ def tron_solve(
         )
         w_try = s.w + step
         if config.constraint_lower is not None or config.constraint_upper is not None:
-            lo = config.constraint_lower
-            hi = config.constraint_upper
-            if lo is not None:
-                w_try = jnp.maximum(w_try, lo)
-            if hi is not None:
-                w_try = jnp.minimum(w_try, hi)
+            w_try = _project_box(w_try, config.constraint_lower, config.constraint_upper)
             step = w_try - s.w
         f_try, g_try = objective.value_and_grad(w_try, data, l2_weight)
 
@@ -192,8 +193,8 @@ def tron_solve(
         g_new = jnp.where(accept, g_try, s.g)
 
         it = s.it + 1
-        g_conv = jnp.linalg.norm(g_new) <= abs_g_tol
-        f_conv = accept & (jnp.abs(actred) <= abs_f_tol)
+        g_conv = gradient_converged(jnp.linalg.norm(g_new), abs_g_tol)
+        f_conv = accept & function_values_converged(s.f, f_new, abs_f_tol)
         too_many_failures = failures >= config.max_improvement_failures
         degenerate = (prered <= 0) & (actred <= 0)
         reason = jnp.where(

@@ -1,0 +1,94 @@
+"""Evaluator tests: AUC vs brute-force pair counting (with ties and weights),
+losses, grouped metrics, precision@k, better_than direction."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation import (
+    AUC,
+    RMSE,
+    MultiEvaluator,
+    PrecisionAtK,
+    area_under_roc_curve,
+)
+
+
+def _auc_brute(scores, labels, weights=None):
+    w = np.ones_like(scores) if weights is None else weights
+    pos = labels > 0.5
+    num = den = 0.0
+    for i in np.where(pos)[0]:
+        for j in np.where(~pos)[0]:
+            pair_w = w[i] * w[j]
+            den += pair_w
+            if scores[i] > scores[j]:
+                num += pair_w
+            elif scores[i] == scores[j]:
+                num += 0.5 * pair_w
+    return num / den
+
+
+def test_auc_matches_brute_force(rng):
+    scores = rng.normal(size=60).astype(np.float32)
+    labels = (rng.random(60) > 0.4).astype(np.float32)
+    np.testing.assert_allclose(
+        AUC.evaluate(scores, labels), _auc_brute(scores, labels), rtol=1e-5
+    )
+
+
+def test_auc_with_ties_and_weights(rng):
+    scores = np.round(rng.normal(size=80), 1).astype(np.float32)  # many ties
+    labels = (rng.random(80) > 0.5).astype(np.float32)
+    weights = (rng.random(80) * 2 + 0.1).astype(np.float32)
+    np.testing.assert_allclose(
+        AUC.evaluate(scores, labels, weights),
+        _auc_brute(scores, labels, weights),
+        rtol=1e-4,
+    )
+
+
+def test_auc_perfect_and_degenerate():
+    assert AUC.evaluate([0.1, 0.2, 0.8, 0.9], [0, 0, 1, 1]) == pytest.approx(1.0)
+    assert AUC.evaluate([0.9, 0.8, 0.2, 0.1], [0, 0, 1, 1]) == pytest.approx(0.0)
+    assert np.isnan(AUC.evaluate([0.1, 0.2], [1, 1]))  # one class
+
+
+def test_rmse_weighted():
+    s = np.array([1.0, 3.0], dtype=np.float32)
+    y = np.array([0.0, 0.0], dtype=np.float32)
+    w = np.array([3.0, 1.0], dtype=np.float32)
+    # weighted mse = (3*1 + 1*9)/4 = 3
+    np.testing.assert_allclose(RMSE.evaluate(s, y, w), np.sqrt(3.0), rtol=1e-6)
+
+
+def test_better_than_direction_and_nan():
+    assert AUC.better_than(0.8, 0.7)
+    assert not AUC.better_than(0.6, 0.7)
+    assert RMSE.better_than(1.0, 2.0)
+    assert AUC.better_than(0.5, float("nan"))
+    assert not AUC.better_than(float("nan"), 0.5)
+
+
+def test_precision_at_k():
+    scores = np.array([0.9, 0.8, 0.7, 0.1], dtype=np.float32)
+    labels = np.array([1, 0, 1, 1], dtype=np.float32)
+    assert PrecisionAtK(2).evaluate(scores, labels) == pytest.approx(0.5)
+    assert PrecisionAtK(3).evaluate(scores, labels) == pytest.approx(2 / 3)
+
+
+def test_multi_evaluator_grouped_auc(rng):
+    n = 120
+    groups = rng.integers(0, 4, size=n)
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = (rng.random(n) > 0.5).astype(np.float32)
+    # make group 3 single-class -> skipped
+    labels[groups == 3] = 1.0
+    ev = MultiEvaluator(base=AUC, group_ids=tuple(groups.tolist()))
+    got = ev.evaluate(scores, labels)
+    expected = np.mean(
+        [
+            _auc_brute(scores[groups == g], labels[groups == g])
+            for g in range(3)
+        ]
+    )
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
