@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu.algorithm.coordinate import Coordinate
+from photon_ml_tpu.evaluation.evaluators import nan_aware_better_than
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -42,7 +43,7 @@ class CoordinateDescent:
         update_order: Optional[Sequence[str]] = None,
         training_objective: Optional[Callable[[np.ndarray], float]] = None,
         validate: Optional[Callable[[Dict[str, object]], float]] = None,
-        validation_larger_is_better: bool = True,
+        validation_better_than: Optional[Callable[[float, float], bool]] = None,
     ) -> None:
         if not coordinates:
             raise ValueError("need at least one coordinate")
@@ -54,7 +55,9 @@ class CoordinateDescent:
             raise ValueError(f"unknown coordinates in update order: {unknown}")
         self.training_objective = training_objective
         self.validate = validate
-        self.validation_larger_is_better = validation_larger_is_better
+        # Evaluator.better_than semantics (larger/smaller-is-better + NaN
+        # policy) come from the evaluator itself; default: larger is better.
+        self.validation_better_than = validation_better_than or nan_aware_better_than
 
     def run(
         self,
@@ -104,15 +107,9 @@ class CoordinateDescent:
                     logger.info(
                         "CD iter %d coordinate %s: validation %.6f", outer, cid, metric
                     )
-                    improved = (
-                        best_metric is None
-                        or (metric == metric and (
-                            metric > best_metric
-                            if self.validation_larger_is_better
-                            else metric < best_metric
-                        ))
-                    )
-                    if improved:
+                    if best_metric is None or self.validation_better_than(
+                        metric, best_metric
+                    ):
                         best_metric = metric
                         best_models = dict(models)
 

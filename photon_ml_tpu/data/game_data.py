@@ -71,3 +71,19 @@ class GameData:
     @property
     def num_rows(self) -> int:
         return len(self.labels)
+
+    def ell_features(self, shard_name: str):
+        """Device ELL layout of one shard, built once and cached (validation
+        re-scores the same data after every coordinate update)."""
+        cache = getattr(self, "_ell_cache", None)
+        if cache is None:
+            cache = {}
+            self._ell_cache = cache
+        if shard_name not in cache:
+            from photon_ml_tpu.ops.features import from_scipy_like
+
+            shard = self.feature_shards[shard_name]
+            cache[shard_name] = from_scipy_like(
+                shard.rows, shard.cols, shard.vals, (self.num_rows, shard.dim)
+            )
+        return cache[shard_name]

@@ -80,10 +80,11 @@ class ReBucket:
 
 @struct.dataclass
 class RePassiveRows:
-    """Passive (score-only) rows of one bucket, local-projected."""
+    """Passive (score-only) rows of one bucket, local-projected. Offsets are
+    not stored: passive scoring is the raw x.w gather; score algebra composes
+    offsets at the coordinate level."""
 
     X: jax.Array            # [P, D]
-    offsets: jax.Array      # [P]
     entity_index: jax.Array  # [P] int32 row into the bucket's entity axis
     sample_pos: jax.Array   # [P] int32 original row index
 
@@ -109,18 +110,43 @@ class RandomEffectDataset:
         (the residual trick: Coordinate.updateModel / addScoresToOffsets)."""
         offsets = np.asarray(offsets, dtype=np.float32)
         new_buckets = []
-        new_passive = []
-        for b, p in zip(self.buckets, self.passive):
+        for b in self.buckets:
             pos = np.asarray(b.sample_pos)
             wt = np.asarray(b.weights)
             off = np.where(wt > 0, offsets[pos], 0.0).astype(np.float32)
             new_buckets.append(b.replace(offsets=jnp.asarray(off)))
-            if p is not None:
-                ppos = np.asarray(p.sample_pos)
-                new_passive.append(p.replace(offsets=jnp.asarray(offsets[ppos])))
-            else:
-                new_passive.append(None)
-        return dataclasses.replace(self, buckets=new_buckets, passive=new_passive)
+        return dataclasses.replace(self, buckets=new_buckets)
+
+
+def _expand_nnz(
+    act_rows: np.ndarray, row_start: np.ndarray, row_end: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten the CSR slices of ``act_rows`` into (sample_index, flat_index)
+    pairs: sample_index points back into act_rows, flat_index into fc/fv."""
+    cnt = row_end[act_rows] - row_start[act_rows]
+    total = int(cnt.sum())
+    rep = np.repeat(np.arange(len(act_rows), dtype=np.int64), cnt)
+    within = np.arange(total, dtype=np.int64) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+    return rep, row_start[act_rows][rep] + within
+
+
+def _local_dense(
+    act_rows: np.ndarray,
+    local_cols: np.ndarray,
+    row_start: np.ndarray,
+    row_end: np.ndarray,
+    fc: np.ndarray,
+    fv: np.ndarray,
+    out: np.ndarray,
+) -> None:
+    """Scatter the rows' features into ``out[sample, local_col]`` (features
+    outside local_cols are dropped — index-map projection semantics)."""
+    rep, fidx = _expand_nnz(act_rows, row_start, row_end)
+    c, v = fc[fidx], fv[fidx]
+    j = np.searchsorted(local_cols, c)
+    j_clip = np.minimum(j, max(len(local_cols) - 1, 0))
+    match = (j < len(local_cols)) & (local_cols[j_clip] == c) if len(local_cols) else np.zeros(len(c), dtype=bool)
+    out[rep[match], j_clip[match]] = v[match]
 
 
 def _pearson_scores(x: np.ndarray, y: np.ndarray, w: np.ndarray) -> np.ndarray:
@@ -212,11 +238,8 @@ def build_random_effect_dataset(
             d_cap = min(d_cap, config.max_local_features) if d_cap is not None else config.max_local_features
         if d_cap is not None and len(local_cols) > d_cap:
             # rank by |Pearson| on a small dense local matrix
-            col_pos = {c: i for i, c in enumerate(local_cols)}
             xm = np.zeros((len(active_rows), len(local_cols)), dtype=np.float32)
-            for i, r in enumerate(active_rows):
-                sl = slice(row_start[r], row_end[r])
-                xm[i, [col_pos[c] for c in fc[sl]]] = fv[sl]
+            _local_dense(active_rows, local_cols, row_start, row_end, fc, fv, xm)
             scores = _pearson_scores(xm, labels[active_rows], weights[active_rows])
             top = np.argsort(-scores, kind="stable")[:d_cap]
             local_cols = np.sort(local_cols[top])
@@ -250,36 +273,73 @@ def build_random_effect_dataset(
         pidx = np.zeros((E, D), dtype=np.int32)
         pval = np.zeros((E, D), dtype=bool)
         ids_b: List[str] = []
-        pX, poff, pent, ppos = [], [], [], []
 
-        for e, (eid, active_rows, passive_rows, local_cols) in enumerate(members):
+        dlocs = np.array([len(lc) for (_, _, _, lc) in members], dtype=np.int64)
+        for e, (eid, _, _, local_cols) in enumerate(members):
             ids_b.append(str(eid))
             entity_to_loc[str(eid)] = (bi, e)
-            dloc = len(local_cols)
-            pidx[e, :dloc] = local_cols
-            pval[e, :dloc] = True
-            col_pos = {c: i for i, c in enumerate(local_cols)}
-            for s_i, r in enumerate(active_rows):
-                sl = slice(row_start[r], row_end[r])
-                for c, v in zip(fc[sl], fv[sl]):
-                    j = col_pos.get(c)
-                    if j is not None:
-                        X[e, s_i, j] = v
-                lab[e, s_i] = labels[r]
-                off[e, s_i] = offsets[r]
-                wt[e, s_i] = weights[r]
-                pos[e, s_i] = r
-            for r in passive_rows:
-                xr = np.zeros(D, dtype=np.float32)
-                sl = slice(row_start[r], row_end[r])
-                for c, v in zip(fc[sl], fv[sl]):
-                    j = col_pos.get(c)
-                    if j is not None:
-                        xr[j] = v
-                pX.append(xr)
-                poff.append(offsets[r])
-                pent.append(e)
-                ppos.append(r)
+            pidx[e, : len(local_cols)] = local_cols
+            pval[e, : len(local_cols)] = True
+
+        # Flat key space entity*(G+1)+col is globally sorted (entities ascend,
+        # each local_cols list is sorted), so ONE searchsorted resolves every
+        # nonzero's local column — no per-sample Python loops.
+        G1 = global_dim + 1
+        flat_cols = (
+            np.concatenate([lc for (_, _, _, lc) in members])
+            if dlocs.sum()
+            else np.empty(0, dtype=np.int64)
+        )
+        flat_keys = np.repeat(np.arange(E, dtype=np.int64), dlocs) * G1 + flat_cols
+        dstart = np.concatenate([[0], np.cumsum(dlocs)[:-1]])
+
+        def local_scatter(rows_g: np.ndarray, e_of: np.ndarray, fill) -> None:
+            """Resolve (row, global col, val) triplets of ``rows_g`` to
+            (sample index into rows_g, local col, val); dropped features
+            (outside the entity's projected space) are skipped."""
+            rep, fidx = _expand_nnz(rows_g, row_start, row_end)
+            c, v = fc[fidx], fv[fidx]
+            qk = e_of[rep] * G1 + c
+            ii = np.searchsorted(flat_keys, qk)
+            ii_c = np.minimum(ii, max(len(flat_keys) - 1, 0))
+            match = (
+                (ii < len(flat_keys)) & (flat_keys[ii_c] == qk)
+                if len(flat_keys)
+                else np.zeros(len(qk), dtype=bool)
+            )
+            j = ii_c - dstart[e_of[rep]]
+            fill(rep[match], j[match], v[match])
+
+        alens = np.array([len(a) for (_, a, _, _) in members], dtype=np.int64)
+        act = (
+            np.concatenate([a for (_, a, _, _) in members])
+            if alens.sum()
+            else np.empty(0, dtype=np.int64)
+        )
+        e_act = np.repeat(np.arange(E, dtype=np.int64), alens)
+        s_act = (
+            np.concatenate([np.arange(l, dtype=np.int64) for l in alens])
+            if alens.sum()
+            else np.empty(0, dtype=np.int64)
+        )
+        lab[e_act, s_act] = labels[act]
+        off[e_act, s_act] = offsets[act]
+        wt[e_act, s_act] = weights[act]
+        pos[e_act, s_act] = act
+        local_scatter(
+            act, e_act, lambda k, j, v: X.__setitem__((e_act[k], s_act[k], j), v)
+        )
+
+        plens = np.array([len(p) for (_, _, p, _) in members], dtype=np.int64)
+        n_pas = int(plens.sum())
+        pas = (
+            np.concatenate([p for (_, _, p, _) in members])
+            if n_pas
+            else np.empty(0, dtype=np.int64)
+        )
+        e_pas = np.repeat(np.arange(E, dtype=np.int64), plens)
+        pX = np.zeros((n_pas, D), dtype=np.float32)
+        local_scatter(pas, e_pas, lambda k, j, v: pX.__setitem__((k, j), v))
 
         buckets.append(
             ReBucket(
@@ -294,12 +354,11 @@ def build_random_effect_dataset(
         )
         passives.append(
             RePassiveRows(
-                X=jnp.asarray(np.stack(pX)),
-                offsets=jnp.asarray(np.asarray(poff, dtype=np.float32)),
-                entity_index=jnp.asarray(np.asarray(pent, dtype=np.int32)),
-                sample_pos=jnp.asarray(np.asarray(ppos, dtype=np.int32)),
+                X=jnp.asarray(pX),
+                entity_index=jnp.asarray(e_pas.astype(np.int32)),
+                sample_pos=jnp.asarray(pas.astype(np.int32)),
             )
-            if pX
+            if n_pas
             else None
         )
         bucket_ids.append(ids_b)

@@ -37,7 +37,6 @@ from photon_ml_tpu.losses.pointwise import loss_for_task
 from photon_ml_tpu.models.game import CoordinateMeta, GameModel
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops.data import LabeledData
-from photon_ml_tpu.ops.features import from_scipy_like
 from photon_ml_tpu.opt.config import GlmOptimizationConfiguration
 from photon_ml_tpu.types import TaskType
 
@@ -56,12 +55,8 @@ class FixedEffectCoordinateConfiguration:
 @dataclasses.dataclass(frozen=True)
 class RandomEffectCoordinateConfiguration:
     feature_shard: str
-    data: RandomEffectDataConfiguration = None  # type: ignore[assignment]
+    data: RandomEffectDataConfiguration
     optimizer: GlmOptimizationConfiguration = GlmOptimizationConfiguration()
-
-    def __post_init__(self) -> None:
-        if self.data is None:
-            raise ValueError("RandomEffectCoordinateConfiguration requires data config")
 
 
 CoordinateConfiguration = Union[
@@ -99,11 +94,8 @@ class GameEstimator:
     ) -> Coordinate:
         shard = data.feature_shards[cfg.feature_shard]
         if isinstance(cfg, FixedEffectCoordinateConfiguration):
-            feats = from_scipy_like(
-                shard.rows, shard.cols, shard.vals, (data.num_rows, shard.dim)
-            )
             labeled = LabeledData.create(
-                feats,
+                data.ell_features(cfg.feature_shard),
                 jnp.asarray(data.labels),
                 offsets=jnp.asarray(data.offsets),
                 weights=jnp.asarray(data.weights),
@@ -177,7 +169,7 @@ class GameEstimator:
             update_order=self.update_order,
             training_objective=training_objective,
             validate=validate,
-            validation_larger_is_better=self.evaluator.larger_is_better,
+            validation_better_than=self.evaluator.better_than,
         )
         result = cd.run(self.num_outer_iterations)
         model = GameModel(models=result.best_models, meta=meta, task=self.task)

@@ -87,13 +87,59 @@ def _weighted_mean(terms: jax.Array, weights: jax.Array) -> jax.Array:
     )
 
 
+def _np_auc(s: np.ndarray, y: np.ndarray, w: np.ndarray) -> float:
+    """Numpy twin of area_under_roc_curve (identical tie/weight semantics)."""
+    pos_w = np.where(y > 0.5, w, 0.0)
+    neg_w = np.where(y > 0.5, 0.0, w)
+    order = np.argsort(s, kind="stable")
+    ss, pw, nw = s[order], pos_w[order], neg_w[order]
+    is_new = np.concatenate([[True], ss[1:] != ss[:-1]])
+    seg = np.cumsum(is_new) - 1
+    seg_neg = np.bincount(seg, weights=nw)
+    neg_below = np.cumsum(seg_neg)[seg] - seg_neg[seg]
+    u = float(np.sum(pw * (neg_below + 0.5 * seg_neg[seg])))
+    w_pos, w_neg = float(pw.sum()), float(nw.sum())
+    return u / (w_pos * w_neg) if w_pos > 0 and w_neg > 0 else float("nan")
+
+
+def _np_wmean(terms: np.ndarray, w: np.ndarray) -> float:
+    return float(np.sum(np.where(w > 0, w * terms, 0.0)) / max(np.sum(w), 1e-30))
+
+
+def _np_logistic(s, y, w):
+    return _np_wmean(np.logaddexp(0.0, s) - y * s, w)
+
+
+def _np_poisson(s, y, w):
+    return _np_wmean(np.exp(s) - y * s, w)
+
+
+def _np_smoothed_hinge(s, y, w):
+    u = np.where(y > 0.5, 1.0, -1.0) * s
+    terms = np.where(u >= 1, 0.0, np.where(u <= 0, 0.5 - u, 0.5 * (1 - u) ** 2))
+    return _np_wmean(terms, w)
+
+
+def nan_aware_better_than(a: float, b: float, larger_is_better: bool = True) -> bool:
+    """Is metric a better than b; any value beats NaN, NaN beats nothing
+    (reference Evaluator.betterThan semantics)."""
+    if b != b:
+        return True
+    if a != a:
+        return False
+    return a > b if larger_is_better else a < b
+
+
 @dataclasses.dataclass(frozen=True)
 class Evaluator:
-    """Metric with an ordering (is higher better?)."""
+    """Metric with an ordering (is higher better?). ``host_fn`` is a numpy
+    twin used for per-group evaluation, where calling the jit'd ``fn`` would
+    recompile for every distinct group size."""
 
     name: str
     fn: Callable  # (scores, labels, weights) -> scalar
     larger_is_better: bool
+    host_fn: Optional[Callable] = None
 
     def evaluate(self, scores, labels, weights=None) -> float:
         scores = jnp.asarray(scores)
@@ -101,46 +147,58 @@ class Evaluator:
         weights = jnp.ones_like(scores) if weights is None else jnp.asarray(weights)
         return float(self.fn(scores, labels, weights))
 
+    def evaluate_host(self, scores, labels, weights) -> float:
+        if self.host_fn is not None:
+            return float(self.host_fn(scores, labels, weights))
+        return self.evaluate(scores, labels, weights)
+
     def better_than(self, a: float, b: float) -> bool:
         """Is metric value a better than b (reference Evaluator.betterThan)."""
-        if b != b:  # b is NaN
-            return True
-        if a != a:
-            return False
-        return a > b if self.larger_is_better else a < b
+        return nan_aware_better_than(a, b, self.larger_is_better)
 
 
-AUC = Evaluator("AUC", area_under_roc_curve, larger_is_better=True)
+AUC = Evaluator("AUC", area_under_roc_curve, larger_is_better=True, host_fn=_np_auc)
 RMSE = Evaluator(
     "RMSE",
     jax.jit(lambda s, y, w: jnp.sqrt(_weighted_mean((s - y) ** 2, w))),
     larger_is_better=False,
+    host_fn=lambda s, y, w: np.sqrt(_np_wmean((s - y) ** 2, w)),
 )
 MSE = Evaluator(
-    "MSE", jax.jit(lambda s, y, w: _weighted_mean((s - y) ** 2, w)), larger_is_better=False
+    "MSE",
+    jax.jit(lambda s, y, w: _weighted_mean((s - y) ** 2, w)),
+    larger_is_better=False,
+    host_fn=lambda s, y, w: _np_wmean((s - y) ** 2, w),
 )
 MAE = Evaluator(
-    "MAE", jax.jit(lambda s, y, w: _weighted_mean(jnp.abs(s - y), w)), larger_is_better=False
+    "MAE",
+    jax.jit(lambda s, y, w: _weighted_mean(jnp.abs(s - y), w)),
+    larger_is_better=False,
+    host_fn=lambda s, y, w: _np_wmean(np.abs(s - y), w),
 )
 LogisticLossEvaluator = Evaluator(
     "LOGISTIC_LOSS",
     jax.jit(lambda s, y, w: _weighted_mean(LogisticLoss.value(s, y), w)),
     larger_is_better=False,
+    host_fn=_np_logistic,
 )
 PoissonLossEvaluator = Evaluator(
     "POISSON_LOSS",
     jax.jit(lambda s, y, w: _weighted_mean(PoissonLoss.value(s, y), w)),
     larger_is_better=False,
+    host_fn=_np_poisson,
 )
 SquaredLossEvaluator = Evaluator(
     "SQUARED_LOSS",
     jax.jit(lambda s, y, w: _weighted_mean(SquaredLoss.value(s, y), w)),
     larger_is_better=False,
+    host_fn=lambda s, y, w: _np_wmean(0.5 * (s - y) ** 2, w),
 )
 SmoothedHingeLossEvaluator = Evaluator(
     "SMOOTHED_HINGE_LOSS",
     jax.jit(lambda s, y, w: _weighted_mean(SmoothedHingeLoss.value(s, y), w)),
     larger_is_better=False,
+    host_fn=_np_smoothed_hinge,
 )
 
 
@@ -153,7 +211,12 @@ def PrecisionAtK(k: int) -> Evaluator:
         top = jnp.argsort(-scores)[:kk]
         return jnp.mean((labels[top] > 0.5).astype(jnp.float32))
 
-    return Evaluator(f"PRECISION@{k}", jax.jit(fn), larger_is_better=True)
+    def host_fn(scores, labels, weights):
+        kk = min(k, len(scores))
+        top = np.argsort(-scores, kind="stable")[:kk]
+        return float(np.mean(labels[top] > 0.5))
+
+    return Evaluator(f"PRECISION@{k}", jax.jit(fn), larger_is_better=True, host_fn=host_fn)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,10 +244,18 @@ class MultiEvaluator:
         labels = np.asarray(labels)
         weights = np.ones_like(scores) if weights is None else np.asarray(weights)
         gids = np.asarray(self.group_ids)
+        # one sort partitions all groups; per-group metric runs on the host
+        # numpy twin (the jit'd fn would recompile per distinct group size)
+        order = np.argsort(gids, kind="stable")
+        sorted_gids = gids[order]
+        starts = np.flatnonzero(
+            np.concatenate([[True], sorted_gids[1:] != sorted_gids[:-1]])
+        )
+        ends = np.append(starts[1:], len(gids))
         vals = []
-        for g in np.unique(gids):
-            m = gids == g
-            v = self.base.evaluate(scores[m], labels[m], weights[m])
+        for s, e in zip(starts, ends):
+            idx = order[s:e]
+            v = self.base.evaluate_host(scores[idx], labels[idx], weights[idx])
             if v == v:  # skip NaN groups
                 vals.append(v)
         return float(np.mean(vals)) if vals else float("nan")

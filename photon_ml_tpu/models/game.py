@@ -17,7 +17,6 @@ import numpy as np
 from photon_ml_tpu.data.game_data import GameData
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
-from photon_ml_tpu.ops.features import from_scipy_like
 from photon_ml_tpu.types import TaskType
 
 
@@ -50,10 +49,7 @@ class GameModel:
         m = self.meta[cid]
         shard = data.feature_shards[m.feature_shard]
         if isinstance(model, GeneralizedLinearModel):
-            feats = from_scipy_like(
-                shard.rows, shard.cols, shard.vals, (data.num_rows, shard.dim)
-            )
-            return np.asarray(model.compute_score(feats))
+            return np.asarray(model.compute_score(data.ell_features(m.feature_shard)))
         assert m.random_effect_type is not None
         entity_ids = data.id_tags[m.random_effect_type]
         return _score_re_rows(model, shard, entity_ids, data.num_rows)
