@@ -31,6 +31,12 @@ from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
 from photon_ml_tpu.ops.data import LabeledData
 from photon_ml_tpu.opt.config import GlmOptimizationConfiguration
+from photon_ml_tpu.opt.tracking import (
+    FixedEffectOptimizationTracker,
+    OptimizationStatesTracker,
+    RandomEffectOptimizationTracker,
+)
+from photon_ml_tpu.sampler import down_sampler_for
 from photon_ml_tpu.types import TaskType
 
 
@@ -59,6 +65,11 @@ class FixedEffectCoordinate(Coordinate):
     task: TaskType
     configuration: GlmOptimizationConfiguration
     down_sampling_seed: int = 0
+    # telemetry from the most recent update (reference
+    # FixedEffectOptimizationTracker.scala)
+    last_tracker: Optional[FixedEffectOptimizationTracker] = dataclasses.field(
+        default=None, repr=False
+    )
 
     def update_model(
         self, model: Optional[GeneralizedLinearModel], residual_scores: np.ndarray
@@ -68,27 +79,24 @@ class FixedEffectCoordinate(Coordinate):
         )
         rate = self.configuration.down_sampling_rate
         if rate < 1.0:
-            # DownSampler (reference BinaryClassificationDownSampler /
-            # DefaultDownSampler): sample rows by zeroing weights and
-            # rescaling survivors so the objective stays unbiased.
-            rng = np.random.default_rng(self.down_sampling_seed)
-            n = data.num_rows
-            if self.task is TaskType.LOGISTIC_REGRESSION:
-                neg = np.asarray(data.labels) <= 0.5
-                keep = rng.random(n) < rate
-                keep = np.where(neg, keep, True)
-                scale = np.where(neg, 1.0 / rate, 1.0)
-            else:
-                keep = rng.random(n) < rate
-                scale = np.full(n, 1.0 / rate)
-            w = np.asarray(data.weights) * keep * scale
-            data = data.replace(weights=jnp.asarray(w.astype(np.float32)))
+            # runWithSampling (reference DistributedOptimizationProblem
+            # :143-155): down-sample before the solve, weights re-scaled so
+            # the objective stays unbiased.
+            sampler = down_sampler_for(self.task, rate)
+            weights = sampler.sample_weights(
+                np.asarray(data.labels), np.asarray(data.weights),
+                seed=self.down_sampling_seed,
+            )
+            data = data.replace(weights=jnp.asarray(weights))
         fit = train_glm(
             data,
             self.task,
             self.configuration,
             initial_model=model,
         )[0]
+        self.last_tracker = FixedEffectOptimizationTracker(
+            states=OptimizationStatesTracker.from_result(fit.result)
+        )
         return fit.model
 
     def score(self, model: GeneralizedLinearModel) -> np.ndarray:
@@ -105,14 +113,22 @@ class RandomEffectCoordinate(Coordinate):
     task: TaskType
     configuration: GlmOptimizationConfiguration
     base_offsets: np.ndarray  # GAME-level offsets, original row order
+    # telemetry from the most recent update (reference
+    # RandomEffectOptimizationTracker.scala)
+    last_tracker: Optional[RandomEffectOptimizationTracker] = dataclasses.field(
+        default=None, repr=False
+    )
 
     def update_model(
         self, model: Optional[RandomEffectModel], residual_scores: np.ndarray
     ) -> RandomEffectModel:
         ds = self.dataset.update_offsets(self.base_offsets + residual_scores)
-        new_model, _ = train_random_effects(
+        new_model, results = train_random_effects(
             ds, self.task, self.configuration, initial_model=model
         )
+        # every entity lane in a bucket is a real entity (buckets are built
+        # exact-size; only the sample axis is padded), so no mask is needed
+        self.last_tracker = RandomEffectOptimizationTracker.from_results(results)
         return new_model
 
     def score(self, model: RandomEffectModel) -> np.ndarray:

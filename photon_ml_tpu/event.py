@@ -1,0 +1,104 @@
+"""In-process event pub/sub: the observability extension point.
+
+Reference parity: photon-client event/{Event,EventEmitter,EventListener}.scala
+and the concrete events fired from Driver.scala:120,162,186 —
+PhotonSetupEvent, TrainingStartEvent, PhotonOptimizationLogEvent,
+TrainingFinishEvent. Listeners are registered by instance (or by dotted class
+name, matching the reference's ``--event-listeners`` flag, Params.scala:186)
+and receive every emitted event; listener exceptions are isolated so a bad
+listener cannot kill training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import logging
+from typing import Any, Dict, List, Optional
+
+_log = logging.getLogger("photon_ml_tpu.event")
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Base event (reference event/Event.scala:27)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PhotonSetupEvent(Event):
+    """Driver configured and about to run (Driver.scala:120)."""
+
+    params: Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingStartEvent(Event):
+    """Training phase entered (Driver.scala:162)."""
+
+    task: str
+
+
+@dataclasses.dataclass(frozen=True)
+class PhotonOptimizationLogEvent(Event):
+    """Per-model optimization telemetry (Driver.scala:186)."""
+
+    coordinate_id: Optional[str]
+    regularization_weight: float
+    objective_value: float
+    iterations: int
+    convergence_reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingFinishEvent(Event):
+    """Training phase finished."""
+
+    task: str
+    wall_seconds: float
+
+
+class EventListener:
+    """Receives every event from an emitter (EventListener.scala)."""
+
+    def on_event(self, event: Event) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Called when the emitter shuts down."""
+
+
+class EventEmitter:
+    """Mixin/owner of a listener list (reference EventEmitter.scala:24).
+
+    Drivers inherit from (or hold) this and call ``send_event``.
+    """
+
+    def __init__(self) -> None:
+        self._listeners: List[EventListener] = []
+
+    def register_listener(self, listener: EventListener) -> None:
+        self._listeners.append(listener)
+
+    def register_listener_class(self, dotted_name: str) -> None:
+        """Instantiate a listener from ``package.module.ClassName`` — the
+        reference's ``--event-listeners`` CLI contract (Params.scala:186)."""
+        module_name, _, class_name = dotted_name.rpartition(".")
+        if not module_name:
+            raise ValueError(f"listener name must be dotted path, got {dotted_name!r}")
+        cls = getattr(importlib.import_module(module_name), class_name)
+        self.register_listener(cls())
+
+    def send_event(self, event: Event) -> None:
+        for listener in self._listeners:
+            try:
+                listener.on_event(event)
+            except Exception:  # noqa: BLE001 - listener isolation
+                _log.exception("event listener %r failed", listener)
+
+    def clear_listeners(self) -> None:
+        for listener in self._listeners:
+            try:
+                listener.close()
+            except Exception:  # noqa: BLE001
+                _log.exception("event listener %r failed to close", listener)
+        self._listeners = []

@@ -1,0 +1,129 @@
+"""Host-side optimization telemetry: per-solve and per-coordinate trackers.
+
+Reference parity: OptimizationStatesTracker.scala:31 (per-iteration
+(loss, time) ring buffer surfaced in logs/ModelTracker),
+FixedEffectOptimizationTracker.scala and RandomEffectOptimizationTracker.scala
+(statistics over millions of per-entity solves: convergence-reason counts and
+iteration/loss distributions).
+
+Device-side history already lives in opt.state.SolveResult (NaN-padded
+``value_history``); these classes are the host-side view that turns one
+SolveResult — or a vmap'd batch of them — into loggable summaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.opt.state import SolveResult
+from photon_ml_tpu.types import ConvergenceReason
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationStatesTracker:
+    """History of one optimizer run (OptimizationStatesTracker.scala:31)."""
+
+    values: np.ndarray  # [iterations+1] objective per iteration (trimmed)
+    iterations: int
+    convergence_reason: ConvergenceReason
+    elapsed_seconds: Optional[float] = None
+
+    @classmethod
+    def from_result(
+        cls, result: SolveResult, elapsed_seconds: Optional[float] = None
+    ) -> "OptimizationStatesTracker":
+        history = np.asarray(result.value_history)
+        iters = int(result.iterations)
+        return cls(
+            values=history[: iters + 1],
+            iterations=iters,
+            convergence_reason=result.reason_enum(),
+            elapsed_seconds=elapsed_seconds,
+        )
+
+    @property
+    def converged(self) -> bool:
+        return self.convergence_reason is not ConvergenceReason.NOT_CONVERGED
+
+    def to_summary_string(self) -> str:
+        head = (
+            f"{self.iterations} iterations, reason={self.convergence_reason.name}"
+        )
+        if self.values.size:
+            head += f", f0={self.values[0]:.6g}, f*={self.values[-1]:.6g}"
+        if self.elapsed_seconds is not None:
+            head += f", {self.elapsed_seconds:.3f}s"
+        return head
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectOptimizationTracker:
+    """One tracker per fixed-effect update (FixedEffectOptimizationTracker.scala)."""
+
+    states: OptimizationStatesTracker
+
+    def to_summary_string(self) -> str:
+        return f"fixed-effect solve: {self.states.to_summary_string()}"
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectOptimizationTracker:
+    """Aggregate convergence telemetry over per-entity solves
+    (RandomEffectOptimizationTracker.scala): reason counts + iteration and
+    final-loss distributions across all (unpadded) entities."""
+
+    num_entities: int
+    reason_counts: Dict[ConvergenceReason, int]
+    iteration_stats: Dict[str, float]  # min/max/mean/p50/p90
+    value_stats: Dict[str, float]
+
+    @classmethod
+    def from_results(cls, results: List[SolveResult]) -> "RandomEffectOptimizationTracker":
+        """``results`` are vmap'd SolveResults (leading entity axis), one per
+        bucket. Every entity lane is a real entity: bucket builds size the
+        entity axis exactly (data/random_effect.py), only samples are padded."""
+        reasons = [np.asarray(res.reason) for res in results]
+        iters = [np.asarray(res.iterations) for res in results]
+        finals = [np.asarray(res.value) for res in results]
+        reason_all = np.concatenate(reasons) if reasons else np.zeros(0, np.int32)
+        iter_all = np.concatenate(iters) if iters else np.zeros(0, np.int32)
+        value_all = np.concatenate(finals) if finals else np.zeros(0, np.float32)
+
+        counts = {
+            r: int(np.sum(reason_all == r.value))
+            for r in ConvergenceReason
+            if np.any(reason_all == r.value)
+        }
+        return cls(
+            num_entities=int(reason_all.size),
+            reason_counts=counts,
+            iteration_stats=_stats(iter_all.astype(np.float64)),
+            value_stats=_stats(value_all.astype(np.float64)),
+        )
+
+    def to_summary_string(self) -> str:
+        reason_part = ", ".join(
+            f"{r.name}={c}" for r, c in sorted(self.reason_counts.items(), key=lambda kv: kv[0].value)
+        )
+        it = self.iteration_stats
+        return (
+            f"random-effect solves over {self.num_entities} entities: "
+            f"[{reason_part}] iterations(mean={it.get('mean', 0):.1f}, "
+            f"p50={it.get('p50', 0):.0f}, p90={it.get('p90', 0):.0f}, "
+            f"max={it.get('max', 0):.0f})"
+        )
+
+
+def _stats(x: np.ndarray) -> Dict[str, float]:
+    if x.size == 0:
+        return {}
+    return {
+        "min": float(np.min(x)),
+        "max": float(np.max(x)),
+        "mean": float(np.mean(x)),
+        "p50": float(np.percentile(x, 50)),
+        "p90": float(np.percentile(x, 90)),
+    }
