@@ -36,13 +36,15 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from photon_ml_tpu.projector import ProjectorType, RandomProjectionMatrix
+
 
 @dataclasses.dataclass(frozen=True)
 class RandomEffectDataConfiguration:
     """Reference RandomEffectDataConfiguration.scala:42 (string mini-language
-    ``reType,shard,numPartitions,activeCap,passiveLB,featureRatio,projector``)
-    as a typed config. numPartitions/projector are superseded by bucketing +
-    always-on index-map projection."""
+    ``reType,shard,numPartitions,activeCap,passiveLB,featureRatio,projector``
+    with ``index_map``/``identity``/``random=k``) as a typed config.
+    numPartitions is superseded by size-bucketing."""
 
     random_effect_type: str
     active_data_upper_bound: Optional[int] = None   # max active samples/entity
@@ -51,6 +53,16 @@ class RandomEffectDataConfiguration:
     max_local_features: Optional[int] = None        # hard cap on D_local
     num_buckets: int = 1
     seed: int = 0
+    # Projection of per-entity problems (reference ProjectorType):
+    # INDEX_MAP (default, exact remap of observed features), IDENTITY
+    # (local space == global space), RANDOM (shared Gaussian matrix,
+    # ``projected_dim`` required — the `random=k` mini-language arm).
+    projector: ProjectorType = ProjectorType.INDEX_MAP
+    projected_dim: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.projector is ProjectorType.RANDOM and not self.projected_dim:
+            raise ValueError("RANDOM projector requires projected_dim (random=k)")
 
 
 @struct.dataclass
@@ -225,10 +237,18 @@ def build_random_effect_dataset(
             active_rows = rows
             passive_rows = np.empty(0, dtype=np.int64)
 
-        # per-entity observed features (from ACTIVE data only, reference
-        # IndexMapProjectorRDD.scala:164)
-        cols_parts = [fc[row_start[r]:row_end[r]] for r in active_rows]
-        local_cols = np.unique(np.concatenate(cols_parts)) if cols_parts else np.empty(0, dtype=np.int64)
+        if config.projector is ProjectorType.RANDOM:
+            # shared Gaussian projection: no per-entity column map
+            local_cols = np.empty(0, dtype=np.int64)
+            entities.append((uniq[e_i], active_rows, passive_rows, local_cols))
+            continue
+        if config.projector is ProjectorType.IDENTITY:
+            local_cols = np.arange(global_dim, dtype=np.int64)
+        else:
+            # per-entity observed features (from ACTIVE data only, reference
+            # IndexMapProjectorRDD.scala:164)
+            cols_parts = [fc[row_start[r]:row_end[r]] for r in active_rows]
+            local_cols = np.unique(np.concatenate(cols_parts)) if cols_parts else np.empty(0, dtype=np.int64)
 
         # feature selection cap (ratio * samples, hard cap)
         d_cap = None
@@ -246,9 +266,24 @@ def build_random_effect_dataset(
 
         entities.append((uniq[e_i], active_rows, passive_rows, local_cols))
 
+    rproj = (
+        RandomProjectionMatrix(
+            projected_dim=int(config.projected_dim),
+            global_dim=int(global_dim),
+            seed=config.seed,
+        )
+        if config.projector is ProjectorType.RANDOM
+        else None
+    )
+
     # size-bucketing by (samples, local dim) product to bound padding waste
     nb = max(1, min(config.num_buckets, len(entities)))
-    sizes = np.array([len(a) * max(len(lc), 1) for (_, a, _, lc) in entities])
+    sizes = np.array(
+        [
+            len(a) * (rproj.projected_dim if rproj else max(len(lc), 1))
+            for (_, a, _, lc) in entities
+        ]
+    )
     bucket_edges = np.quantile(sizes, np.linspace(0, 1, nb + 1)[1:-1]) if nb > 1 else []
     bucket_of = np.searchsorted(bucket_edges, sizes, side="left") if nb > 1 else np.zeros(len(entities), dtype=int)
 
@@ -264,7 +299,11 @@ def build_random_effect_dataset(
         bi = len(buckets)
         E = len(members)
         S = max(len(a) for (_, a, _, _) in members)
-        D = max(max(len(lc), 1) for (_, _, _, lc) in members)
+        D = (
+            rproj.projected_dim
+            if rproj
+            else max(max(len(lc), 1) for (_, _, _, lc) in members)
+        )
         X = np.zeros((E, S, D), dtype=np.float32)
         lab = np.zeros((E, S), dtype=np.float32)
         off = np.zeros((E, S), dtype=np.float32)
@@ -278,8 +317,13 @@ def build_random_effect_dataset(
         for e, (eid, _, _, local_cols) in enumerate(members):
             ids_b.append(str(eid))
             entity_to_loc[str(eid)] = (bi, e)
-            pidx[e, : len(local_cols)] = local_cols
-            pval[e, : len(local_cols)] = True
+            if rproj is None:
+                pidx[e, : len(local_cols)] = local_cols
+                pval[e, : len(local_cols)] = True
+        if rproj is not None:
+            # projected-space coordinates are all live; back-projection to the
+            # original space goes through the shared matrix, not pidx
+            pval[:, :] = True
 
         # Flat key space entity*(G+1)+col is globally sorted (entities ascend,
         # each local_cols list is sorted), so ONE searchsorted resolves every
@@ -326,9 +370,18 @@ def build_random_effect_dataset(
         off[e_act, s_act] = offsets[act]
         wt[e_act, s_act] = weights[act]
         pos[e_act, s_act] = act
-        local_scatter(
-            act, e_act, lambda k, j, v: X.__setitem__((e_act[k], s_act[k], j), v)
-        )
+
+        def random_project(rows_g: np.ndarray) -> np.ndarray:
+            """x_projected = Bᵀ x per sample of ``rows_g`` (RANDOM projector)."""
+            rep, fidx = _expand_nnz(rows_g, row_start, row_end)
+            return rproj.project_coo(rep, fc[fidx], fv[fidx], len(rows_g))
+
+        if rproj is not None:
+            X[e_act, s_act] = random_project(act)
+        else:
+            local_scatter(
+                act, e_act, lambda k, j, v: X.__setitem__((e_act[k], s_act[k], j), v)
+            )
 
         plens = np.array([len(p) for (_, _, p, _) in members], dtype=np.int64)
         n_pas = int(plens.sum())
@@ -339,7 +392,10 @@ def build_random_effect_dataset(
         )
         e_pas = np.repeat(np.arange(E, dtype=np.int64), plens)
         pX = np.zeros((n_pas, D), dtype=np.float32)
-        local_scatter(pas, e_pas, lambda k, j, v: pX.__setitem__((k, j), v))
+        if rproj is not None:
+            pX = random_project(pas)
+        else:
+            local_scatter(pas, e_pas, lambda k, j, v: pX.__setitem__((k, j), v))
 
         buckets.append(
             ReBucket(

@@ -26,6 +26,10 @@ from photon_ml_tpu.algorithm.coordinate import (
     RandomEffectCoordinate,
 )
 from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.algorithm.factored_random_effect import (
+    FactoredRandomEffectCoordinate,
+    MFOptimizationConfiguration,
+)
 from photon_ml_tpu.data.game_data import GameData
 from photon_ml_tpu.data.random_effect import (
     RandomEffectDataConfiguration,
@@ -59,8 +63,22 @@ class RandomEffectCoordinateConfiguration:
     optimizer: GlmOptimizationConfiguration = GlmOptimizationConfiguration()
 
 
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectCoordinateConfiguration:
+    """Reference FactoredRandomEffectOptimizationProblem.scala:42: a latent
+    RE problem + projection-matrix problem pair plus MF config."""
+
+    feature_shard: str
+    data: RandomEffectDataConfiguration
+    mf: MFOptimizationConfiguration
+    optimizer: GlmOptimizationConfiguration = GlmOptimizationConfiguration()
+    matrix_optimizer: Optional[GlmOptimizationConfiguration] = None
+
+
 CoordinateConfiguration = Union[
-    FixedEffectCoordinateConfiguration, RandomEffectCoordinateConfiguration
+    FixedEffectCoordinateConfiguration,
+    RandomEffectCoordinateConfiguration,
+    FactoredRandomEffectCoordinateConfiguration,
 ]
 
 
@@ -114,6 +132,15 @@ class GameEstimator:
             offsets=data.offsets,
             weights=data.weights,
         )
+        if isinstance(cfg, FactoredRandomEffectCoordinateConfiguration):
+            return FactoredRandomEffectCoordinate(
+                dataset=re_ds,
+                task=self.task,
+                re_configuration=cfg.optimizer,
+                matrix_configuration=cfg.matrix_optimizer or cfg.optimizer,
+                mf_configuration=cfg.mf,
+                base_offsets=data.offsets,
+            )
         return RandomEffectCoordinate(
             dataset=re_ds,
             task=self.task,

@@ -98,6 +98,8 @@ def train_random_effects(
         entity_ids=dataset.entity_ids,
         entity_to_loc=dataset.entity_to_loc,
         global_dim=dataset.global_dim,
+        projector_type=dataset.config.projector,
+        projection_seed=dataset.config.seed,
     )
     return model, results
 

@@ -17,6 +17,7 @@ import numpy as np
 from photon_ml_tpu.data.game_data import GameData
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.projector import ProjectorType
 from photon_ml_tpu.types import TaskType
 
 
@@ -29,7 +30,9 @@ class CoordinateMeta:
     random_effect_type: Optional[str] = None
 
 
-SubModel = Union[GeneralizedLinearModel, RandomEffectModel]
+SubModel = Union[
+    GeneralizedLinearModel, RandomEffectModel, "FactoredRandomEffectModel"
+]
 
 
 @dataclasses.dataclass
@@ -52,6 +55,12 @@ class GameModel:
             return np.asarray(model.compute_score(data.ell_features(m.feature_shard)))
         assert m.random_effect_type is not None
         entity_ids = data.id_tags[m.random_effect_type]
+        from photon_ml_tpu.algorithm.factored_random_effect import (
+            FactoredRandomEffectModel,
+        )
+
+        if isinstance(model, FactoredRandomEffectModel):
+            return _score_factored_re_rows(model, shard, entity_ids, data.num_rows)
         return _score_re_rows(model, shard, entity_ids, data.num_rows)
 
     def score(self, data: GameData) -> np.ndarray:
@@ -61,6 +70,38 @@ class GameModel:
         for cid in self.models:
             total += self.score_coordinate(cid, data)
         return total
+
+
+def _score_factored_re_rows(
+    model, shard, entity_ids, num_rows: int
+) -> np.ndarray:
+    """Score arbitrary rows against a factored RE model: per nonzero
+    (r, c, v), contrib = v * (B[c] . latent_{entity(r)}); unseen entities
+    score 0 (reference FactoredRandomEffectModel scoring via the projected
+    RandomEffectModel + projection matrix)."""
+    out = np.zeros(num_rows, dtype=np.float32)
+    if len(shard.rows) == 0:
+        return out
+    latent = model.latent
+    B = np.asarray(model.projection_matrix)
+    locs = [latent.entity_to_loc.get(str(e)) for e in entity_ids]
+    bucket_of_row = np.array([l[0] if l is not None else -1 for l in locs], dtype=np.int64)
+    erow_of_row = np.array([l[1] if l is not None else 0 for l in locs], dtype=np.int64)
+    rows = np.asarray(shard.rows, dtype=np.int64)
+    cols = np.asarray(shard.cols, dtype=np.int64)
+    vals = np.asarray(shard.vals, dtype=np.float32)
+    nz_bucket = bucket_of_row[rows]
+    for b in range(len(latent.coefficients)):
+        sel = nz_bucket == b
+        if not sel.any():
+            continue
+        v_lat = np.asarray(latent.coefficients[b])  # [Eb, k]
+        r = rows[sel]
+        contrib = vals[sel] * np.einsum(
+            "nk,nk->n", B[cols[sel]], v_lat[erow_of_row[r]]
+        )
+        np.add.at(out, r, contrib.astype(np.float32))
+    return out
 
 
 def _score_re_rows(
@@ -85,6 +126,25 @@ def _score_re_rows(
     cols = np.asarray(shard.cols, dtype=np.int64)
     vals = np.asarray(shard.vals, dtype=np.float32)
     nz_bucket = bucket_of_row[rows]
+
+    if model.projector_type is ProjectorType.RANDOM:
+        # model lives in the shared Gaussian-projected space: score each
+        # nonzero as v * (B[c] . w_entity). One B regeneration serves every
+        # bucket (all buckets share projected_dim).
+        uniq_c, inv = np.unique(cols, return_inverse=True)
+        k = np.asarray(model.coefficients[0]).shape[1]
+        b_rows = model._back_projection_matrix(k).rows(uniq_c)
+        for b in range(len(model.coefficients)):
+            sel = nz_bucket == b
+            if not sel.any():
+                continue
+            w = np.asarray(model.coefficients[b])  # [Eb, k]
+            r = rows[sel]
+            contrib = vals[sel] * np.einsum(
+                "nk,nk->n", b_rows[inv[sel]], w[erow_of_row[r]]
+            )
+            np.add.at(out, r, contrib.astype(np.float32))
+        return out
 
     for b in range(len(model.coefficients)):
         sel = nz_bucket == b

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.projector import ProjectorType, RandomProjectionMatrix
 from photon_ml_tpu.types import TaskType
 
 
@@ -34,6 +35,18 @@ class RandomEffectModel:
     entity_ids: List[List[str]]
     entity_to_loc: Dict[str, Tuple[int, int]]
     global_dim: int
+    # how local spaces map back to the original feature space (reference
+    # RandomEffectModelInProjectedSpace): INDEX_MAP/IDENTITY use proj_indices;
+    # RANDOM regenerates the shared Gaussian matrix from projection_seed
+    projector_type: ProjectorType = ProjectorType.INDEX_MAP
+    projection_seed: int = 0
+
+    def _back_projection_matrix(self, projected_dim: int) -> RandomProjectionMatrix:
+        return RandomProjectionMatrix(
+            projected_dim=projected_dim,
+            global_dim=self.global_dim,
+            seed=self.projection_seed,
+        )
 
     @property
     def num_entities(self) -> int:
@@ -47,6 +60,9 @@ class RandomEffectModel:
             return None
         b, e = loc
         w = np.asarray(self.coefficients[b][e])
+        if self.projector_type is ProjectorType.RANDOM:
+            cols, vals = self._back_projection_matrix(w.shape[0]).project_coefficients_back(w)
+            return {int(i): float(v) for i, v in zip(cols, vals)}
         idx = np.asarray(self.proj_indices[b][e])
         valid = np.asarray(self.proj_valid[b][e])
         return {int(i): float(v) for i, v, ok in zip(idx, w, valid) if ok}
@@ -55,6 +71,15 @@ class RandomEffectModel:
         """Iterate (entity_id, sparse global coefficients) — export order."""
         for b, ids in enumerate(self.entity_ids):
             w_b = np.asarray(self.coefficients[b])
+            if self.projector_type is ProjectorType.RANDOM:
+                # regenerate B once per bucket; back-project the whole bucket
+                # with a single matmul (w_orig = B @ w_proj per entity)
+                proj = self._back_projection_matrix(w_b.shape[1])
+                b_full = proj.rows(np.arange(self.global_dim, dtype=np.int64))
+                vals_b = w_b @ b_full.T  # [Eb, global_dim]
+                for e, eid in enumerate(ids):
+                    yield eid, {int(i): float(v) for i, v in enumerate(vals_b[e])}
+                continue
             idx_b = np.asarray(self.proj_indices[b])
             val_b = np.asarray(self.proj_valid[b])
             for e, eid in enumerate(ids):

@@ -1,0 +1,167 @@
+"""Projector tests: index-map exactness, random-projection determinism and
+distance preservation, identity passthrough, and RE-dataset integration.
+
+Mirrors reference IndexMapProjectorTest / ProjectionMatrixTest and
+RandomEffectCoordinateInProjectedSpace behavior.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.random_effect import (
+    RandomEffectDataConfiguration,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.estimators.random_effect import (
+    score_random_effects,
+    train_random_effects,
+)
+from photon_ml_tpu.opt.config import GlmOptimizationConfiguration
+from photon_ml_tpu.projector import (
+    IdentityProjector,
+    IndexMapProjector,
+    ProjectorType,
+    RandomProjectionMatrix,
+)
+from photon_ml_tpu.types import TaskType
+
+
+class TestIndexMapProjector:
+    def test_roundtrip_exact(self):
+        proj = IndexMapProjector.from_observed(np.array([7, 2, 9, 2]), global_dim=20)
+        assert proj.projected_dim == 3
+        local, mask = proj.project_cols(np.array([2, 7, 9]))
+        assert mask.all()
+        assert sorted(local.tolist()) == [0, 1, 2]
+        cols, vals = proj.project_coefficients_back(np.array([0.5, -1.0, 2.0]))
+        assert cols.tolist() == [2, 7, 9]
+        assert vals.tolist() == [0.5, -1.0, 2.0]
+
+    def test_unobserved_columns_masked(self):
+        proj = IndexMapProjector.from_observed(np.array([1, 5]), global_dim=10)
+        _, mask = proj.project_cols(np.array([1, 3, 5, 9]))
+        assert mask.tolist() == [True, False, True, False]
+
+    def test_empty(self):
+        proj = IndexMapProjector.from_observed(np.array([]), global_dim=10)
+        _, mask = proj.project_cols(np.array([0, 1]))
+        assert not mask.any()
+
+
+class TestRandomProjectionMatrix:
+    def test_rows_deterministic_per_column(self):
+        p = RandomProjectionMatrix(projected_dim=8, global_dim=1000, seed=3)
+        a = p.rows(np.array([5, 100, 999]))
+        b = p.rows(np.array([100]))
+        np.testing.assert_array_equal(a[1], b[0])  # same col -> same row
+        assert not np.allclose(a[0], a[2])  # distinct cols differ
+
+    def test_projection_approximately_preserves_norms(self):
+        # Johnson-Lindenstrauss sanity: E||B^T x||^2 = ||x||^2
+        d, k, n = 200, 64, 50
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        p = RandomProjectionMatrix(projected_dim=k, global_dim=d, seed=0)
+        b = p.rows(np.arange(d))
+        z = x @ b
+        ratio = np.sum(z * z, axis=1) / np.sum(x * x, axis=1)
+        assert abs(float(ratio.mean()) - 1.0) < 0.15
+
+    def test_project_coo_matches_dense(self):
+        d, k = 30, 6
+        p = RandomProjectionMatrix(projected_dim=k, global_dim=d, seed=1)
+        rng = np.random.default_rng(2)
+        dense = (rng.random((4, d)) * (rng.random((4, d)) < 0.3)).astype(np.float32)
+        rows, cols = np.nonzero(dense)
+        out = p.project_coo(rows, cols, dense[rows, cols], num_samples=4)
+        expected = dense @ p.rows(np.arange(d))
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_back_projection_shape(self):
+        p = RandomProjectionMatrix(projected_dim=4, global_dim=12, seed=0)
+        cols, vals = p.project_coefficients_back(np.ones(4, np.float32))
+        assert cols.shape == (12,) and vals.shape == (12,)
+
+    def test_config_requires_k(self):
+        with pytest.raises(ValueError, match="projected_dim"):
+            RandomEffectDataConfiguration(
+                random_effect_type="u", projector=ProjectorType.RANDOM
+            )
+
+
+class TestIdentityProjector:
+    def test_passthrough(self):
+        proj = IdentityProjector(global_dim=5)
+        local, mask = proj.project_cols(np.array([0, 4]))
+        assert local.tolist() == [0, 4] and mask.all()
+        cols, vals = proj.project_coefficients_back(np.arange(5, dtype=np.float32))
+        assert cols.tolist() == list(range(5))
+
+
+def _synthetic(n=600, d=24, entities=12, seed=0):
+    rng = np.random.default_rng(seed)
+    X = (rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.4)).astype(np.float32)
+    ids = np.array([f"e{i % entities}" for i in range(n)])
+    w_e = rng.normal(size=(entities, d)).astype(np.float32)
+    z = np.einsum("nd,nd->n", X, w_e[np.arange(n) % entities])
+    y = (z > 0).astype(np.float32)
+    rows, cols = np.nonzero(X)
+    return ids, rows, cols, X[rows, cols], y, d, n
+
+
+class TestDatasetProjectorIntegration:
+    @pytest.mark.parametrize(
+        "ptype,k",
+        [(ProjectorType.IDENTITY, None), (ProjectorType.RANDOM, 16)],
+    )
+    def test_train_and_score(self, ptype, k):
+        ids, rows, cols, vals, y, d, n = _synthetic()
+        ds = build_random_effect_dataset(
+            entity_ids=ids,
+            feature_rows=rows,
+            feature_cols=cols,
+            feature_vals=vals,
+            global_dim=d,
+            labels=y,
+            config=RandomEffectDataConfiguration(
+                random_effect_type="e", projector=ptype, projected_dim=k
+            ),
+        )
+        D = ds.buckets[0].local_dim
+        assert D == (d if k is None else k)
+        model, _ = train_random_effects(
+            ds,
+            TaskType.LOGISTIC_REGRESSION,
+            GlmOptimizationConfiguration(regularization_weight=0.5),
+        )
+        scores = score_random_effects(model, ds)
+        acc = float(np.mean((scores > 0) == (y > 0.5)))
+        assert acc > 0.8, f"{ptype}: accuracy {acc}"
+        # export goes through back-projection
+        coeffs = model.coefficients_for("e0")
+        assert coeffs and len(coeffs) <= d
+
+    def test_random_projection_scores_match_manual(self):
+        # scoring a model in projected space == B^T x . w_proj
+        ids, rows, cols, vals, y, d, n = _synthetic(n=60, entities=3)
+        cfg = RandomEffectDataConfiguration(
+            random_effect_type="e",
+            projector=ProjectorType.RANDOM,
+            projected_dim=8,
+            seed=5,
+        )
+        ds = build_random_effect_dataset(
+            entity_ids=ids, feature_rows=rows, feature_cols=cols,
+            feature_vals=vals, global_dim=d, labels=y, config=cfg,
+        )
+        p = RandomProjectionMatrix(projected_dim=8, global_dim=d, seed=5)
+        dense = np.zeros((n, d), np.float32)
+        dense[rows, cols] = vals
+        expected_proj = dense @ p.rows(np.arange(d))
+        bucket = ds.buckets[0]
+        pos = np.asarray(bucket.sample_pos)
+        wts = np.asarray(bucket.weights)
+        got = np.asarray(bucket.X)[wts > 0]
+        np.testing.assert_allclose(
+            got, expected_proj[pos[wts > 0]], rtol=1e-4, atol=1e-5
+        )
