@@ -153,8 +153,16 @@ def _latent_dataset(
     dataset: RandomEffectDataset, B: jax.Array
 ) -> RandomEffectDataset:
     """Project every bucket into the latent space of B (step (a) input):
-    X_latent[e,s] = B[pidx[e]]^T x[e,s]."""
-    k = B.shape[1]
+    X_latent[e,s] = B[pidx[e]]^T x[e,s].
+
+    The returned dataset's "global" space IS the k-dim latent space (identity
+    projection, global_dim=k), so the latent RandomEffectModel trained on it
+    exports honest {latent_axis: factor} maps rather than pretending its
+    coordinates are original features.
+    """
+    from photon_ml_tpu.projector import ProjectorType
+
+    k = int(B.shape[1])
     new_buckets = []
     new_passive = []
     for b, bucket in enumerate(dataset.buckets):
@@ -164,7 +172,7 @@ def _latent_dataset(
         new_buckets.append(
             bucket.replace(
                 X=Xl,
-                proj_indices=jnp.zeros((e_n, k), dtype=jnp.int32),
+                proj_indices=jnp.tile(jnp.arange(k, dtype=jnp.int32), (e_n, 1)),
                 proj_valid=jnp.ones((e_n, k), dtype=bool),
             )
         )
@@ -174,7 +182,15 @@ def _latent_dataset(
             new_passive.append(p.replace(X=Xp))
         else:
             new_passive.append(None)
-    return dataclasses.replace(dataset, buckets=new_buckets, passive=new_passive)
+    return dataclasses.replace(
+        dataset,
+        buckets=new_buckets,
+        passive=new_passive,
+        global_dim=k,
+        config=dataclasses.replace(
+            dataset.config, projector=ProjectorType.IDENTITY, projected_dim=None
+        ),
+    )
 
 
 @dataclasses.dataclass

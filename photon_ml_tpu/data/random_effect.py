@@ -61,8 +61,18 @@ class RandomEffectDataConfiguration:
     projected_dim: Optional[int] = None
 
     def __post_init__(self) -> None:
-        if self.projector is ProjectorType.RANDOM and not self.projected_dim:
-            raise ValueError("RANDOM projector requires projected_dim (random=k)")
+        if self.projector is ProjectorType.RANDOM:
+            if not self.projected_dim:
+                raise ValueError("RANDOM projector requires projected_dim (random=k)")
+            if (
+                self.features_to_samples_ratio is not None
+                or self.max_local_features is not None
+            ):
+                raise ValueError(
+                    "feature selection (features_to_samples_ratio / "
+                    "max_local_features) does not apply to the RANDOM "
+                    "projector; the projection itself bounds the local dim"
+                )
 
 
 @struct.dataclass

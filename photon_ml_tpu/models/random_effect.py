@@ -69,13 +69,15 @@ class RandomEffectModel:
 
     def items(self) -> Iterator[Tuple[str, Dict[int, float]]]:
         """Iterate (entity_id, sparse global coefficients) — export order."""
+        b_full = None  # shared across buckets (same seed/global_dim/k)
         for b, ids in enumerate(self.entity_ids):
             w_b = np.asarray(self.coefficients[b])
             if self.projector_type is ProjectorType.RANDOM:
-                # regenerate B once per bucket; back-project the whole bucket
+                # regenerate B once per export; back-project the whole bucket
                 # with a single matmul (w_orig = B @ w_proj per entity)
-                proj = self._back_projection_matrix(w_b.shape[1])
-                b_full = proj.rows(np.arange(self.global_dim, dtype=np.int64))
+                if b_full is None:
+                    proj = self._back_projection_matrix(w_b.shape[1])
+                    b_full = proj.rows(np.arange(self.global_dim, dtype=np.int64))
                 vals_b = w_b @ b_full.T  # [Eb, global_dim]
                 for e, eid in enumerate(ids):
                     yield eid, {int(i): float(v) for i, v in enumerate(vals_b[e])}

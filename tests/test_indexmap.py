@@ -1,0 +1,126 @@
+"""Index-map tests: default map, off-heap PHIX store (native + pure-Python
+readers over the same files), partitioning, and reverse lookup.
+
+Mirrors reference DefaultIndexMapTest / PalDBIndexMapTest.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.indexmap import (
+    INTERCEPT_KEY,
+    DefaultIndexMap,
+    feature_key,
+)
+from photon_ml_tpu.indexmap import offheap
+from photon_ml_tpu.indexmap.offheap import (
+    OffHeapIndexMap,
+    build_offheap_index_map,
+    fnv1a_hashes,
+    native_available,
+)
+
+
+class TestDefaultIndexMap:
+    def test_from_names_deterministic(self):
+        m = DefaultIndexMap.from_names(["b", "a", "b", "c"])
+        assert len(m) == 3
+        assert m.get_index("a") == 0  # sorted order
+        assert m.get_index("b") == 1
+        assert m.get_index("zzz") == -1
+        assert m.get_feature_name(2) == "c"
+        assert m.get_feature_name(99) is None
+
+    def test_intercept(self):
+        m = DefaultIndexMap.from_names(["x"], add_intercept=True)
+        assert INTERCEPT_KEY in m
+
+    def test_feature_key(self):
+        assert feature_key("age") == "age"
+        assert feature_key("age", "18-25") == "age\x0118-25"
+
+    def test_vectorized_lookup(self):
+        m = DefaultIndexMap.from_names(["a", "b"])
+        np.testing.assert_array_equal(
+            m.get_indices(["b", "missing", "a"]), [1, -1, 0]
+        )
+
+    def test_duplicate_indices_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            DefaultIndexMap({"a": 0, "b": 0})
+
+
+def _names(n=5000, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        feature_key(f"feat{i}", f"t{rng.integers(0, 10)}") for i in range(n)
+    ]
+
+
+class TestOffHeapIndexMap:
+    @pytest.mark.parametrize("partitions", [1, 4])
+    def test_build_and_lookup(self, tmp_path, partitions):
+        names = _names()
+        m = build_offheap_index_map(names, str(tmp_path / "im"), partitions)
+        assert len(m) == len(set(names))
+        # forward: every name maps to a unique in-range index
+        idx = m.get_indices(sorted(set(names)))
+        assert idx.min() == 0 and idx.max() == len(m) - 1
+        assert len(np.unique(idx)) == len(m)
+        assert m.get_index("missing-feature") == -1
+        # reverse: round trip
+        for probe in [0, 1, len(m) // 2, len(m) - 1]:
+            name = m.get_feature_name(probe)
+            assert name is not None
+            assert m.get_index(name) == probe
+        assert m.get_feature_name(len(m)) is None
+        m.close()
+
+    def test_python_reader_reads_native_files(self, tmp_path, monkeypatch):
+        """Files are interchangeable between the C++ and Python paths."""
+        names = _names(500)
+        m = build_offheap_index_map(names, str(tmp_path / "im"), 2)
+        expected = {n: m.get_index(n) for n in sorted(set(names))[:50]}
+        m.close()
+        # force the pure-Python reader on the same files
+        monkeypatch.setattr(offheap, "_lib", None)
+        monkeypatch.setattr(offheap, "_lib_failed", True)
+        with OffHeapIndexMap(str(tmp_path / "im")) as m2:
+            for n, i in expected.items():
+                assert m2.get_index(n) == i
+            name = m2.get_feature_name(3)
+            assert name is not None and m2.get_index(name) == 3
+
+    def test_python_writer_native_reader(self, tmp_path, monkeypatch):
+        names = _names(300, seed=2)
+        monkeypatch.setattr(offheap, "_lib", None)
+        monkeypatch.setattr(offheap, "_lib_failed", True)
+        m = build_offheap_index_map(names, str(tmp_path / "im"), 2)
+        expected = {n: m.get_index(n) for n in sorted(set(names))[:50]}
+        m.close()
+        monkeypatch.setattr(offheap, "_lib", None)
+        monkeypatch.setattr(offheap, "_lib_failed", False)
+        if not native_available():
+            pytest.skip("no g++ available")
+        with OffHeapIndexMap(str(tmp_path / "im")) as m2:
+            for n, i in expected.items():
+                assert m2.get_index(n) == i
+
+    def test_native_is_available_in_this_image(self):
+        # the toolchain is baked into the image; catch silent fallback
+        assert native_available()
+
+    def test_duplicate_keys_rejected(self, tmp_path):
+        with pytest.raises((ValueError, OSError)):
+            build_offheap_index_map.__wrapped__ if False else None
+            offheap._build_partition(
+                str(tmp_path / "p.bin"),
+                [b"same", b"same"],
+                np.array([0, 1], dtype=np.uint32),
+            )
+
+    def test_fnv_matches_reference_vectors(self):
+        # FNV-1a 64 known vectors
+        assert int(fnv1a_hashes([b""])[0]) == 0xCBF29CE484222325
+        assert int(fnv1a_hashes([b"a"])[0]) == 0xAF63DC4C8601EC8C
+        assert int(fnv1a_hashes([b"foobar"])[0]) == 0x85944171F73967E8
