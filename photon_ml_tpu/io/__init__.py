@@ -1,0 +1,17 @@
+"""IO layer: Avro wire format, data readers, model persistence.
+
+Replaces photon-client's data/avro/* (AvroDataReader.scala:53,
+ModelProcessingUtils.scala:58, ScoreProcessingUtils.scala:29) and the
+photon-avro-schemas module. The Avro object-container codec is implemented
+in-tree (no JVM Avro library): the on-disk format is identical, so files
+written by the reference pipeline are readable here and vice versa.
+"""
+
+from photon_ml_tpu.io.avro import (
+    AvroSchema,
+    read_avro_file,
+    write_avro_file,
+)
+from photon_ml_tpu.io import schemas
+
+__all__ = ["AvroSchema", "read_avro_file", "write_avro_file", "schemas"]

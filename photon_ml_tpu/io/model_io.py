@@ -1,0 +1,460 @@
+"""GAME model persistence: the reference's on-disk layout, Avro coefficients.
+
+Reference parity: data/avro/ModelProcessingUtils.scala:58 —
+``saveGameModelsToHDFS`` (:71) / ``loadGameModelFromHDFS`` (:136) with layout
+
+    <dir>/model-metadata.json
+    <dir>/fixed-effect/<coordinate>/id-info            (text: featureShardId)
+    <dir>/fixed-effect/<coordinate>/coefficients/part-00000.avro
+    <dir>/random-effect/<coordinate>/id-info           (reType, featureShardId)
+    <dir>/random-effect/<coordinate>/coefficients/part-*.avro
+    <dir>/matrix-factorization/<coordinate>/{rowEffect,colEffect}/part-*.avro
+
+Each GLM is one BayesianLinearModelAvro record: means/variances as
+name-term-value triples (nonzeros only), modelClass naming the reference's
+model class for cross-compat. Loading without index maps builds a compact
+index per shard from the scanned features, exactly like the reference
+(:128-133 doc).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.indexmap import (
+    NAME_TERM_DELIMITER,
+    DefaultIndexMap,
+    IndexMap,
+)
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro import read_avro_dir, write_avro_file
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import CoordinateMeta, GameModel
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.types import TaskType
+
+FIXED_EFFECT = "fixed-effect"
+RANDOM_EFFECT = "random-effect"
+MATRIX_FACTORIZATION = "matrix-factorization"
+ID_INFO = "id-info"
+COEFFICIENTS = "coefficients"
+METADATA_FILE = "model-metadata.json"
+
+# Reference class names (BayesianLinearModelAvro.modelClass), for files the
+# reference pipeline can attribute to the right GLM subclass.
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_TO_TASK = {v: k for k, v in _MODEL_CLASS.items()}
+
+
+def _split_key(key: str) -> Tuple[str, str]:
+    name, _, term = key.partition(NAME_TERM_DELIMITER)
+    return name, term
+
+
+def _name_term_values(
+    values: Dict[int, float], index_map: Optional[IndexMap]
+) -> List[dict]:
+    out = []
+    for idx, val in values.items():
+        if val == 0.0:
+            continue
+        if index_map is not None:
+            key = index_map.get_feature_name(int(idx))
+            if key is None:
+                continue
+            name, term = _split_key(key)
+        else:
+            name, term = str(idx), ""
+        out.append({"name": name, "term": term, "value": float(val)})
+    return out
+
+
+def _glm_record(
+    model_id: str,
+    task: TaskType,
+    means: Dict[int, float],
+    variances: Optional[Dict[int, float]],
+    index_map: Optional[IndexMap],
+) -> dict:
+    return {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS[task],
+        "means": _name_term_values(means, index_map),
+        "variances": (
+            _name_term_values(variances, index_map) if variances else None
+        ),
+        "lossFunction": None,
+    }
+
+
+def _dense_to_sparse(arr) -> Dict[int, float]:
+    a = np.asarray(arr)
+    (nz,) = np.nonzero(a)
+    return {int(i): float(a[i]) for i in nz}
+
+
+def save_game_model(
+    model: GameModel,
+    output_dir: str,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+    model_name: str = "photon-ml-tpu",
+    configurations: Optional[dict] = None,
+    num_output_files_per_random_effect: int = 1,
+) -> None:
+    """Write a GAME model directory (see module docstring for layout)."""
+    os.makedirs(output_dir, exist_ok=True)
+    save_game_model_metadata(
+        output_dir, model.task, model_name=model_name,
+        configurations=configurations,
+    )
+    from photon_ml_tpu.algorithm.factored_random_effect import (
+        FactoredRandomEffectModel,
+    )
+
+    for cid, sub in model.models.items():
+        meta = model.meta[cid]
+        imap = (index_maps or {}).get(meta.feature_shard)
+        if isinstance(sub, GeneralizedLinearModel):
+            cdir = os.path.join(output_dir, FIXED_EFFECT, cid)
+            os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(cdir, ID_INFO), "w") as f:
+                f.write(meta.feature_shard + "\n")
+            means = _dense_to_sparse(sub.coefficients.means)
+            variances = (
+                _dense_to_sparse(sub.coefficients.variances)
+                if sub.coefficients.variances is not None
+                else None
+            )
+            write_avro_file(
+                os.path.join(cdir, COEFFICIENTS, "part-00000.avro"),
+                schemas.bayesian_linear_model_schema(),
+                [_glm_record(cid, model.task, means, variances, imap)],
+            )
+        elif isinstance(sub, RandomEffectModel):
+            _save_random_effect(
+                sub, os.path.join(output_dir, RANDOM_EFFECT, cid),
+                model.task, imap, num_output_files_per_random_effect, meta,
+            )
+        elif isinstance(sub, FactoredRandomEffectModel):
+            # Materialize per-entity global-space coefficients (w = B·w_lat)
+            # so the saved artifact scores identically as a plain RE model;
+            # additionally persist the latent factors + projection matrix
+            # under matrix-factorization/ (LatentFactorAvro, reference
+            # :450-516) so the factored structure is not lost.
+            effective = _factored_to_effective_re(sub, meta)
+            _save_random_effect(
+                effective, os.path.join(output_dir, RANDOM_EFFECT, cid),
+                model.task, imap, num_output_files_per_random_effect, meta,
+            )
+            _save_factored_latents(
+                sub, os.path.join(output_dir, MATRIX_FACTORIZATION, cid), meta
+            )
+        else:
+            raise ValueError(f"cannot save sub-model type {type(sub)} for {cid}")
+
+
+def _factored_to_effective_re(sub, meta: CoordinateMeta) -> RandomEffectModel:
+    B = np.asarray(sub.projection_matrix)  # [d, k]
+    latent = sub.latent
+    entity_coefs: Dict[str, Dict[int, float]] = {}
+    for b, ids in enumerate(latent.entity_ids):
+        w_b = np.asarray(latent.coefficients[b])  # [Eb, k]
+        eff = w_b @ B.T  # [Eb, d]
+        for e, eid in enumerate(ids):
+            (nz,) = np.nonzero(eff[e])
+            entity_coefs[eid] = {int(i): float(eff[e, i]) for i in nz}
+    return RandomEffectModel.from_entity_coefficients(
+        random_effect_type=latent.random_effect_type,
+        task=latent.task,
+        entity_coefficients=entity_coefs,
+        global_dim=B.shape[0],
+    )
+
+
+def _save_factored_latents(sub, out_dir: str, meta: CoordinateMeta) -> None:
+    latent = sub.latent
+    row_dir = os.path.join(out_dir, latent.random_effect_type)
+    os.makedirs(row_dir, exist_ok=True)
+    records = []
+    for b, ids in enumerate(latent.entity_ids):
+        w_b = np.asarray(latent.coefficients[b])
+        for e, eid in enumerate(ids):
+            records.append(
+                {"effectId": str(eid), "latentFactor": [float(v) for v in w_b[e]]}
+            )
+    write_avro_file(
+        os.path.join(row_dir, "part-00000.avro"),
+        schemas.latent_factor_schema(),
+        records,
+    )
+    # The projection matrix B: one latent vector per feature column index.
+    col_dir = os.path.join(out_dir, "projection")
+    os.makedirs(col_dir, exist_ok=True)
+    B = np.asarray(sub.projection_matrix)
+    write_avro_file(
+        os.path.join(col_dir, "part-00000.avro"),
+        schemas.latent_factor_schema(),
+        (
+            {"effectId": str(i), "latentFactor": [float(v) for v in B[i]]}
+            for i in range(B.shape[0])
+        ),
+    )
+
+
+def _save_random_effect(
+    sub: RandomEffectModel,
+    cdir: str,
+    task: TaskType,
+    imap: Optional[IndexMap],
+    num_files: int,
+    meta: CoordinateMeta,
+) -> None:
+    os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
+    with open(os.path.join(cdir, ID_INFO), "w") as f:
+        f.write(f"{sub.random_effect_type}\n{meta.feature_shard}\n")
+    items = list(sub.items())
+    variances = _re_variances(sub)
+    num_files = max(1, min(num_files, max(1, len(items))))
+    per_file = -(-len(items) // num_files) if items else 1
+    for p in range(num_files):
+        chunk = items[p * per_file : (p + 1) * per_file]
+        write_avro_file(
+            os.path.join(cdir, COEFFICIENTS, f"part-{p:05d}.avro"),
+            schemas.bayesian_linear_model_schema(),
+            (
+                _glm_record(eid, task, coefs, variances.get(eid), imap)
+                for eid, coefs in chunk
+            ),
+        )
+
+
+def _re_variances(sub: RandomEffectModel) -> Dict[str, Dict[int, float]]:
+    """Per-entity sparse global-space variances (INDEX_MAP/IDENTITY only —
+    variances are not back-projectable through a random projection)."""
+    out: Dict[str, Dict[int, float]] = {}
+    for b, ids in enumerate(sub.entity_ids):
+        if sub.variances[b] is None:
+            continue
+        var_b = np.asarray(sub.variances[b])
+        idx_b = np.asarray(sub.proj_indices[b])
+        ok_b = np.asarray(sub.proj_valid[b])
+        for e, eid in enumerate(ids):
+            out[eid] = {
+                int(i): float(v)
+                for i, v, ok in zip(idx_b[e], var_b[e], ok_b[e])
+                if ok
+            }
+    return out
+
+
+def save_game_model_metadata(
+    output_dir: str,
+    task: TaskType,
+    model_name: str = "photon-ml-tpu",
+    configurations: Optional[dict] = None,
+) -> None:
+    """model-metadata.json (reference saveGameModelMetadataToHDFS :517)."""
+    os.makedirs(output_dir, exist_ok=True)
+    payload = {
+        "modelType": task.name,
+        "modelName": model_name,
+        "configurations": configurations or {},
+    }
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump(payload, f, indent=2)
+
+
+def load_game_model_metadata(models_dir: str) -> dict:
+    with open(os.path.join(models_dir, METADATA_FILE)) as f:
+        return json.load(f)
+
+
+def _record_sparse(
+    record: dict,
+    field: str,
+    imap: Optional[IndexMap],
+    builder: Optional[Dict[str, int]],
+) -> Dict[int, float]:
+    """NameTermValue list → {index: value}; builds a compact index on the
+    fly when no map is given (reference load-without-index behavior)."""
+    out: Dict[int, float] = {}
+    arr = record.get(field) or []
+    for ntv in arr:
+        key = (
+            ntv["name"]
+            if not ntv["term"]
+            else f"{ntv['name']}{NAME_TERM_DELIMITER}{ntv['term']}"
+        )
+        if imap is not None:
+            idx = imap.get_index(key)
+            if idx < 0:
+                continue
+        else:
+            assert builder is not None
+            idx = builder.setdefault(key, len(builder))
+        out[idx] = float(ntv["value"])
+    return out
+
+
+def load_game_model(
+    models_dir: str,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+) -> Tuple[GameModel, Dict[str, IndexMap]]:
+    """Load a GAME model directory → (GameModel, per-shard index maps)."""
+    metadata = load_game_model_metadata(models_dir)
+    task = TaskType[metadata["modelType"]]
+    models: Dict[str, object] = {}
+    meta: Dict[str, CoordinateMeta] = {}
+    builders: Dict[str, Dict[str, int]] = {}
+
+    def map_for(shard: str) -> Tuple[Optional[IndexMap], Optional[Dict[str, int]]]:
+        if index_maps is not None and shard in index_maps:
+            return index_maps[shard], None
+        return None, builders.setdefault(shard, {})
+
+    fe_dir = os.path.join(models_dir, FIXED_EFFECT)
+    if os.path.isdir(fe_dir):
+        for cid in sorted(os.listdir(fe_dir)):
+            cdir = os.path.join(fe_dir, cid)
+            with open(os.path.join(cdir, ID_INFO)) as f:
+                shard = f.read().split()[0]
+            imap, builder = map_for(shard)
+            records = list(
+                read_avro_dir(os.path.join(cdir, COEFFICIENTS))
+            )
+            if len(records) != 1:
+                raise ValueError(
+                    f"{cid}: expected one fixed-effect GLM, got {len(records)}"
+                )
+            rec = records[0]
+            means = _record_sparse(rec, "means", imap, builder)
+            variances = _record_sparse(rec, "variances", imap, builder)
+            models[cid] = (rec, means, variances or None)
+            meta[cid] = CoordinateMeta(feature_shard=shard)
+
+    re_specs: Dict[str, tuple] = {}
+    re_dir = os.path.join(models_dir, RANDOM_EFFECT)
+    if os.path.isdir(re_dir):
+        for cid in sorted(os.listdir(re_dir)):
+            cdir = os.path.join(re_dir, cid)
+            with open(os.path.join(cdir, ID_INFO)) as f:
+                re_type, shard = f.read().split()[:2]
+            imap, builder = map_for(shard)
+            entity_coefs: Dict[str, Dict[int, float]] = {}
+            entity_vars: Dict[str, Dict[int, float]] = {}
+            for rec in read_avro_dir(os.path.join(cdir, COEFFICIENTS)):
+                eid = rec["modelId"]
+                entity_coefs[eid] = _record_sparse(rec, "means", imap, builder)
+                v = _record_sparse(rec, "variances", imap, builder)
+                if v:
+                    entity_vars[eid] = v
+            re_specs[cid] = (re_type, shard, entity_coefs, entity_vars)
+            meta[cid] = CoordinateMeta(
+                feature_shard=shard, random_effect_type=re_type
+            )
+
+    if not models and not re_specs:
+        raise ValueError(f"no models could be loaded from: {models_dir}")
+
+    # Finalize index maps (builders are complete only after every coordinate
+    # sharing the shard has been scanned).
+    out_maps: Dict[str, IndexMap] = dict(index_maps or {})
+    for shard, builder in builders.items():
+        out_maps[shard] = DefaultIndexMap(builder)
+
+    final: Dict[str, object] = {}
+    for cid, payload in models.items():
+        rec, means, variances = payload
+        shard = meta[cid].feature_shard
+        dim = len(out_maps[shard])
+        w = np.zeros(dim, dtype=np.float32)
+        for i, v in means.items():
+            w[i] = v
+        var = None
+        if variances:
+            var = np.zeros(dim, dtype=np.float32)
+            for i, v in variances.items():
+                var[i] = v
+        final[cid] = GeneralizedLinearModel(
+            coefficients=Coefficients(
+                means=jnp.asarray(w),
+                variances=jnp.asarray(var) if var is not None else None,
+            ),
+            task=task,
+        )
+    for cid, (re_type, shard, entity_coefs, entity_vars) in re_specs.items():
+        final[cid] = RandomEffectModel.from_entity_coefficients(
+            random_effect_type=re_type,
+            task=task,
+            entity_coefficients=entity_coefs,
+            global_dim=len(out_maps[shard]),
+            entity_variances=entity_vars or None,
+        )
+
+    return GameModel(models=final, meta=meta, task=task), out_maps
+
+
+# ------------------------------------------------------- matrix factorization
+
+def save_matrix_factorization_model(
+    model: MatrixFactorizationModel, output_dir: str
+) -> None:
+    """LatentFactorAvro dirs per effect type (reference :450-516)."""
+    for effect, factors, index in (
+        (model.row_effect_type, model.row_factors, model.row_index),
+        (model.col_effect_type, model.col_factors, model.col_index),
+    ):
+        edir = os.path.join(output_dir, effect)
+        os.makedirs(edir, exist_ok=True)
+        order = sorted(index, key=index.get)
+        write_avro_file(
+            os.path.join(edir, "part-00000.avro"),
+            schemas.latent_factor_schema(),
+            (
+                {
+                    "effectId": str(eid),
+                    "latentFactor": [float(v) for v in factors[index[eid]]],
+                }
+                for eid in order
+            ),
+        )
+
+
+def load_matrix_factorization_model(
+    input_dir: str, row_effect_type: str, col_effect_type: str
+) -> MatrixFactorizationModel:
+    def load(effect: str):
+        recs = list(read_avro_dir(os.path.join(input_dir, effect)))
+        index = {r["effectId"]: i for i, r in enumerate(recs)}
+        factors = np.array(
+            [r["latentFactor"] for r in recs], dtype=np.float32
+        )
+        return factors, index
+
+    row_factors, row_index = load(row_effect_type)
+    col_factors, col_index = load(col_effect_type)
+    return MatrixFactorizationModel(
+        row_effect_type=row_effect_type,
+        col_effect_type=col_effect_type,
+        row_factors=row_factors,
+        col_factors=col_factors,
+        row_index=row_index,
+        col_index=col_index,
+    )

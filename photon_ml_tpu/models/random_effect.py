@@ -90,3 +90,56 @@ class RandomEffectModel:
                     for i, v, ok in zip(idx_b[e], w_b[e], val_b[e])
                     if ok
                 }
+
+    @classmethod
+    def from_entity_coefficients(
+        cls,
+        random_effect_type: str,
+        task: TaskType,
+        entity_coefficients: Dict[str, Dict[int, float]],
+        global_dim: int,
+        entity_variances: Optional[Dict[str, Dict[int, float]]] = None,
+    ) -> "RandomEffectModel":
+        """Build a (single-bucket, INDEX_MAP-projected) model from per-entity
+        sparse global-space coefficients — the model-load path (reference
+        loadModelsRDDFromHDFS builds RandomEffectModel from Avro records)."""
+        ids = list(entity_coefficients)
+        entity_variances = entity_variances or {}
+        # local feature set per entity = union of mean and variance indices
+        # (a feature may have zero mean but a stored variance)
+        local: Dict[str, List[int]] = {
+            eid: sorted(
+                set(entity_coefficients[eid]) | set(entity_variances.get(eid, ()))
+            )
+            for eid in ids
+        }
+        d_local = max((len(f) for f in local.values()), default=1) or 1
+        n = len(ids)
+        idx = np.full((n, d_local), global_dim, dtype=np.int32)
+        valid = np.zeros((n, d_local), dtype=bool)
+        w = np.zeros((n, d_local), dtype=np.float32)
+        var = np.zeros((n, d_local), dtype=np.float32)
+        has_var = False
+        for e, eid in enumerate(ids):
+            coefs = entity_coefficients[eid]
+            vars_e = entity_variances.get(eid)
+            # sorted valid prefix: the scoring path binary-searches these
+            for j, i in enumerate(local[eid]):
+                idx[e, j] = i
+                w[e, j] = coefs.get(i, 0.0)
+                valid[e, j] = True
+                if vars_e is not None:
+                    var[e, j] = vars_e.get(i, 0.0)
+            has_var = has_var or vars_e is not None
+        return cls(
+            random_effect_type=random_effect_type,
+            task=task,
+            coefficients=[jnp.asarray(w)],
+            variances=[jnp.asarray(var) if has_var else None],
+            proj_indices=[jnp.asarray(idx)],
+            proj_valid=[jnp.asarray(valid)],
+            entity_ids=[ids],
+            entity_to_loc={eid: (0, e) for e, eid in enumerate(ids)},
+            global_dim=global_dim,
+            projector_type=ProjectorType.INDEX_MAP,
+        )

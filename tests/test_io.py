@@ -1,0 +1,361 @@
+"""IO tests: Avro codec round trips (incl. binary-format invariants), data
+reader feature-bag merging, GAME model save/load scoring equivalence, score
+persistence — modeled on the reference's AvroUtilsTest /
+ModelProcessingUtilsTest / AvroDataReaderTest / ScoreProcessingUtilsTest."""
+
+import io as _io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro import (
+    AvroSchema,
+    _Reader,
+    _encode,
+    read_avro_file,
+    write_avro_file,
+)
+
+
+class TestAvroCodec:
+    def test_zigzag_varint_spec_values(self):
+        """Byte-level spec conformance: zigzag(-1)=1, zigzag(1)=2, 64→0x80 0x01."""
+        for value, expected in [(0, b"\x00"), (-1, b"\x01"), (1, b"\x02"),
+                                (-2, b"\x03"), (2, b"\x04"), (64, b"\x80\x01"),
+                                (-64, b"\x7f")]:
+            buf = _io.BytesIO()
+            _encode(buf, "long", value)
+            assert buf.getvalue() == expected, value
+            assert _Reader(buf.getvalue()).read_long() == value
+
+    def test_primitive_round_trip(self):
+        schema = AvroSchema(
+            {
+                "type": "record",
+                "name": "T",
+                "fields": [
+                    {"name": "s", "type": "string"},
+                    {"name": "d", "type": "double"},
+                    {"name": "f", "type": "float"},
+                    {"name": "i", "type": "int"},
+                    {"name": "l", "type": "long"},
+                    {"name": "b", "type": "boolean"},
+                    {"name": "y", "type": "bytes"},
+                    {"name": "u", "type": ["null", "string"]},
+                    {"name": "a", "type": {"type": "array", "items": "double"}},
+                    {"name": "m", "type": {"type": "map", "values": "string"}},
+                ],
+            }
+        )
+        rec = {
+            "s": "hélloworld", "d": -1.5e300, "f": 0.25, "i": -123456,
+            "l": 2**60, "b": True, "y": b"\x00\xff", "u": None,
+            "a": [1.0, -2.5], "m": {"k1": "v1", "k2": "v2"},
+        }
+        buf = _io.BytesIO()
+        _encode(buf, schema.root, rec)
+        out = _Reader(buf.getvalue())
+        from photon_ml_tpu.io.avro import _decode
+
+        got = _decode(out, schema.root)
+        assert got["s"] == rec["s"]
+        assert got["d"] == rec["d"]
+        assert got["f"] == pytest.approx(0.25)
+        assert got["i"] == rec["i"] and got["l"] == rec["l"]
+        assert got["y"] == rec["y"] and got["u"] is None
+        assert got["a"] == rec["a"] and got["m"] == rec["m"]
+
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_container_file_round_trip(self, tmp_path, codec):
+        schema = schemas.training_example_schema()
+        records = [
+            {
+                "uid": f"u{i}",
+                "label": float(i % 2),
+                "features": [
+                    {"name": "f", "term": str(j), "value": i + 0.5 * j}
+                    for j in range(i % 4)
+                ],
+                "metadataMap": {"userId": f"user{i}"},
+                "weight": 1.0 + i,
+                "offset": None,
+            }
+            for i in range(257)
+        ]
+        path = str(tmp_path / "data.avro")
+        n = write_avro_file(path, schema, records, codec=codec,
+                            sync_interval=1024)  # force multiple blocks
+        assert n == 257
+        got = list(read_avro_file(path))
+        assert len(got) == 257
+        assert got[3]["uid"] == "u3"
+        assert got[3]["features"][1]["value"] == pytest.approx(3.5)
+        assert got[10]["metadataMap"]["userId"] == "user10"
+        assert got[0]["offset"] is None
+
+    def test_defaults_fill_missing_fields(self, tmp_path):
+        path = str(tmp_path / "d.avro")
+        write_avro_file(
+            path, schemas.training_example_schema(),
+            [{"label": 1.0, "features": []}],
+        )
+        (rec,) = read_avro_file(path)
+        assert rec["uid"] is None and rec["weight"] is None
+
+    def test_named_types_defined_once_in_emitted_schema(self):
+        """Spec parsers reject duplicate named-type definitions; the second
+        NameTermValueAvro occurrence must be a name reference."""
+        js = schemas.bayesian_linear_model_schema().to_json()
+        assert js.count('"name": "NameTermValueAvro"') <= 1
+        # and the emitted JSON must round-trip through our own parser
+        AvroSchema(js)
+
+    def test_explicit_zero_weight_preserved(self, tmp_path):
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_game_data,
+            write_training_examples,
+        )
+
+        path = str(tmp_path / "w.avro")
+        write_training_examples(
+            path,
+            [
+                {"label": 1.0, "features": [("f", "", 1.0)], "weight": 0.0},
+                {"label": 0.0, "features": [("f", "", 1.0)]},
+            ],
+        )
+        data, _, _ = read_game_data(
+            path, {"g": FeatureShardConfiguration(["features"], add_intercept=False)}
+        )
+        assert data.weights[0] == 0.0
+        assert data.weights[1] == 1.0
+
+    def test_corrupt_sync_marker_detected(self, tmp_path):
+        path = str(tmp_path / "d.avro")
+        write_avro_file(path, schemas.scoring_result_schema(),
+                        [{"modelId": "m", "predictionScore": 1.0}])
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF  # flip a bit in the trailing sync marker
+        open(path, "wb").write(raw)
+        with pytest.raises(ValueError, match="sync"):
+            list(read_avro_file(path))
+
+
+class TestDataReader:
+    def _write_fixture(self, tmp_path):
+        from photon_ml_tpu.io.data_reader import write_training_examples
+
+        records = []
+        rng = np.random.default_rng(0)
+        for i in range(40):
+            records.append(
+                {
+                    "uid": f"uid{i}",
+                    "label": float(i % 2),
+                    "features": [("g", str(j), float(rng.normal())) for j in range(3)],
+                    "userFeatures": [("u", "0", float(rng.normal()))],
+                    "metadataMap": {"userId": f"user{i % 5}"},
+                    "weight": 2.0,
+                    "offset": 0.25,
+                }
+            )
+        path = str(tmp_path / "train.avro")
+        write_training_examples(path, records)
+        return path
+
+    def test_read_merged_shards(self, tmp_path):
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_game_data,
+        )
+
+        path = self._write_fixture(tmp_path)
+        data, index_maps, uids = read_game_data(
+            path,
+            {
+                "global": FeatureShardConfiguration(
+                    feature_bags=["features", "userFeatures"], add_intercept=True
+                ),
+                "per_user": FeatureShardConfiguration(
+                    feature_bags=["userFeatures"], add_intercept=False
+                ),
+            },
+            id_tags=["userId"],
+        )
+        assert data.num_rows == 40
+        # global shard: 3 g-features + 1 u-feature + intercept
+        assert len(index_maps["global"]) == 5
+        assert len(index_maps["per_user"]) == 1
+        assert data.feature_shards["global"].dim == 5
+        # every row has an intercept nonzero in the global shard
+        g = data.feature_shards["global"]
+        from photon_ml_tpu.indexmap import INTERCEPT_KEY
+
+        icpt = index_maps["global"].get_index(INTERCEPT_KEY)
+        assert (g.cols == icpt).sum() == 40
+        assert data.weights[0] == pytest.approx(2.0)
+        assert data.offsets[0] == pytest.approx(0.25)
+        assert list(data.id_tags["userId"][:5]) == [
+            "user0", "user1", "user2", "user3", "user4"
+        ]
+        assert uids[7] == "uid7"
+
+    def test_fixed_index_map_drops_unknown(self, tmp_path):
+        from photon_ml_tpu.indexmap import DefaultIndexMap, feature_key
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_game_data,
+        )
+
+        path = self._write_fixture(tmp_path)
+        imap = DefaultIndexMap({feature_key("g", "0"): 0})
+        data, _, _ = read_game_data(
+            path,
+            {"global": FeatureShardConfiguration(["features"], add_intercept=False)},
+            index_maps={"global": imap},
+        )
+        assert data.feature_shards["global"].dim == 1
+        assert set(data.feature_shards["global"].cols) == {0}
+
+
+class TestModelIO:
+    def _train_small_game(self, rng):
+        from photon_ml_tpu.data import RandomEffectDataConfiguration
+        from photon_ml_tpu.data.game_data import FeatureShard, GameData
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+            RandomEffectCoordinateConfiguration,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        n_users, rows, dg, du = 6, 25, 8, 4
+        n = n_users * rows
+        Xg = rng.normal(size=(n, dg)).astype(np.float32)
+        Xu = rng.normal(size=(n, du)).astype(np.float32)
+        users = np.repeat([f"user{i}" for i in range(n_users)], rows)
+        wg = rng.normal(size=dg).astype(np.float32)
+        wu = {f"user{i}": rng.normal(size=du).astype(np.float32) for i in range(n_users)}
+        y = Xg @ wg + np.array([Xu[i] @ wu[users[i]] for i in range(n)], np.float32)
+
+        def coo(X):
+            r, c = np.nonzero(X)
+            return FeatureShard(rows=r, cols=c, vals=X[r, c], dim=X.shape[1])
+
+        data = GameData(
+            labels=y,
+            feature_shards={"g": coo(Xg), "u": coo(Xu)},
+            id_tags={"userId": users},
+        )
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates={
+                "fixed": FixedEffectCoordinateConfiguration("g"),
+                "per_user": RandomEffectCoordinateConfiguration(
+                    "u", RandomEffectDataConfiguration(random_effect_type="userId")
+                ),
+            },
+        )
+        return est.fit(data).model, data
+
+    def test_save_load_scoring_equivalence(self, tmp_path, rng):
+        from photon_ml_tpu.io.model_io import (
+            load_game_model,
+            load_game_model_metadata,
+            save_game_model,
+        )
+
+        model, data = self._train_small_game(rng)
+        out = str(tmp_path / "model")
+        save_game_model(model, out)
+        # layout
+        assert os.path.isfile(os.path.join(out, "model-metadata.json"))
+        assert os.path.isfile(
+            os.path.join(out, "fixed-effect", "fixed", "id-info")
+        )
+        assert os.path.isfile(
+            os.path.join(out, "fixed-effect", "fixed", "coefficients", "part-00000.avro")
+        )
+        assert os.path.isfile(
+            os.path.join(out, "random-effect", "per_user", "id-info")
+        )
+        md = load_game_model_metadata(out)
+        assert md["modelType"] == "LINEAR_REGRESSION"
+
+        loaded, maps = load_game_model(out)
+        s0 = model.score(data)
+        s1 = loaded.score(data)
+        np.testing.assert_allclose(s0, s1, rtol=1e-5, atol=1e-5)
+
+    def test_save_load_with_index_maps_round_trip(self, tmp_path, rng):
+        """With real feature-name index maps, names survive the round trip
+        (reference: model files keyed by name+term, not position)."""
+        from photon_ml_tpu.indexmap import DefaultIndexMap, feature_key
+        from photon_ml_tpu.io.avro import read_avro_file
+        from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+
+        model, data = self._train_small_game(rng)
+        g_map = DefaultIndexMap(
+            {feature_key("g", str(i)): i for i in range(8)}
+        )
+        u_map = DefaultIndexMap(
+            {feature_key("u", str(i)): i for i in range(4)}
+        )
+        out = str(tmp_path / "model")
+        save_game_model(model, out, index_maps={"g": g_map, "u": u_map})
+        part = os.path.join(out, "fixed-effect", "fixed", "coefficients",
+                            "part-00000.avro")
+        (rec,) = read_avro_file(part)
+        names = {(m["name"], m["term"]) for m in rec["means"]}
+        assert ("g", "3") in names
+        assert rec["modelClass"].endswith("LinearRegressionModel")
+
+        loaded, _ = load_game_model(out, index_maps={"g": g_map, "u": u_map})
+        np.testing.assert_allclose(
+            model.score(data), loaded.score(data), rtol=1e-5, atol=1e-5
+        )
+
+    def test_matrix_factorization_round_trip(self, tmp_path, rng):
+        from photon_ml_tpu.io.model_io import (
+            load_matrix_factorization_model,
+            save_matrix_factorization_model,
+        )
+        from photon_ml_tpu.models.matrix_factorization import (
+            MatrixFactorizationModel,
+        )
+
+        m = MatrixFactorizationModel(
+            row_effect_type="userId",
+            col_effect_type="itemId",
+            row_factors=rng.normal(size=(5, 3)).astype(np.float32),
+            col_factors=rng.normal(size=(7, 3)).astype(np.float32),
+            row_index={f"u{i}": i for i in range(5)},
+            col_index={f"i{j}": j for j in range(7)},
+        )
+        out = str(tmp_path / "mf")
+        save_matrix_factorization_model(m, out)
+        loaded = load_matrix_factorization_model(out, "userId", "itemId")
+        assert loaded.score("u2", "i3") == pytest.approx(m.score("u2", "i3"), rel=1e-6)
+        np.testing.assert_allclose(loaded.row_factors, m.row_factors)
+
+
+class TestScoresIO:
+    def test_round_trip(self, tmp_path):
+        from photon_ml_tpu.io.scores_io import ScoredItem, load_scores, save_scores
+
+        items = [
+            ScoredItem(prediction_score=0.9, label=1.0, weight=2.0, uid="a",
+                       id_tags={"userId": "u1"}),
+            ScoredItem(prediction_score=-0.1),
+        ]
+        out = str(tmp_path / "scores")
+        n = save_scores(out, items, model_id="my-model")
+        assert n == 2
+        got = list(load_scores(out))
+        assert got[0].prediction_score == pytest.approx(0.9)
+        assert got[0].id_tags == {"userId": "u1"}
+        assert got[1].label is None and got[1].uid is None
