@@ -65,6 +65,9 @@ class FixedEffectCoordinate(Coordinate):
     task: TaskType
     configuration: GlmOptimizationConfiguration
     down_sampling_seed: int = 0
+    # when data.norm is set, the shift modes need the intercept slot to map
+    # coefficients back to the original space (train_glm contract)
+    intercept_index: Optional[int] = None
     # telemetry from the most recent update (reference
     # FixedEffectOptimizationTracker.scala)
     last_tracker: Optional[FixedEffectOptimizationTracker] = dataclasses.field(
@@ -93,6 +96,7 @@ class FixedEffectCoordinate(Coordinate):
             self.task,
             self.configuration,
             initial_model=model,
+            intercept_index=self.intercept_index,
         )[0]
         self.last_tracker = FixedEffectOptimizationTracker(
             states=OptimizationStatesTracker.from_result(fit.result)

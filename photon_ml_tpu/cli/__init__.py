@@ -1,0 +1,12 @@
+"""Command-line drivers.
+
+Reference parity: photon-client's four entry points —
+cli/game/training/Driver.scala:448 (GAME training),
+cli/game/scoring/Driver.scala:266 (GAME scoring),
+Driver.scala:71 (legacy single-GLM pipeline),
+FeatureIndexingJob.scala:214 (off-heap index-map build) —
+launched with ``python -m photon_ml_tpu.cli.<driver>`` instead of
+spark-submit. The reference's string mini-languages
+(GLMOptimizationConfiguration et al.) are replaced by a typed JSON
+coordinate-config file with the same knobs (SURVEY.md §5 rebuild note).
+"""

@@ -1,0 +1,92 @@
+"""Feature indexing job: build partitioned off-heap index maps from Avro.
+
+Reference parity: FeatureIndexingJob.scala:56 — scan Avro input dirs for
+distinct (name, term) features per feature shard, hash-partition, and write
+an off-heap store (:92-179; PalDB there, the native PHIX mmap store here)
+that training/scoring jobs open without loading into heap.
+
+Usage:
+    python -m photon_ml_tpu.cli.build_index \
+        --data-dirs data/train --output-dir indexes/ \
+        --feature-shard global=features,userFeatures --feature-shard user=userFeatures
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional
+
+from photon_ml_tpu.cli.common import setup_logger
+from photon_ml_tpu.indexmap import INTERCEPT_KEY, feature_key
+from photon_ml_tpu.indexmap.offheap import build_offheap_index_map
+from photon_ml_tpu.io.avro import read_avro_dir
+from photon_ml_tpu.utils.timer import Timer
+
+
+def parse_shard_spec(specs: List[str]) -> Dict[str, List[str]]:
+    """'shard=bagA,bagB' flags → {shard: [bags]}."""
+    out: Dict[str, List[str]] = {}
+    for spec in specs:
+        shard, _, bags = spec.partition("=")
+        if not bags:
+            raise ValueError(f"bad --feature-shard spec: {spec!r}")
+        out[shard.strip()] = [b.strip() for b in bags.split(",") if b.strip()]
+    return out
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu build-index", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--data-dirs", nargs="+", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-shard", action="append", required=True,
+                   dest="feature_shards", metavar="SHARD=BAG[,BAG...]")
+    p.add_argument("--num-partitions", type=int, default=1)
+    p.add_argument("--add-intercept", dest="add_intercept",
+                   action="store_true", default=True)
+    p.add_argument("--no-intercept", dest="add_intercept", action="store_false")
+    p.add_argument("--log-file", default=None)
+    return p.parse_args(argv)
+
+
+def run(args: argparse.Namespace) -> Dict[str, int]:
+    logger = setup_logger(args.log_file)
+    timer = Timer()
+    shards = parse_shard_spec(args.feature_shards)
+    names: Dict[str, set] = {sid: set() for sid in shards}
+    with timer.time("scan"):
+        for path in args.data_dirs:
+            for record in read_avro_dir(path):
+                for sid, bags in shards.items():
+                    bucket = names[sid]
+                    for bag in bags:
+                        for f in record.get(bag) or ():
+                            bucket.add(feature_key(f["name"], f["term"]))
+    sizes = {}
+    for sid, keys in names.items():
+        if args.add_intercept:
+            keys.add(INTERCEPT_KEY)
+        out = os.path.join(args.output_dir, sid)
+        with timer.time(f"build [{sid}]"):
+            m = build_offheap_index_map(
+                keys, out, num_partitions=args.num_partitions
+            )
+            sizes[sid] = len(m)
+            m.close()
+        logger.info("shard %s: %d features -> %s", sid, sizes[sid], out)
+    for name, seconds in timer.durations.items():
+        logger.info("timing %-16s %.3fs", name, seconds)
+    return sizes
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    run(parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,187 @@
+"""Shared CLI plumbing: JSON config → typed configs, index-map loading,
+logger setup.
+
+Reference parity: GameDriver.scala:32 (prepareFeatureMaps: default Avro scan
+vs PalDB off-heap :46-85), GameTrainingParams.scala:269-610 (flag surface),
+and the config mini-languages replaced by JSON (GLMOptimizationConfiguration
+.scala:64-67, RandomEffectDataConfiguration.scala:78-143).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from photon_ml_tpu.data.random_effect import RandomEffectDataConfiguration
+from photon_ml_tpu.estimators.game import (
+    CoordinateConfiguration,
+    FactoredRandomEffectCoordinateConfiguration,
+    FixedEffectCoordinateConfiguration,
+    RandomEffectCoordinateConfiguration,
+)
+from photon_ml_tpu.algorithm.factored_random_effect import (
+    MFOptimizationConfiguration,
+)
+from photon_ml_tpu.indexmap import IndexMap
+from photon_ml_tpu.indexmap.offheap import OffHeapIndexMap
+from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
+from photon_ml_tpu.opt.config import (
+    GlmOptimizationConfiguration,
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+)
+from photon_ml_tpu.projector import ProjectorType
+from photon_ml_tpu.types import RegularizationType
+
+
+def setup_logger(log_file: Optional[str] = None, level: str = "INFO") -> logging.Logger:
+    """PhotonLogger-style driver logging: stderr + optional buffered file
+    (reference util/PhotonLogger.scala:36 writes a per-job log file)."""
+    logger = logging.getLogger("photon_ml_tpu")
+    logger.setLevel(getattr(logging, level.upper()))
+    # idempotent: a second driver run in the same process must not stack
+    # handlers (duplicate lines, leaked file descriptors)
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+        h.close()
+    fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(fmt)
+    logger.addHandler(handler)
+    if log_file:
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    return logger
+
+
+def parse_optimizer_config(cfg: dict) -> GlmOptimizationConfiguration:
+    """JSON dict → GlmOptimizationConfiguration. Keys mirror the reference
+    mini-language fields: optimizer, max_iterations, tolerance,
+    regularization, alpha, regularization_weight, down_sampling_rate, plus
+    box constraints."""
+    opt_type = OptimizerType[cfg.get("optimizer", "LBFGS").upper()]
+    kw = {}
+    if "max_iterations" in cfg:
+        kw["max_iterations"] = int(cfg["max_iterations"])
+    if "tolerance" in cfg:
+        kw["tolerance"] = float(cfg["tolerance"])
+    if "constraint_lower" in cfg:
+        kw["constraint_lower"] = cfg["constraint_lower"]
+    if "constraint_upper" in cfg:
+        kw["constraint_upper"] = cfg["constraint_upper"]
+    if opt_type is OptimizerType.TRON:
+        opt = OptimizerConfig.tron(**kw)
+    else:
+        opt = OptimizerConfig.lbfgs(**kw)
+    reg_type = RegularizationType[cfg.get("regularization", "NONE").upper()]
+    reg = RegularizationContext(reg_type, alpha=cfg.get("alpha"))
+    return GlmOptimizationConfiguration(
+        optimizer_config=opt,
+        regularization=reg,
+        regularization_weight=float(cfg.get("regularization_weight", 0.0)),
+        down_sampling_rate=float(cfg.get("down_sampling_rate", 1.0)),
+    )
+
+
+def parse_re_data_config(cfg: dict, re_type: str) -> RandomEffectDataConfiguration:
+    return RandomEffectDataConfiguration(
+        random_effect_type=re_type,
+        active_data_upper_bound=cfg.get("active_data_upper_bound"),
+        passive_data_lower_bound=cfg.get("passive_data_lower_bound"),
+        features_to_samples_ratio=cfg.get("features_to_samples_ratio"),
+        max_local_features=cfg.get("max_local_features"),
+        num_buckets=int(cfg.get("num_buckets", 1)),
+        projector=ProjectorType[cfg.get("projector", "INDEX_MAP").upper()],
+        projected_dim=cfg.get("projected_dim"),
+    )
+
+
+def parse_coordinate_config(cfg: dict) -> CoordinateConfiguration:
+    ctype = cfg.get("type", "fixed").lower()
+    shard = cfg["feature_shard"]
+    optimizer = parse_optimizer_config(cfg.get("optimizer", {}))
+    if ctype == "fixed":
+        return FixedEffectCoordinateConfiguration(
+            feature_shard=shard, optimizer=optimizer
+        )
+    re_type = cfg["random_effect_type"]
+    data = parse_re_data_config(cfg.get("data", {}), re_type)
+    if ctype == "random":
+        return RandomEffectCoordinateConfiguration(
+            feature_shard=shard, data=data, optimizer=optimizer
+        )
+    if ctype == "factored_random":
+        mf = cfg.get("mf", {})
+        return FactoredRandomEffectCoordinateConfiguration(
+            feature_shard=shard,
+            data=data,
+            mf=MFOptimizationConfiguration(
+                num_latent_factors=int(mf.get("num_latent_factors", 8)),
+                num_iterations=int(mf.get("num_iterations", 2)),
+            ),
+            optimizer=optimizer,
+            matrix_optimizer=(
+                parse_optimizer_config(cfg["matrix_optimizer"])
+                if "matrix_optimizer" in cfg
+                else None
+            ),
+        )
+    raise ValueError(f"unknown coordinate type: {ctype}")
+
+
+def load_game_config(path: str) -> Tuple[
+    Dict[str, FeatureShardConfiguration],
+    Dict[str, CoordinateConfiguration],
+    List[str],
+    dict,
+]:
+    """Load the typed JSON coordinate-config file. Returns (shard configs,
+    coordinate configs, update order, the raw dict for metadata)."""
+    with open(path) as f:
+        raw = json.load(f)
+    shards = {
+        sid: FeatureShardConfiguration(
+            feature_bags=s["feature_bags"],
+            add_intercept=bool(s.get("add_intercept", True)),
+        )
+        for sid, s in raw["feature_shards"].items()
+    }
+    coordinates = {
+        cid: parse_coordinate_config(c)
+        for cid, c in raw["coordinates"].items()
+    }
+    update_order = raw.get("update_order", list(coordinates))
+    return shards, coordinates, update_order, raw
+
+
+def load_index_maps(
+    offheap_dir: Optional[str],
+    shard_ids,
+) -> Optional[Dict[str, IndexMap]]:
+    """Off-heap (PHIX) maps when a directory is given — one subdir per
+    feature shard — else None (callers fall back to the default Avro scan,
+    reference GameDriver.prepareFeatureMaps)."""
+    if not offheap_dir:
+        return None
+    import os
+
+    out: Dict[str, IndexMap] = {}
+    for sid in shard_ids:
+        d = os.path.join(offheap_dir, sid)
+        if not os.path.isdir(d):
+            raise FileNotFoundError(f"no off-heap index map for shard {sid} at {d}")
+        out[sid] = OffHeapIndexMap(d)
+    return out
+
+
+def id_tags_needed(coordinates: Dict[str, CoordinateConfiguration]) -> List[str]:
+    tags = []
+    for cfg in coordinates.values():
+        re_type = getattr(getattr(cfg, "data", None), "random_effect_type", None)
+        if re_type and re_type not in tags:
+            tags.append(re_type)
+    return tags

@@ -1,0 +1,278 @@
+"""GAME training driver.
+
+Reference parity: cli/game/training/Driver.scala:50 — run() (:64-119):
+prepareFeatureMaps → AvroDataReader.readMerged → feature stats /
+normalization contexts → GameEstimator.fit per optimization configuration →
+optional hyperparameter tuning (:318-348) → best-model selection →
+model save (:389-433). Flags keep the reference's names where sensible
+(GameTrainingParams.scala:274-319), with the per-coordinate mini-languages
+replaced by the typed JSON config file (see cli/common.py).
+
+Usage:
+    python -m photon_ml_tpu.cli.train_game \
+        --train-data-dirs data/train --validation-data-dirs data/test \
+        --coordinate-config game.json --task LOGISTIC_REGRESSION \
+        --output-dir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.cli.common import (
+    id_tags_needed,
+    load_game_config,
+    load_index_maps,
+    setup_logger,
+)
+from photon_ml_tpu.estimators.game import GameEstimator, GameFit
+from photon_ml_tpu.estimators.tuning import run_hyperparameter_tuning
+from photon_ml_tpu.evaluation.evaluators import (
+    EvaluatorType,
+    MultiEvaluator,
+    evaluator_for,
+)
+from photon_ml_tpu.indexmap import DefaultIndexMap, INTERCEPT_KEY
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.data_reader import read_game_data
+from photon_ml_tpu.io.model_io import save_game_model
+from photon_ml_tpu.normalization import build_normalization_context
+from photon_ml_tpu.ops.data import LabeledData
+from photon_ml_tpu.stat.summary import summarize
+from photon_ml_tpu.types import NormalizationType, TaskType
+from photon_ml_tpu.utils.timer import Timer
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu train-game", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--train-data-dirs", nargs="+", required=True)
+    p.add_argument("--validation-data-dirs", nargs="*", default=[])
+    p.add_argument("--coordinate-config", required=True,
+                   help="typed JSON config: feature shards + coordinates")
+    p.add_argument("--task", required=True,
+                   choices=[t.name for t in TaskType])
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--num-outer-iterations", type=int, default=1)
+    p.add_argument("--evaluator", default=None,
+                   help="e.g. AUC, RMSE, or sharded 'AUC:userId' "
+                        "(reference MultiEvaluatorType syntax)")
+    p.add_argument("--normalization-type", default="NONE",
+                   choices=[n.name for n in NormalizationType])
+    p.add_argument("--offheap-indexmap-dir", default=None)
+    p.add_argument("--hyperparameter-tuning", default="NONE",
+                   choices=["NONE", "RANDOM", "BAYESIAN"])
+    p.add_argument("--hyperparameter-tuning-iter", type=int, default=10)
+    p.add_argument("--model-name", default="photon-ml-tpu-game")
+    p.add_argument("--save-feature-stats", action="store_true",
+                   help="write per-shard FeatureSummarizationResultAvro")
+    p.add_argument("--log-file", default=None)
+    return p.parse_args(argv)
+
+
+def _make_evaluator(spec: Optional[str], task: TaskType, data):
+    """'AUC' or 'AUC:idTag' → Evaluator / MultiEvaluator bound to the
+    validation id tag (reference MultiEvaluatorType.scala:46-60)."""
+    if not spec:
+        return None
+    name, _, tag = spec.partition(":")
+    base = evaluator_for(EvaluatorType[name.strip().upper()])
+    if not tag:
+        return base
+    ids = data.id_tags.get(tag.strip())
+    if ids is None:
+        raise ValueError(f"validation data has no id tag '{tag}'")
+    return MultiEvaluator(base=base, group_ids=tuple(ids))
+
+
+def _save_feature_stats(output_dir, shard, summary, index_map) -> None:
+    """writeBasicStatistics parity (ModelProcessingUtils.scala:560)."""
+    stats_dir = os.path.join(output_dir, "feature-stats", shard)
+    os.makedirs(stats_dir, exist_ok=True)
+    mean = np.asarray(summary.mean)
+    var = np.asarray(summary.variance)
+    mx = np.asarray(summary.max_val)
+    mn = np.asarray(summary.min_val)
+    nnz = np.asarray(summary.num_nonzeros)
+    from photon_ml_tpu.indexmap import NAME_TERM_DELIMITER
+
+    def records():
+        for i in range(len(mean)):
+            key = index_map.get_feature_name(i)
+            if key is None:
+                continue
+            name, _, term = key.partition(NAME_TERM_DELIMITER)
+            yield {
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {
+                    "mean": float(mean[i]),
+                    "variance": float(var[i]),
+                    "min": float(mn[i]),
+                    "max": float(mx[i]),
+                    "numNonzeros": float(nnz[i]),
+                },
+            }
+
+    write_avro_file(
+        os.path.join(stats_dir, "part-00000.avro"),
+        schemas.feature_summarization_schema(),
+        records(),
+    )
+
+
+def run(args: argparse.Namespace) -> GameFit:
+    logger = setup_logger(args.log_file)
+    timer = Timer()
+    task = TaskType[args.task]
+    shard_configs, coordinates, update_order, raw_config = load_game_config(
+        args.coordinate_config
+    )
+
+    with timer.time("prepare feature maps"):
+        index_maps = load_index_maps(args.offheap_indexmap_dir, shard_configs)
+
+    id_tags = id_tags_needed(coordinates)
+    with timer.time("read training data"):
+        data, index_maps, _ = read_game_data(
+            args.train_data_dirs, shard_configs, index_maps, id_tags=id_tags
+        )
+    logger.info("training rows: %d", data.num_rows)
+
+    # a sharded evaluator ('AUC:tag') needs its tag in the validation read
+    # even when no coordinate uses it
+    val_tags = list(id_tags)
+    if args.evaluator and ":" in args.evaluator:
+        tag = args.evaluator.partition(":")[2].strip()
+        if tag and tag not in val_tags:
+            val_tags.append(tag)
+
+    validation_data = None
+    if args.validation_data_dirs:
+        with timer.time("read validation data"):
+            validation_data, _, _ = read_game_data(
+                args.validation_data_dirs, shard_configs, index_maps,
+                id_tags=val_tags,
+            )
+        logger.info("validation rows: %d", validation_data.num_rows)
+
+    norm_type = NormalizationType[args.normalization_type]
+    normalization = {}
+    intercept_indices = {}
+    # normalization applies to fixed-effect coordinates (see GameEstimator);
+    # stats are computed/saved for every shard
+    from photon_ml_tpu.estimators.game import FixedEffectCoordinateConfiguration
+
+    fe_shards = {
+        c.feature_shard
+        for c in coordinates.values()
+        if isinstance(c, FixedEffectCoordinateConfiguration)
+    }
+    # summarize only what's needed: fe shards for normalization, every shard
+    # when stats output was requested
+    stat_shards = (
+        list(shard_configs) if args.save_feature_stats else sorted(fe_shards)
+    )
+    if norm_type is not NormalizationType.NONE or args.save_feature_stats:
+        for sid in stat_shards:
+            with timer.time(f"feature stats [{sid}]"):
+                import jax.numpy as jnp
+
+                labeled = LabeledData.create(
+                    data.ell_features(sid), jnp.asarray(data.labels),
+                    weights=jnp.asarray(data.weights),
+                )
+                summary = summarize(labeled)
+            if args.save_feature_stats:
+                _save_feature_stats(args.output_dir, sid, summary, index_maps[sid])
+            icpt = index_maps[sid].get_index(INTERCEPT_KEY)
+            intercept_indices[sid] = icpt if icpt >= 0 else None
+            if norm_type is not NormalizationType.NONE and sid in fe_shards:
+                normalization[sid] = build_normalization_context(
+                    norm_type,
+                    mean=summary.mean,
+                    variance=summary.variance,
+                    max_magnitude=summary.max_abs,
+                    intercept_index=intercept_indices[sid],
+                )
+
+    evaluator = (
+        _make_evaluator(args.evaluator, task, validation_data)
+        if validation_data is not None
+        else None
+    )
+    estimator = GameEstimator(
+        task=task,
+        coordinates=coordinates,
+        update_order=update_order,
+        num_outer_iterations=args.num_outer_iterations,
+        evaluator=evaluator,
+        normalization=normalization,
+        intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
+    )
+
+    with timer.time("fit"):
+        fit = estimator.fit(data, validation_data=validation_data)
+    for name, value in fit.objective_history:
+        logger.info("objective [%s]: %.6f", name, value)
+    if fit.validation_metric is not None:
+        logger.info("validation metric: %.6f", fit.validation_metric)
+
+    best = fit
+    if (
+        args.hyperparameter_tuning != "NONE"
+        and validation_data is not None
+        and args.hyperparameter_tuning_iter > 0
+    ):
+        with timer.time("hyperparameter tuning"):
+            trials = run_hyperparameter_tuning(
+                estimator, data, validation_data,
+                mode=args.hyperparameter_tuning,
+                num_iterations=args.hyperparameter_tuning_iter,
+                prior_fits=[fit],
+            )
+        for t in trials:
+            logger.info(
+                "trial lambda=%s metric=%.6f",
+                ["%.4g" % (10.0 ** v) for v in t.hyperparameters], t.value,
+            )
+        candidates = [fit] + [t.fit for t in trials]
+        better = estimator.evaluator.better_than
+        for c in candidates:
+            if c.validation_metric is not None and (
+                best.validation_metric is None
+                or better(c.validation_metric, best.validation_metric)
+            ):
+                best = c
+
+    with timer.time("save model"):
+        save_game_model(
+            best.model,
+            os.path.join(args.output_dir, "best"),
+            index_maps=index_maps,
+            model_name=args.model_name,
+            configurations=raw_config,
+        )
+    logger.info("model saved to %s", os.path.join(args.output_dir, "best"))
+    for name, seconds in timer.durations.items():
+        logger.info("timing %-28s %.3fs", name, seconds)
+    return best
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    run(parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,247 @@
+"""Single-GLM training driver (the reference's "legacy" pipeline).
+
+Reference parity: Driver.scala:71 — staged run() (:158-218):
+preprocess (read + validate + stats/normalization) → train (λ sweep with
+warm start, ModelTraining.scala:106) → validate (metric per λ,
+ModelSelection.scala:29 best-model selection) → output (model text files +
+best model Avro). Stage gating via DriverStage is replaced by a linear
+pipeline; diagnostics live in photon_ml_tpu.diagnostics.
+
+Usage:
+    python -m photon_ml_tpu.cli.train_glm \
+        --training-data-dirs data/train --validation-data-dirs data/test \
+        --task LOGISTIC_REGRESSION --regularization-weights 0.1 1 10 100 \
+        --output-dir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.cli.common import parse_optimizer_config, setup_logger
+from photon_ml_tpu.data.validators import (
+    DataValidationType,
+    validate_labeled_data,
+)
+from photon_ml_tpu.estimators.model_training import train_glm
+from photon_ml_tpu.evaluation.evaluators import default_evaluator
+from photon_ml_tpu.indexmap import INTERCEPT_KEY, NAME_TERM_DELIMITER
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.data_reader import (
+    FeatureShardConfiguration,
+    read_game_data,
+)
+from photon_ml_tpu.normalization import build_normalization_context
+from photon_ml_tpu.ops.data import LabeledData
+from photon_ml_tpu.stat.summary import summarize
+from photon_ml_tpu.types import NormalizationType, TaskType
+from photon_ml_tpu.utils.timer import Timer
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu train-glm", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--training-data-dirs", nargs="+", required=True)
+    p.add_argument("--validation-data-dirs", nargs="*", default=[])
+    p.add_argument("--task", required=True, choices=[t.name for t in TaskType])
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-bags", nargs="+", default=["features"])
+    p.add_argument("--add-intercept", dest="add_intercept",
+                   action="store_true", default=True)
+    p.add_argument("--no-intercept", dest="add_intercept", action="store_false")
+    p.add_argument("--regularization-weights", nargs="+", type=float,
+                   default=[0.0])
+    p.add_argument("--optimizer", default="LBFGS", choices=["LBFGS", "TRON"])
+    p.add_argument("--regularization", default="L2",
+                   choices=["NONE", "L1", "L2", "ELASTIC_NET"])
+    p.add_argument("--elastic-net-alpha", type=float, default=None)
+    p.add_argument("--max-iterations", type=int, default=None)
+    p.add_argument("--tolerance", type=float, default=None)
+    p.add_argument("--normalization-type", default="NONE",
+                   choices=[n.name for n in NormalizationType])
+    p.add_argument("--coefficient-box-constraints", default=None,
+                   help='JSON: {"lower": -1.0, "upper": 1.0}')
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.name for v in DataValidationType])
+    p.add_argument("--compute-variances", action="store_true")
+    p.add_argument("--log-file", default=None)
+    return p.parse_args(argv)
+
+
+def _labeled_from_game(data, shard: str, norm=None) -> LabeledData:
+    return LabeledData.create(
+        data.ell_features(shard),
+        jnp.asarray(data.labels),
+        offsets=jnp.asarray(data.offsets),
+        weights=jnp.asarray(data.weights),
+        norm=norm,
+    )
+
+
+def _write_model_text(path: str, w, variances, index_map) -> None:
+    """Per-feature text output 'name<TAB>term<TAB>value' (reference
+    IOUtils.writeModelsInText, Driver.scala:213)."""
+    w = np.asarray(w)
+    with open(path, "w") as f:
+        for i in np.flatnonzero(w):
+            key = index_map.get_feature_name(int(i)) or str(i)
+            name, _, term = key.partition(NAME_TERM_DELIMITER)
+            line = f"{name}\t{term}\t{w[i]:.17g}"
+            if variances is not None:
+                line += f"\t{np.asarray(variances)[i]:.17g}"
+            f.write(line + "\n")
+
+
+def run(args: argparse.Namespace) -> dict:
+    logger = setup_logger(args.log_file)
+    timer = Timer()
+    task = TaskType[args.task]
+    shard_cfg = {
+        "features": FeatureShardConfiguration(
+            feature_bags=args.feature_bags, add_intercept=args.add_intercept
+        )
+    }
+
+    with timer.time("preprocess"):
+        data, index_maps, _ = read_game_data(
+            args.training_data_dirs, shard_cfg
+        )
+        imap = index_maps["features"]
+        labeled = _labeled_from_game(data, "features")
+        validate_labeled_data(
+            labeled, task, DataValidationType[args.data_validation]
+        )
+        icpt = imap.get_index(INTERCEPT_KEY)
+        intercept_index = icpt if icpt >= 0 else None
+        norm = None
+        norm_type = NormalizationType[args.normalization_type]
+        if norm_type is not NormalizationType.NONE:
+            summary = summarize(labeled)
+            norm = build_normalization_context(
+                norm_type,
+                mean=summary.mean,
+                variance=summary.variance,
+                max_magnitude=summary.max_abs,
+                intercept_index=intercept_index,
+            )
+            labeled = _labeled_from_game(data, "features", norm=norm)
+    logger.info("rows: %d features: %d", data.num_rows, len(imap))
+
+    opt_cfg = {
+        "optimizer": args.optimizer,
+        "regularization": args.regularization,
+    }
+    if args.elastic_net_alpha is not None:
+        opt_cfg["alpha"] = args.elastic_net_alpha
+    if args.max_iterations is not None:
+        opt_cfg["max_iterations"] = args.max_iterations
+    if args.tolerance is not None:
+        opt_cfg["tolerance"] = args.tolerance
+    if args.coefficient_box_constraints:
+        box = json.loads(args.coefficient_box_constraints)
+        opt_cfg["constraint_lower"] = box.get("lower")
+        opt_cfg["constraint_upper"] = box.get("upper")
+    configuration = parse_optimizer_config(opt_cfg)
+
+    with timer.time("train"):
+        fits = train_glm(
+            labeled,
+            task,
+            configuration,
+            regularization_weights=args.regularization_weights,
+            compute_variances=args.compute_variances,
+            intercept_index=intercept_index,
+        )
+
+    # validate: metric per λ; best-λ selection by the task's default metric
+    # (reference Driver.validate + ModelSelection.selectBestModel)
+    evaluator = default_evaluator(task)
+    metrics = {}
+    best_lambda = None
+    if args.validation_data_dirs:
+        with timer.time("validate"):
+            vdata, _, _ = read_game_data(
+                args.validation_data_dirs, shard_cfg, index_maps
+            )
+            vfeats = vdata.ell_features("features")
+            for fit in fits:
+                scores = np.asarray(
+                    fit.model.compute_score(vfeats)
+                ) + vdata.offsets
+                m = evaluator.evaluate(scores, vdata.labels, vdata.weights)
+                metrics[fit.regularization_weight] = m
+                logger.info(
+                    "lambda=%g %s=%.6f", fit.regularization_weight,
+                    evaluator.name, m,
+                )
+        best_lambda = None
+        for lam, m in metrics.items():
+            # nan-aware comparison (NaN never wins; reference
+            # Evaluator.betterThan semantics)
+            if best_lambda is None or evaluator.better_than(m, metrics[best_lambda]):
+                best_lambda = lam
+        logger.info("best lambda: %g", best_lambda)
+    else:
+        best_lambda = fits[0].regularization_weight
+
+    with timer.time("output"):
+        os.makedirs(args.output_dir, exist_ok=True)
+        for fit in fits:
+            _write_model_text(
+                os.path.join(
+                    args.output_dir, f"model-lambda-{fit.regularization_weight:g}.txt"
+                ),
+                fit.model.coefficients.means,
+                fit.model.coefficients.variances,
+                imap,
+            )
+        best = next(f for f in fits if f.regularization_weight == best_lambda)
+        means = np.asarray(best.model.coefficients.means)
+        ntv = []
+        for i in np.flatnonzero(means):
+            key = imap.get_feature_name(int(i)) or str(i)
+            name, _, term = key.partition(NAME_TERM_DELIMITER)
+            ntv.append({"name": name, "term": term, "value": float(means[i])})
+        record = {
+            "modelId": "best",
+            "modelClass": None,
+            "means": ntv,
+            "variances": None,
+            "lossFunction": None,
+        }
+        write_avro_file(
+            os.path.join(args.output_dir, "best-model.avro"),
+            schemas.bayesian_linear_model_schema(),
+            [record],
+        )
+        with open(os.path.join(args.output_dir, "selection.json"), "w") as f:
+            json.dump(
+                {
+                    "best_lambda": best_lambda,
+                    "metrics": {str(k): v for k, v in metrics.items()},
+                    "evaluator": evaluator.name,
+                },
+                f, indent=2,
+            )
+    for name, seconds in timer.durations.items():
+        logger.info("timing %-12s %.3fs", name, seconds)
+    return {"best_lambda": best_lambda, "metrics": metrics, "fits": fits}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    run(parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,20 @@ class GameData:
     def num_rows(self) -> int:
         return len(self.labels)
 
+    def slice_rows(self, row_mask: np.ndarray) -> "GameData":
+        """Row-subset view (fresh arrays; ELL cache not carried over)."""
+        row_mask = np.asarray(row_mask, dtype=bool)
+        return GameData(
+            labels=self.labels[row_mask],
+            feature_shards={
+                sid: s.slice_rows(row_mask)
+                for sid, s in self.feature_shards.items()
+            },
+            id_tags={t: np.asarray(v)[row_mask] for t, v in self.id_tags.items()},
+            offsets=self.offsets[row_mask],
+            weights=self.weights[row_mask],
+        )
+
     def ell_features(self, shard_name: str):
         """Device ELL layout of one shard, built once and cached (validation
         re-scores the same data after every coordinate update)."""

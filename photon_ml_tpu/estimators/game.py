@@ -98,7 +98,14 @@ class GameEstimator:
         update_order: Optional[Sequence[str]] = None,
         num_outer_iterations: int = 1,
         evaluator: Optional[Evaluator] = None,
+        normalization: Optional[Dict[str, NormalizationContext]] = None,
+        intercept_indices: Optional[Dict[str, int]] = None,
     ) -> None:
+        """``normalization``/``intercept_indices`` are per-feature-shard;
+        they apply to fixed-effect coordinates (training runs in normalized
+        space, coefficients are mapped back after each solve — reference
+        prepareNormalizationContexts, GameEstimator.scala). Random-effect
+        locals are index-map projected and train unnormalized."""
         if not coordinates:
             raise ValueError("need at least one coordinate configuration")
         self.task = task
@@ -106,6 +113,8 @@ class GameEstimator:
         self.update_order = list(update_order) if update_order else list(coordinates)
         self.num_outer_iterations = num_outer_iterations
         self.evaluator = evaluator or default_evaluator(task)
+        self.normalization = dict(normalization or {})
+        self.intercept_indices = dict(intercept_indices or {})
 
     def _build_coordinate(
         self, cid: str, cfg: CoordinateConfiguration, data: GameData
@@ -117,9 +126,13 @@ class GameEstimator:
                 jnp.asarray(data.labels),
                 offsets=jnp.asarray(data.offsets),
                 weights=jnp.asarray(data.weights),
+                norm=self.normalization.get(cfg.feature_shard),
             )
             return FixedEffectCoordinate(
-                data=labeled, task=self.task, configuration=cfg.optimizer
+                data=labeled,
+                task=self.task,
+                configuration=cfg.optimizer,
+                intercept_index=self.intercept_indices.get(cfg.feature_shard),
             )
         re_ds = build_random_effect_dataset(
             data.id_tags[cfg.data.random_effect_type],

@@ -116,6 +116,8 @@ class GameEstimatorEvaluationFunction:
             update_order=self.estimator.update_order,
             num_outer_iterations=self.estimator.num_outer_iterations,
             evaluator=self.estimator.evaluator,
+            normalization=self.estimator.normalization,
+            intercept_indices=self.estimator.intercept_indices,
         )
         fit = estimator.fit(self.data, validation_data=self.validation_data)
         if fit.validation_metric is None:
