@@ -74,6 +74,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.name for v in DataValidationType])
     p.add_argument("--compute-variances", action="store_true")
+    p.add_argument("--diagnostic-mode", default="NONE",
+                   choices=["NONE", "ALL"],
+                   help="ALL writes model-diagnostic.html (bootstrap, "
+                        "Hosmer-Lemeshow, error independence, feature "
+                        "importance; reference Driver diagnose stage)")
     p.add_argument("--log-file", default=None)
     return p.parse_args(argv)
 
@@ -233,9 +238,84 @@ def run(args: argparse.Namespace) -> dict:
                 },
                 f, indent=2,
             )
+    if args.diagnostic_mode == "ALL":
+        with timer.time("diagnose"):
+            _diagnose(
+                args, task, data, labeled, fits, best_lambda, imap,
+                intercept_index, configuration, logger,
+            )
+
     for name, seconds in timer.durations.items():
         logger.info("timing %-12s %.3fs", name, seconds)
     return {"best_lambda": best_lambda, "metrics": metrics, "fits": fits}
+
+
+def _diagnose(
+    args, task, data, labeled, fits, best_lambda, imap, intercept_index,
+    configuration, logger,
+) -> None:
+    """Reference Driver diagnose() stage: full diagnostic HTML report for
+    the selected model."""
+    from photon_ml_tpu.diagnostics import (
+        bootstrap_training,
+        evaluate_metrics,
+        expected_magnitude_importance,
+        hosmer_lemeshow_diagnostic,
+        prediction_error_independence,
+    )
+    from photon_ml_tpu.diagnostics.report import (
+        build_diagnostic_document,
+        write_diagnostic_report,
+    )
+
+    best = next(f for f in fits if f.regularization_weight == best_lambda)
+    feats = data.ell_features("features")
+    scores = np.asarray(best.model.compute_score(feats)) + data.offsets
+    metrics = evaluate_metrics(scores, data.labels, task, data.weights)
+
+    def boot_train(idx):
+        sub = data.take_rows(idx)
+        # same normalization as the diagnosed model — the regularizer acts
+        # in normalized space, so dropping it would bootstrap a different
+        # estimator
+        sub_labeled = _labeled_from_game(sub, "features", norm=labeled.norm)
+        fit = train_glm(
+            sub_labeled, task, configuration,
+            regularization_weights=[best_lambda],
+            intercept_index=intercept_index,
+        )[0]
+        s = np.asarray(fit.model.compute_score(sub.ell_features("features")))
+        return (
+            np.asarray(fit.model.coefficients.means),
+            evaluate_metrics(s + sub.offsets, sub.labels, task, sub.weights),
+        )
+
+    bootstrap = bootstrap_training(
+        boot_train, data.num_rows, num_samples=6, seed=0
+    )
+
+    hl = None
+    if task is TaskType.LOGISTIC_REGRESSION:
+        probs = 1.0 / (1.0 + np.exp(-scores))
+        hl = hosmer_lemeshow_diagnostic(probs, data.labels, len(imap))
+
+    summary = summarize(labeled)
+    doc = build_diagnostic_document(
+        f"Model diagnostics (lambda = {best_lambda:g})",
+        metrics=metrics,
+        bootstrap=bootstrap,
+        hosmer_lemeshow=hl,
+        independence=prediction_error_independence(
+            scores, data.labels, max_items=2000
+        ),
+        importance=expected_magnitude_importance(
+            best.model.coefficients.means,
+            mean_abs=np.asarray(summary.mean_abs),
+            index_map=imap,
+        ),
+    )
+    out = write_diagnostic_report(args.output_dir, doc)
+    logger.info("diagnostic report: %s", out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

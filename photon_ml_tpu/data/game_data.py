@@ -39,6 +39,27 @@ class FeatureShard:
             dim=self.dim,
         )
 
+    def take_rows(self, indices: np.ndarray) -> "FeatureShard":
+        """Gather rows by index, allowing repeats (bootstrap resampling);
+        output row r holds the nonzeros of input row indices[r]."""
+        indices = np.asarray(indices, dtype=np.int64)
+        order = np.argsort(self.rows, kind="stable")
+        r_sorted = self.rows[order]
+        starts = np.searchsorted(r_sorted, indices, side="left")
+        ends = np.searchsorted(r_sorted, indices, side="right")
+        counts = ends - starts
+        total = int(counts.sum())
+        # positions into `order`, one contiguous run per selected row
+        run_offsets = np.repeat(np.cumsum(counts) - counts, counts)
+        pos = np.arange(total) - run_offsets + np.repeat(starts, counts)
+        nz = order[pos]
+        return FeatureShard(
+            rows=np.repeat(np.arange(len(indices), dtype=np.int64), counts),
+            cols=self.cols[nz],
+            vals=self.vals[nz],
+            dim=self.dim,
+        )
+
 
 @dataclasses.dataclass
 class GameData:
@@ -84,6 +105,20 @@ class GameData:
             id_tags={t: np.asarray(v)[row_mask] for t, v in self.id_tags.items()},
             offsets=self.offsets[row_mask],
             weights=self.weights[row_mask],
+        )
+
+    def take_rows(self, indices: np.ndarray) -> "GameData":
+        """Gather rows by index with repeats allowed (bootstrap resamples)."""
+        indices = np.asarray(indices, dtype=np.int64)
+        return GameData(
+            labels=self.labels[indices],
+            feature_shards={
+                sid: s.take_rows(indices)
+                for sid, s in self.feature_shards.items()
+            },
+            id_tags={t: np.asarray(v)[indices] for t, v in self.id_tags.items()},
+            offsets=self.offsets[indices],
+            weights=self.weights[indices],
         )
 
     def ell_features(self, shard_name: str):

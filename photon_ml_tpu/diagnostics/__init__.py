@@ -1,0 +1,50 @@
+"""Model diagnostics: metrics, bootstrap CIs, learning curves,
+Hosmer-Lemeshow calibration, Kendall-τ independence, feature importance,
+and the logical→physical→HTML report engine.
+
+Reference parity: photon-diagnostics module — Evaluation.scala:31,
+BootstrapTraining.scala:29, diagnostics/fitting/FittingDiagnostic.scala:33,
+diagnostics/hl/HosmerLemeshowDiagnostic.scala:29,
+diagnostics/independence/KendallTauAnalysis.scala:26,
+diagnostics/featureimportance/*, diagnostics/reporting/*.
+"""
+
+from photon_ml_tpu.diagnostics.evaluation import MetricsMap, evaluate_metrics
+from photon_ml_tpu.diagnostics.bootstrap import (
+    BootstrapReport,
+    CoefficientSummary,
+    bootstrap_training,
+)
+from photon_ml_tpu.diagnostics.fitting import FittingReport, fitting_diagnostic
+from photon_ml_tpu.diagnostics.hl import (
+    HosmerLemeshowReport,
+    hosmer_lemeshow_diagnostic,
+)
+from photon_ml_tpu.diagnostics.independence import (
+    KendallTauReport,
+    kendall_tau_analysis,
+    prediction_error_independence,
+)
+from photon_ml_tpu.diagnostics.feature_importance import (
+    FeatureImportanceReport,
+    expected_magnitude_importance,
+    variance_importance,
+)
+
+__all__ = [
+    "MetricsMap",
+    "evaluate_metrics",
+    "BootstrapReport",
+    "CoefficientSummary",
+    "bootstrap_training",
+    "FittingReport",
+    "fitting_diagnostic",
+    "HosmerLemeshowReport",
+    "hosmer_lemeshow_diagnostic",
+    "KendallTauReport",
+    "kendall_tau_analysis",
+    "prediction_error_independence",
+    "FeatureImportanceReport",
+    "expected_magnitude_importance",
+    "variance_importance",
+]

@@ -1,0 +1,105 @@
+"""Bootstrap training: coefficient and metric confidence intervals.
+
+Reference parity: BootstrapTraining.scala:29 — draw ``num_samples``
+with-replacement resamples, fit via a caller-supplied train function, then
+aggregate per-coefficient summaries (CoefficientSummary.scala: min/max/mean/
+std + quartile estimates) and per-metric distributions
+(BootstrapTrainingDiagnostic.scala:26 importance/CI tables).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.diagnostics.evaluation import MetricsMap
+
+
+@dataclasses.dataclass(frozen=True)
+class CoefficientSummary:
+    """Distribution summary of one scalar across bootstrap fits
+    (reference supervised/model/CoefficientSummary.scala; quartiles here are
+    exact over the sample set rather than streaming estimates)."""
+
+    min: float
+    max: float
+    mean: float
+    std: float
+    q1: float
+    median: float
+    q3: float
+
+    @classmethod
+    def from_samples(cls, samples: np.ndarray) -> "CoefficientSummary":
+        s = np.asarray(samples, dtype=np.float64)
+        q1, med, q3 = np.percentile(s, [25, 50, 75])
+        return cls(
+            min=float(s.min()), max=float(s.max()), mean=float(s.mean()),
+            std=float(s.std(ddof=1)) if len(s) > 1 else 0.0,
+            q1=float(q1), median=float(med), q3=float(q3),
+        )
+
+    def interval_contains_zero(self) -> bool:
+        return self.min <= 0.0 <= self.max
+
+
+@dataclasses.dataclass
+class BootstrapReport:
+    """Per-coefficient CIs + metric distributions + notable features
+    (reference bootstrap/BootstrapReport.scala)."""
+
+    coefficient_summaries: List[CoefficientSummary]
+    metric_summaries: Dict[str, CoefficientSummary]
+    # coefficients whose bootstrap interval straddles zero — candidates for
+    # removal (reference 'importance analysis')
+    zero_crossing_indices: np.ndarray
+
+
+def bootstrap_training(
+    train_fn: Callable[[np.ndarray], Tuple[np.ndarray, MetricsMap]],
+    num_rows: int,
+    num_samples: int = 16,
+    portion: float = 1.0,
+    seed: int = 0,
+) -> BootstrapReport:
+    """Run ``train_fn`` on ``num_samples`` with-replacement row resamples.
+
+    ``train_fn(row_indices) -> (coefficient_vector, metrics)`` encapsulates
+    the model fit + evaluation (the reference curries
+    ModelTraining.trainGeneralizedLinearModel the same way).
+    """
+    if num_samples < 2:
+        raise ValueError("bootstrapping needs at least 2 samples")
+    rng = np.random.default_rng(seed)
+    n_draw = max(1, int(portion * num_rows))
+    coef_rows: List[np.ndarray] = []
+    metric_rows: List[MetricsMap] = []
+    for _ in range(num_samples):
+        idx = rng.integers(0, num_rows, size=n_draw)
+        w, metrics = train_fn(idx)
+        coef_rows.append(np.asarray(w, dtype=np.float64))
+        metric_rows.append(metrics)
+
+    coefs = np.stack(coef_rows)  # [S, d]
+    coefficient_summaries = [
+        CoefficientSummary.from_samples(coefs[:, j])
+        for j in range(coefs.shape[1])
+    ]
+    metric_summaries = {
+        name: CoefficientSummary.from_samples(
+            np.array([m[name] for m in metric_rows])
+        )
+        for name in metric_rows[0]
+    }
+    zero_crossing = np.array(
+        [j for j, s in enumerate(coefficient_summaries)
+         if s.interval_contains_zero()],
+        dtype=np.int64,
+    )
+    return BootstrapReport(
+        coefficient_summaries=coefficient_summaries,
+        metric_summaries=metric_summaries,
+        zero_crossing_indices=zero_crossing,
+    )

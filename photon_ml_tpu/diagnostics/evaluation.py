@@ -1,0 +1,124 @@
+"""Per-model metric maps.
+
+Reference parity: Evaluation.scala:31-160 — regression metrics (RMSE, MAE,
+MSE), binary-classification metrics (ROC AUC, PR AUC, peak F1), and per-task
+log-likelihood losses (logistic, Poisson, squared, smoothed hinge). The
+reference wrapped Spark MLlib's metric classes; here the math is direct
+vectorized numpy over (scores, labels, weights).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from photon_ml_tpu.evaluation.evaluators import (
+    _np_auc,
+    _np_logistic,
+    _np_poisson,
+    _np_smoothed_hinge,
+)
+from photon_ml_tpu.types import TaskType
+
+MetricsMap = Dict[str, float]
+
+# metric names (reference Evaluation.scala:31-44)
+ROOT_MEAN_SQUARED_ERROR = "RMSE"
+MEAN_ABSOLUTE_ERROR = "MAE"
+MEAN_SQUARED_ERROR = "MSE"
+AREA_UNDER_ROC = "Area under ROC"
+AREA_UNDER_PRECISION_RECALL = "Area under precision/recall"
+PEAK_F1_SCORE = "Peak F1 score"
+DATA_LOG_LIKELIHOOD = "Per-datum log likelihood"
+
+
+def _sigmoid(z: np.ndarray) -> np.ndarray:
+    return 0.5 * (1.0 + np.tanh(0.5 * z))
+
+
+def _precision_recall_points(scores, labels, weights):
+    """(precision, recall) at each distinct score threshold, descending
+    (weighted; tied thresholds collapsed to their last cumulative point).
+    Returns (None, None) with no positives."""
+    scores = np.asarray(scores, dtype=np.float64)
+    labels = np.asarray(labels, dtype=np.float64)
+    w = np.ones_like(scores) if weights is None else np.asarray(weights, np.float64)
+    pos = labels > 0.5
+    total_pos = float(w[pos].sum())
+    if total_pos == 0:
+        return None, None
+    order = np.argsort(-scores, kind="stable")
+    tp = np.cumsum(np.where(pos[order], w[order], 0.0))
+    fp = np.cumsum(np.where(~pos[order], w[order], 0.0))
+    s_sorted = scores[order]
+    last_of_tie = np.append(s_sorted[1:] != s_sorted[:-1], True)
+    tp, fp = tp[last_of_tie], fp[last_of_tie]
+    precision = tp / np.maximum(tp + fp, 1e-30)
+    recall = tp / total_pos
+    return precision, recall
+
+
+def area_under_pr_curve(scores, labels, weights=None) -> float:
+    """Weighted PR AUC by descending-score sweep (MLlib areaUnderPR
+    semantics: trapezoid over (recall, precision), anchored at the first
+    point's precision)."""
+    precision, recall = _precision_recall_points(scores, labels, weights)
+    if precision is None:
+        return float("nan")
+    r = np.concatenate([[0.0], recall])
+    p = np.concatenate([[precision[0] if len(precision) else 1.0], precision])
+    return float(np.sum((r[1:] - r[:-1]) * (p[1:] + p[:-1]) / 2.0))
+
+
+def peak_f1(scores, labels, weights=None) -> float:
+    """max_t F1(t) over all score thresholds (MLlib fMeasureByThreshold)."""
+    precision, recall = _precision_recall_points(scores, labels, weights)
+    if precision is None:
+        return float("nan")
+    f1 = 2 * precision * recall / np.maximum(precision + recall, 1e-30)
+    return float(np.max(f1))
+
+
+def evaluate_metrics(
+    scores,
+    labels,
+    task: TaskType,
+    weights=None,
+) -> MetricsMap:
+    """Metric map for raw margins ``scores`` (offsets already added).
+
+    Regression tasks report RMSE/MAE/MSE on the mean prediction; logistic
+    adds ROC-AUC, PR-AUC and peak F1 on the margin; each task reports its
+    per-datum loss as the log-likelihood proxy (Evaluation.scala:55-160).
+    """
+    scores = np.asarray(scores, dtype=np.float64)
+    labels = np.asarray(labels, dtype=np.float64)
+    w = np.ones_like(scores) if weights is None else np.asarray(weights, np.float64)
+    wsum = float(np.maximum(w.sum(), 1e-30))
+    out: MetricsMap = {}
+
+    if task is TaskType.LOGISTIC_REGRESSION:
+        mean = _sigmoid(scores)
+        out[AREA_UNDER_ROC] = _np_auc(scores, labels, w)
+        out[AREA_UNDER_PRECISION_RECALL] = area_under_pr_curve(scores, labels, w)
+        out[PEAK_F1_SCORE] = peak_f1(scores, labels, w)
+        out[DATA_LOG_LIKELIHOOD] = -_np_logistic(scores, labels, w)
+    elif task is TaskType.POISSON_REGRESSION:
+        mean = np.exp(scores)
+        out[DATA_LOG_LIKELIHOOD] = -_np_poisson(scores, labels, w)
+    elif task is TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        mean = scores
+        out[DATA_LOG_LIKELIHOOD] = -_np_smoothed_hinge(scores, labels, w)
+        out[AREA_UNDER_ROC] = _np_auc(scores, labels, w)
+    else:
+        mean = scores
+        out[DATA_LOG_LIKELIHOOD] = -float(
+            np.sum(w * (scores - labels) ** 2) / (2 * wsum)
+        )
+
+    err = mean - labels
+    out[MEAN_SQUARED_ERROR] = float(np.sum(w * err * err) / wsum)
+    out[ROOT_MEAN_SQUARED_ERROR] = float(np.sqrt(out[MEAN_SQUARED_ERROR]))
+    out[MEAN_ABSOLUTE_ERROR] = float(np.sum(w * np.abs(err)) / wsum)
+    return out

@@ -29,6 +29,8 @@ class BasicStatisticalSummary:
     min_val: jax.Array        # [d] min over observed values incl. implicit zeros
     max_val: jax.Array        # [d] max over observed values incl. implicit zeros
     count: jax.Array          # scalar total weight
+    mean_abs: jax.Array       # [d] weighted mean of |x| (reference meanAbs,
+    #                           used by ExpectedMagnitude feature importance)
 
 
 def _dense_stats(matrix, weights):
@@ -36,10 +38,11 @@ def _dense_stats(matrix, weights):
     w = weights[:, None]
     s1 = jnp.sum(w * matrix, axis=0)
     s2 = jnp.sum(w * matrix * matrix, axis=0)
+    sabs = jnp.sum(w * jnp.abs(matrix), axis=0)
     nnz = jnp.sum(jnp.where(matrix != 0, w, 0.0), axis=0)
     mx = jnp.max(jnp.where(weights[:, None] > 0, matrix, -jnp.inf), axis=0)
     mn = jnp.min(jnp.where(weights[:, None] > 0, matrix, jnp.inf), axis=0)
-    return s1, s2, nnz, mn, mx, wsum
+    return s1, s2, sabs, nnz, mn, mx, wsum
 
 
 def _ell_stats(feats: EllFeatures, weights):
@@ -50,6 +53,7 @@ def _ell_stats(feats: EllFeatures, weights):
     zeros = lambda: jnp.zeros((d,), dtype=feats.values.dtype)
     s1 = zeros().at[feats.indices].add(wv)
     s2 = zeros().at[feats.indices].add(wv * feats.values)
+    sabs = zeros().at[feats.indices].add(jnp.abs(wv))
     nnz = zeros().at[feats.indices].add(jnp.where(feats.values != 0, w, 0.0))
     # min/max over EXPLICIT values; implicit zeros folded in afterwards
     mx = jnp.full((d,), -jnp.inf, dtype=feats.values.dtype).at[feats.indices].max(
@@ -58,16 +62,16 @@ def _ell_stats(feats: EllFeatures, weights):
     mn = jnp.full((d,), jnp.inf, dtype=feats.values.dtype).at[feats.indices].min(
         jnp.where((feats.values != 0) & (w > 0), feats.values, jnp.inf)
     )
-    return s1, s2, nnz, mn, mx, wsum
+    return s1, s2, sabs, nnz, mn, mx, wsum
 
 
 def summarize(data: LabeledData) -> BasicStatisticalSummary:
     feats = data.features
     if isinstance(feats, DenseFeatures):
-        s1, s2, nnz, mn, mx, wsum = _dense_stats(feats.matrix, data.weights)
+        s1, s2, sabs, nnz, mn, mx, wsum = _dense_stats(feats.matrix, data.weights)
         sparse = False
     else:
-        s1, s2, nnz, mn, mx, wsum = _ell_stats(feats, data.weights)
+        s1, s2, sabs, nnz, mn, mx, wsum = _ell_stats(feats, data.weights)
         sparse = True
 
     mean = s1 / jnp.maximum(wsum, 1e-30)
@@ -92,4 +96,5 @@ def summarize(data: LabeledData) -> BasicStatisticalSummary:
         min_val=mn,
         max_val=mx,
         count=wsum,
+        mean_abs=sabs / jnp.maximum(wsum, 1e-30),
     )

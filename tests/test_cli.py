@@ -233,6 +233,23 @@ class TestLegacyGlmDriver:
         vals = list(metrics.values())
         assert max(vals) - min(vals) < 0.02, metrics
 
+    def test_diagnostic_mode_writes_report(self, glmix_avro, tmp_path):
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        out = tmp_path / "glm_diag"
+        run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "1.0",
+            "--diagnostic-mode", "ALL",
+        ]))
+        html = (out / "model-diagnostic.html").read_text()
+        assert "Hosmer-Lemeshow" in html
+        assert "Bootstrap" in html
+        assert "Feature importance" in html
+        assert "<svg" in html
+
     def test_tron_and_box_constraints(self, glmix_avro, tmp_path):
         from photon_ml_tpu.cli.train_glm import parse_args, run
 

@@ -1,0 +1,324 @@
+"""Diagnostics tests, modeled on photon-diagnostics' test suite:
+EvaluationTest (metric correctness vs hand computations / sklearn-style
+references), BootstrapTrainingTest, FittingDiagnosticIntegTest,
+HosmerLemeshowDiagnostic tests, KendallTauAnalysisTest, feature-importance
+tests, and reporting render tests."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.types import TaskType
+
+
+class TestEvaluationMetrics:
+    def test_regression_metrics(self):
+        from photon_ml_tpu.diagnostics.evaluation import evaluate_metrics
+
+        scores = np.array([1.0, 2.0, 3.0])
+        labels = np.array([1.5, 2.0, 2.0])
+        m = evaluate_metrics(scores, labels, TaskType.LINEAR_REGRESSION)
+        assert m["MSE"] == pytest.approx((0.25 + 0 + 1) / 3)
+        assert m["RMSE"] == pytest.approx(np.sqrt((0.25 + 0 + 1) / 3))
+        assert m["MAE"] == pytest.approx((0.5 + 0 + 1) / 3)
+
+    def test_logistic_metrics_perfect_separation(self):
+        from photon_ml_tpu.diagnostics.evaluation import evaluate_metrics
+
+        scores = np.array([-5.0, -3.0, 3.0, 5.0])
+        labels = np.array([0.0, 0.0, 1.0, 1.0])
+        m = evaluate_metrics(scores, labels, TaskType.LOGISTIC_REGRESSION)
+        assert m["Area under ROC"] == pytest.approx(1.0)
+        assert m["Area under precision/recall"] == pytest.approx(1.0)
+        assert m["Peak F1 score"] == pytest.approx(1.0)
+
+    def test_pr_auc_known_value(self):
+        from photon_ml_tpu.diagnostics.evaluation import area_under_pr_curve
+
+        # ordering: pos, neg, pos, neg; PR points at the 4 thresholds:
+        # (R,P) = (.5,1), (.5,.5), (1,2/3), (1,.5); MLlib-style trapezoid
+        # over ALL threshold points anchored at (0, P_first):
+        # (0->.5)*avg(1,1) + 0 + (.5->1)*avg(.5,2/3) + 0
+        scores = np.array([4.0, 3.0, 2.0, 1.0])
+        labels = np.array([1.0, 0.0, 1.0, 0.0])
+        v = area_under_pr_curve(scores, labels)
+        assert v == pytest.approx(0.5 * 1.0 + 0.5 * (0.5 + 2 / 3) / 2)
+
+    def test_peak_f1_known_value(self):
+        from photon_ml_tpu.diagnostics.evaluation import peak_f1
+
+        scores = np.array([4.0, 3.0, 2.0, 1.0])
+        labels = np.array([0.0, 1.0, 1.0, 0.0])
+        # best threshold keeps top-3: P=2/3, R=1 -> F1=0.8
+        assert peak_f1(scores, labels) == pytest.approx(0.8)
+
+
+class TestBootstrap:
+    def test_coefficient_cis_cover_truth(self, rng):
+        from photon_ml_tpu.diagnostics.bootstrap import bootstrap_training
+
+        n, d = 400, 4
+        X = rng.normal(size=(n, d))
+        w_true = np.array([2.0, -1.0, 0.0, 0.5])
+        y = X @ w_true + 0.1 * rng.normal(size=n)
+
+        def train(idx):
+            Xi, yi = X[idx], y[idx]
+            w = np.linalg.lstsq(Xi, yi, rcond=None)[0]
+            mse = float(np.mean((Xi @ w - yi) ** 2))
+            return w, {"MSE": mse}
+
+        report = bootstrap_training(train, n, num_samples=20, seed=0)
+        assert len(report.coefficient_summaries) == d
+        for j, s in enumerate(report.coefficient_summaries):
+            assert s.min <= w_true[j] <= s.max
+        # the zero coefficient is flagged, the strong ones are not
+        assert 2 in report.zero_crossing_indices
+        assert 0 not in report.zero_crossing_indices
+        assert report.metric_summaries["MSE"].mean < 0.02
+
+    def test_quartile_ordering(self):
+        from photon_ml_tpu.diagnostics.bootstrap import CoefficientSummary
+
+        s = CoefficientSummary.from_samples(np.arange(101, dtype=float))
+        assert s.q1 <= s.median <= s.q3
+        assert s.median == pytest.approx(50.0)
+
+
+class TestFitting:
+    def test_learning_curves_shrink_gap(self, rng):
+        from photon_ml_tpu.diagnostics.fitting import fitting_diagnostic
+
+        n, d = 2000, 5
+        X = rng.normal(size=(n, d))
+        w_true = rng.normal(size=d)
+        y = X @ w_true + 0.5 * rng.normal(size=n)
+
+        def train(idx, warm):
+            out = {}
+            for lam in [1.0]:
+                A = X[idx].T @ X[idx] + lam * np.eye(d)
+                out[lam] = np.linalg.solve(A, X[idx].T @ y[idx])
+            return out
+
+        def evaluate(w, idx):
+            err = X[idx] @ w - y[idx]
+            return {"MSE": float(np.mean(err**2))}
+
+        reports = fitting_diagnostic(train, evaluate, n, d, seed=1)
+        assert set(reports) == {1.0}
+        portions, train_vals, test_vals = reports[1.0].metrics["MSE"]
+        assert len(portions) == 9  # NUM_TRAINING_PARTITIONS - 1 points
+        assert portions == sorted(portions)
+        # holdout error at full data ≲ holdout error at small data
+        assert test_vals[-1] <= test_vals[0] + 0.05
+
+    def test_too_small_returns_empty(self):
+        from photon_ml_tpu.diagnostics.fitting import fitting_diagnostic
+
+        out = fitting_diagnostic(
+            lambda idx, warm: {}, lambda m, idx: {}, num_rows=3, dim=10
+        )
+        assert out == {}
+
+
+class TestHosmerLemeshow:
+    def test_calibrated_model_passes(self, rng):
+        from photon_ml_tpu.diagnostics.hl import hosmer_lemeshow_diagnostic
+
+        n = 5000
+        p = rng.uniform(0.05, 0.95, size=n)
+        y = (rng.random(n) < p).astype(float)  # perfectly calibrated
+        rep = hosmer_lemeshow_diagnostic(p, y, num_dimensions=8)
+        assert rep.prob_at_chi_squared < 0.99  # not flagged as miscalibrated
+        assert rep.degrees_of_freedom == len(rep.bins) - 2
+        assert sum(b.count for b in rep.bins) == n
+
+    def test_miscalibrated_model_flagged(self, rng):
+        from photon_ml_tpu.diagnostics.hl import hosmer_lemeshow_diagnostic
+
+        n = 5000
+        p = rng.uniform(0.05, 0.95, size=n)
+        y = (rng.random(n) < np.clip(p + 0.25, 0, 1)).astype(float)
+        rep = hosmer_lemeshow_diagnostic(p, y, num_dimensions=8)
+        assert rep.prob_at_chi_squared > 0.999
+        assert rep.p_value < 1e-3
+
+    def test_bin_count_heuristic(self):
+        from photon_ml_tpu.diagnostics.hl import default_bin_count
+
+        assert default_bin_count(10_000, 8) == 10  # dim-bound: 8+2
+        assert default_bin_count(100, 100) == 9    # data-bound: .9*10+.1*log1p
+        assert default_bin_count(10, 1) == 3       # floor
+
+
+class TestKendallTau:
+    def test_matches_scipy(self, rng):
+        from scipy.stats import kendalltau
+
+        from photon_ml_tpu.diagnostics.independence import kendall_tau_analysis
+
+        a = rng.normal(size=200)
+        b = 0.5 * a + rng.normal(size=200)
+        rep = kendall_tau_analysis(a, b)
+        ref_tau, _ = kendalltau(a, b)
+        assert rep.tau_beta == pytest.approx(ref_tau, abs=1e-9)
+        assert rep.tau_alpha == pytest.approx(ref_tau, abs=1e-9)  # no ties
+        assert rep.prob_dependent > 0.99  # strong dependence detected
+        assert rep.p_value < 0.01
+
+    def test_independent_low_p(self, rng):
+        from photon_ml_tpu.diagnostics.independence import kendall_tau_analysis
+
+        a = rng.normal(size=300)
+        b = rng.normal(size=300)
+        rep = kendall_tau_analysis(a, b)
+        assert abs(rep.tau_alpha) < 0.1
+        assert rep.prob_dependent < 0.95
+
+    def test_ties_counted(self):
+        from photon_ml_tpu.diagnostics.independence import kendall_tau_analysis
+
+        a = np.array([1.0, 1.0, 2.0, 3.0])
+        b = np.array([1.0, 2.0, 2.0, 3.0])
+        rep = kendall_tau_analysis(a, b)
+        # pairs: (12):tieA, (13):C,(14):C,(23):tieB,(24):C,(34):C
+        assert rep.num_concordant == 4
+        assert rep.num_discordant == 0
+        assert "ties" in rep.message
+
+    def test_error_independence_wrapper(self, rng):
+        from photon_ml_tpu.diagnostics.independence import (
+            prediction_error_independence,
+        )
+
+        scores = rng.normal(size=150)
+        labels = scores + rng.normal(size=150)  # error independent of score
+        rep = prediction_error_independence(scores, labels)
+        assert abs(rep.tau_alpha) < 0.15
+
+
+class TestFeatureImportance:
+    def test_rankings(self):
+        from photon_ml_tpu.indexmap import DefaultIndexMap
+        from photon_ml_tpu.diagnostics.feature_importance import (
+            expected_magnitude_importance,
+            variance_importance,
+        )
+
+        imap = DefaultIndexMap({f"f{i}": i for i in range(4)})
+        coefs = np.array([0.1, -5.0, 2.0, 0.0])
+        mean_abs = np.array([10.0, 0.1, 1.0, 1.0])
+        rep = expected_magnitude_importance(coefs, mean_abs, imap)
+        # importances: 1.0, 0.5, 2.0, 0 -> top = f2
+        assert rep.ranked_features[0][0] == "f2"
+        assert rep.ranked_features[0][3] == pytest.approx(2.0)
+
+        var = np.array([1.0, 1.0, 1.0, 1.0])
+        rep2 = variance_importance(coefs, var, imap)
+        assert rep2.ranked_features[0][0] == "f1"  # |-5|*1
+
+    def test_without_summary_falls_back_to_magnitude(self):
+        from photon_ml_tpu.diagnostics.feature_importance import (
+            expected_magnitude_importance,
+        )
+
+        rep = expected_magnitude_importance(np.array([1.0, -3.0]))
+        assert rep.ranked_features[0][2] == 1  # index of -3
+        assert "Magnitude" in rep.importance_description
+
+
+class TestReporting:
+    def _document(self):
+        from photon_ml_tpu.diagnostics.reporting import (
+            BulletedList,
+            Chapter,
+            Document,
+            Plot,
+            Section,
+            SimpleText,
+            Table,
+        )
+
+        return Document(
+            title="Model diagnostics",
+            chapters=[
+                Chapter("Metrics", [Section("Summary", [
+                    SimpleText("All good & well <tested>"),
+                    Table(headers=["Metric", "Value"], rows=[("AUC", 0.9)]),
+                    BulletedList(["point one", "point two"]),
+                ])]),
+                Chapter("Curves", [Section("Learning", [
+                    Plot("MSE vs portion", "% data", "MSE",
+                         series=[("train", [10, 50, 90], [1.0, 0.6, 0.5]),
+                                 ("holdout", [10, 50, 90], [1.5, 0.8, 0.6])]),
+                ])]),
+            ],
+        )
+
+    def test_html_rendering(self):
+        from photon_ml_tpu.diagnostics.reporting import render_html
+
+        html = render_html(self._document())
+        assert "<h2>1. Metrics</h2>" in html
+        assert "<h3>2.1. Learning</h3>" in html
+        assert "&amp; well &lt;tested&gt;" in html  # escaping
+        assert "<svg" in html and "polyline" in html
+        assert "<table" in html
+
+    def test_text_rendering(self):
+        from photon_ml_tpu.diagnostics.reporting import render_text
+
+        text = render_text(self._document())
+        assert "1. Metrics" in text
+        assert "[plot: MSE vs portion]" in text
+
+    def test_full_report_assembly(self, tmp_path, rng):
+        """End-to-end: all diagnostics on a small logistic fit → HTML file
+        (legacy Driver diagnose() parity)."""
+        from photon_ml_tpu.diagnostics import (
+            bootstrap_training,
+            evaluate_metrics,
+            expected_magnitude_importance,
+            hosmer_lemeshow_diagnostic,
+            prediction_error_independence,
+        )
+        from photon_ml_tpu.diagnostics.report import (
+            build_diagnostic_document,
+            write_diagnostic_report,
+        )
+
+        n, d = 600, 4
+        X = rng.normal(size=(n, d))
+        w = rng.normal(size=d)
+        z = X @ w
+        y = (1 / (1 + np.exp(-z)) > rng.random(n)).astype(float)
+
+        def train(idx):
+            from scipy.optimize import minimize
+
+            def nll(wv):
+                zz = X[idx] @ wv
+                return float(np.mean(np.logaddexp(0, zz) - y[idx] * zz))
+
+            res = minimize(nll, np.zeros(d), method="L-BFGS-B")
+            m = evaluate_metrics(X[idx] @ res.x, y[idx],
+                                 TaskType.LOGISTIC_REGRESSION)
+            return res.x, m
+
+        what, metrics = train(np.arange(n))
+        scores = X @ what
+        probs = 1 / (1 + np.exp(-scores))
+        doc = build_diagnostic_document(
+            "diag",
+            metrics=metrics,
+            bootstrap=bootstrap_training(train, n, num_samples=4, seed=2),
+            hosmer_lemeshow=hosmer_lemeshow_diagnostic(probs, y, d),
+            independence=prediction_error_independence(scores, y,
+                                                       max_items=150),
+            importance=expected_magnitude_importance(what),
+        )
+        out = write_diagnostic_report(str(tmp_path / "report"), doc)
+        html = open(out).read()
+        assert "Hosmer-Lemeshow" in html
+        assert "Bootstrap" in html
+        assert "Feature importance" in html
