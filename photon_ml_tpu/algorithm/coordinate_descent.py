@@ -63,7 +63,14 @@ class CoordinateDescent:
         self,
         num_iterations: int,
         initial_models: Optional[Dict[str, object]] = None,
+        start_iteration: int = 0,
+        initial_best: Optional[Tuple[Dict[str, object], float]] = None,
+        on_iteration_end: Optional[Callable[[int, "CoordinateDescentResult"], None]] = None,
     ) -> CoordinateDescentResult:
+        """``start_iteration``/``initial_best``/``on_iteration_end`` support
+        checkpoint-resume: the callback fires after each outer iteration with
+        the running result; resume passes the restored models and best-so-far
+        back in and skips completed iterations."""
         models: Dict[str, object] = dict(initial_models or {})
         scores: Dict[str, np.ndarray] = {}
 
@@ -81,8 +88,10 @@ class CoordinateDescent:
         validation_history: List[Tuple[str, float]] = []
         best_metric: Optional[float] = None
         best_models: Dict[str, object] = {}
+        if initial_best is not None:
+            best_models, best_metric = dict(initial_best[0]), initial_best[1]
 
-        for outer in range(num_iterations):
+        for outer in range(start_iteration, num_iterations):
             for cid in self.update_order:
                 coord = self.coordinates[cid]
                 # partialScore = fullScore - ownScore (reference
@@ -112,6 +121,18 @@ class CoordinateDescent:
                     ):
                         best_metric = metric
                         best_models = dict(models)
+
+            if on_iteration_end is not None:
+                on_iteration_end(
+                    outer,
+                    CoordinateDescentResult(
+                        models=dict(models),
+                        best_models=dict(best_models) if best_models else dict(models),
+                        best_metric=best_metric,
+                        objective_history=list(objective_history),
+                        validation_history=list(validation_history),
+                    ),
+                )
 
         if self.validate is None or not best_models:
             best_models = dict(models)

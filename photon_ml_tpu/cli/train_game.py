@@ -74,6 +74,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    choices=["NONE", "RANDOM", "BAYESIAN"])
     p.add_argument("--hyperparameter-tuning-iter", type=int, default=10)
     p.add_argument("--model-name", default="photon-ml-tpu-game")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="atomic per-outer-iteration training checkpoints; "
+                        "an existing checkpoint there is resumed")
     p.add_argument("--save-feature-stats", action="store_true",
                    help="write per-shard FeatureSummarizationResultAvro")
     p.add_argument("--log-file", default=None)
@@ -222,7 +225,11 @@ def run(args: argparse.Namespace) -> GameFit:
     )
 
     with timer.time("fit"):
-        fit = estimator.fit(data, validation_data=validation_data)
+        fit = estimator.fit(
+            data,
+            validation_data=validation_data,
+            checkpoint_dir=args.checkpoint_dir,
+        )
     for name, value in fit.objective_history:
         logger.info("objective [%s]: %.6f", name, value)
     if fit.validation_metric is not None:

@@ -296,8 +296,11 @@ def _diagnose(
 
     hl = None
     if task is TaskType.LOGISTIC_REGRESSION:
-        probs = 1.0 / (1.0 + np.exp(-scores))
-        hl = hosmer_lemeshow_diagnostic(probs, data.labels, len(imap))
+        from photon_ml_tpu.diagnostics.evaluation import _sigmoid
+
+        hl = hosmer_lemeshow_diagnostic(
+            _sigmoid(scores), data.labels, len(imap)
+        )
 
     summary = summarize(labeled)
     doc = build_diagnostic_document(

@@ -88,11 +88,11 @@ def kendall_tau_analysis(
         a, b = a[idx], b[idx]
         n = max_items
 
-    # sort by a (b shuffled for ties in a to avoid order bias), then count
-    # discordant pairs as inversions in b
+    # lexsort by (a, then b): within tied-a runs b is ascending, so those
+    # pairs contribute no inversions — discordant pairs are exactly the
+    # inversions of b in this order
     order = np.lexsort((b, a))
     b_sorted = b[order]
-    a_sorted = a[order]
     num_pairs = n * (n - 1) // 2
     ties_a = _tie_pairs(a)
     ties_b = _tie_pairs(b)

@@ -173,11 +173,57 @@ class GameEstimator:
                 )
         return meta
 
+    @staticmethod
+    def _check_resume_compatible(
+        models: Dict[str, object], coordinates: Dict[str, Coordinate]
+    ) -> None:
+        """Fail fast (with a clear message) when a checkpoint's layout does
+        not match the datasets rebuilt from the current data/config."""
+        from photon_ml_tpu.models.glm import GeneralizedLinearModel
+        from photon_ml_tpu.models.random_effect import RandomEffectModel
+
+        problems = []
+        for cid, model in models.items():
+            coord = coordinates.get(cid)
+            if coord is None:
+                problems.append(f"{cid}: not in current configuration")
+                continue
+            if isinstance(model, GeneralizedLinearModel):
+                want = coord.data.dim
+                if model.dim != want:
+                    problems.append(
+                        f"{cid}: checkpoint dim {model.dim} != data dim {want}"
+                    )
+            else:
+                latent = getattr(model, "latent", model)
+                if not isinstance(latent, RandomEffectModel):
+                    continue
+                ds = coord.dataset
+                if latent.entity_ids != ds.entity_ids:
+                    problems.append(
+                        f"{cid}: checkpoint entity layout differs from the "
+                        "dataset rebuilt from the current data/config"
+                    )
+        if set(coordinates) - set(models):
+            missing = sorted(set(coordinates) - set(models))
+            problems.append(f"coordinates missing from checkpoint: {missing}")
+        if problems:
+            raise ValueError(
+                "checkpoint is incompatible with this run — it was written "
+                "for different data or configuration:\n  "
+                + "\n  ".join(problems)
+            )
+
     def fit(
         self,
         data: GameData,
         validation_data: Optional[GameData] = None,
+        checkpoint_dir: Optional[str] = None,
     ) -> GameFit:
+        """With ``checkpoint_dir``, training state is written atomically
+        after every outer CD iteration and an existing checkpoint there is
+        resumed automatically (skipping completed iterations) — see
+        photon_ml_tpu.checkpoint."""
         coordinates = {
             cid: self._build_coordinate(cid, cfg, data)
             for cid, cfg in self.coordinate_configs.items()
@@ -211,11 +257,64 @@ class GameEstimator:
             validate=validate,
             validation_better_than=self.evaluator.better_than,
         )
-        result = cd.run(self.num_outer_iterations)
+
+        initial_models = None
+        start_iteration = 0
+        initial_best = None
+        on_iteration_end = None
+        prior_objective_history: List[Tuple[str, float]] = []
+        prior_validation_history: List[Tuple[str, float]] = []
+        if checkpoint_dir is not None:
+            from photon_ml_tpu import checkpoint as ckpt
+
+            if ckpt.has_checkpoint(checkpoint_dir):
+                initial_models, state, best = ckpt.load_training_checkpoint(
+                    checkpoint_dir
+                )
+                self._check_resume_compatible(initial_models, coordinates)
+                start_iteration = int(state["completed_iterations"])
+                if best is not None and state.get("best_metric") is not None:
+                    initial_best = (best, float(state["best_metric"]))
+                prior_objective_history = [
+                    tuple(x) for x in state.get("objective_history", [])
+                ]
+                prior_validation_history = [
+                    tuple(x) for x in state.get("validation_history", [])
+                ]
+                logger.info(
+                    "resuming from checkpoint %s at outer iteration %d",
+                    checkpoint_dir, start_iteration,
+                )
+
+            def on_iteration_end(outer: int, running) -> None:
+                ckpt.save_training_checkpoint(
+                    checkpoint_dir,
+                    running.models,
+                    state={
+                        "completed_iterations": outer + 1,
+                        "best_metric": running.best_metric,
+                        # full histories so a second resume stays complete
+                        "objective_history": prior_objective_history
+                        + running.objective_history,
+                        "validation_history": prior_validation_history
+                        + running.validation_history,
+                    },
+                    best_models=(
+                        running.best_models if validate is not None else None
+                    ),
+                )
+
+        result = cd.run(
+            self.num_outer_iterations,
+            initial_models=initial_models,
+            start_iteration=start_iteration,
+            initial_best=initial_best,
+            on_iteration_end=on_iteration_end,
+        )
         model = GameModel(models=result.best_models, meta=meta, task=self.task)
         return GameFit(
             model=model,
             validation_metric=result.best_metric,
-            objective_history=result.objective_history,
-            validation_history=result.validation_history,
+            objective_history=prior_objective_history + result.objective_history,
+            validation_history=prior_validation_history + result.validation_history,
         )
