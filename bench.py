@@ -71,7 +71,9 @@ def _build():
     return (ell_vals, ell_idx, y), fe_data, (re_x, re_y), re_data
 
 
-def _tpu_run(fe_data, re_data):
+def _tpu_run(fe_data, re_data, use_pallas: bool = False):
+    import os
+
     import jax
     import jax.numpy as jnp
 
@@ -79,6 +81,10 @@ def _tpu_run(fe_data, re_data):
     from photon_ml_tpu.losses.pointwise import LogisticLoss
     from photon_ml_tpu.opt.config import GlmOptimizationConfiguration, OptimizerConfig
     from photon_ml_tpu.opt.solve import solve
+    from photon_ml_tpu.ops import pallas_kernels
+
+    os.environ["PHOTON_ML_TPU_PALLAS"] = "1" if use_pallas else "0"
+    pallas_kernels.enabled.cache_clear()
 
     objective = make_glm_objective(LogisticLoss)
     cfg = GlmOptimizationConfiguration(
@@ -153,8 +159,30 @@ def _cpu_baseline(fe_np, re_np, fe_iters, re_iters):
 
 
 def main():
+    import sys
+
     fe_np, fe_data, re_np, re_data = _build()
     passes, tpu_time, fe_iters, re_iters = _tpu_run(fe_data, re_data)
+
+    # A/B the fused pallas kernels (dense RE inner loop) on real TPU; keep
+    # whichever path is faster. Any pallas failure falls back silently.
+    from photon_ml_tpu.ops.pallas_kernels import pallas_available
+
+    if pallas_available():
+        try:
+            p_passes, p_time, p_fe, p_re = _tpu_run(
+                fe_data, re_data, use_pallas=True
+            )
+            print(
+                f"pallas A/B: xla={passes / tpu_time:.0f} "
+                f"pallas={p_passes / p_time:.0f} passes/s",
+                file=sys.stderr,
+            )
+            if p_passes / p_time > passes / tpu_time:
+                passes, tpu_time, fe_iters, re_iters = p_passes, p_time, p_fe, p_re
+        except Exception as e:  # pragma: no cover
+            print(f"pallas path failed, using XLA: {e}", file=sys.stderr)
+
     cpu_time = _cpu_baseline(fe_np, re_np, fe_iters, re_iters)
     value = passes / tpu_time
     print(

@@ -28,10 +28,32 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.normalization import NormalizationContext
-from photon_ml_tpu.losses.pointwise import PointwiseLoss
+from photon_ml_tpu.losses.pointwise import (
+    LogisticLoss,
+    PointwiseLoss,
+    PoissonLoss,
+    SquaredLoss,
+)
 from photon_ml_tpu.ops.data import LabeledData
+from photon_ml_tpu.ops.features import DenseFeatures
 
 _IDENTITY_NORM = NormalizationContext()
+
+# Pallas fused kernel (ops/pallas_kernels.py) loss-kind mapping; losses not
+# listed (smoothed hinge) use the XLA path.
+_PALLAS_KIND = {
+    LogisticLoss: "logistic",
+    SquaredLoss: "squared",
+    PoissonLoss: "poisson",
+}
+
+
+def _pallas_kind_for(loss: Type[PointwiseLoss]):
+    from photon_ml_tpu.ops import pallas_kernels
+
+    if not pallas_kernels.enabled():
+        return None
+    return _PALLAS_KIND.get(loss)
 
 
 def _norm_of(data: LabeledData) -> NormalizationContext:
@@ -68,10 +90,29 @@ def make_glm_objective(loss: Type[PointwiseLoss]) -> GlmObjective:
         loss_sum = jnp.sum(_wmask(data.weights, loss.value(z, data.labels)))
         return loss_sum + 0.5 * l2 * jnp.dot(w, w)
 
+    pallas_kind = _pallas_kind_for(loss)
+
     def value_and_grad(
         w: jax.Array, data: LabeledData, l2: jax.Array
     ) -> Tuple[jax.Array, jax.Array]:
         norm = _norm_of(data)
+        if (
+            pallas_kind is not None
+            and isinstance(data.features, DenseFeatures)
+            and data.features.matrix.ndim == 2
+            and norm.is_identity
+        ):
+            # fused MXU kernel: one HBM pass over X for value + gradient
+            # (None => problem too large for the chip-local kernel; use XLA)
+            from photon_ml_tpu.ops.pallas_kernels import fused_value_grad_auto
+
+            fused = fused_value_grad_auto(
+                data.features.matrix, data.labels, data.offsets,
+                data.weights, w, kind=pallas_kind,
+            )
+            if fused is not None:
+                loss_sum, raw, _ = fused
+                return loss_sum + 0.5 * l2 * jnp.dot(w, w), raw + l2 * w
         z = margins(w, data)
         loss_sum = jnp.sum(_wmask(data.weights, loss.value(z, data.labels)))
         c = _wmask(data.weights, loss.d1(z, data.labels))
