@@ -72,8 +72,6 @@ def _build():
 
 
 def _tpu_run(fe_data, re_data, use_pallas: bool = False):
-    import os
-
     import jax
     import jax.numpy as jnp
 
@@ -81,12 +79,8 @@ def _tpu_run(fe_data, re_data, use_pallas: bool = False):
     from photon_ml_tpu.losses.pointwise import LogisticLoss
     from photon_ml_tpu.opt.config import GlmOptimizationConfiguration, OptimizerConfig
     from photon_ml_tpu.opt.solve import solve
-    from photon_ml_tpu.ops import pallas_kernels
 
-    os.environ["PHOTON_ML_TPU_PALLAS"] = "1" if use_pallas else "0"
-    pallas_kernels.enabled.cache_clear()
-
-    objective = make_glm_objective(LogisticLoss)
+    objective = make_glm_objective(LogisticLoss, use_pallas=use_pallas)
     cfg = GlmOptimizationConfiguration(
         optimizer_config=OptimizerConfig.lbfgs(max_iterations=50),
         regularization_weight=1.0,

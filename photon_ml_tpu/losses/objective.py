@@ -28,32 +28,11 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.normalization import NormalizationContext
-from photon_ml_tpu.losses.pointwise import (
-    LogisticLoss,
-    PointwiseLoss,
-    PoissonLoss,
-    SquaredLoss,
-)
+from photon_ml_tpu.losses.pointwise import PointwiseLoss
 from photon_ml_tpu.ops.data import LabeledData
 from photon_ml_tpu.ops.features import DenseFeatures
 
 _IDENTITY_NORM = NormalizationContext()
-
-# Pallas fused kernel (ops/pallas_kernels.py) loss-kind mapping; losses not
-# listed (smoothed hinge) use the XLA path.
-_PALLAS_KIND = {
-    LogisticLoss: "logistic",
-    SquaredLoss: "squared",
-    PoissonLoss: "poisson",
-}
-
-
-def _pallas_kind_for(loss: Type[PointwiseLoss]):
-    from photon_ml_tpu.ops import pallas_kernels
-
-    if not pallas_kernels.enabled():
-        return None
-    return _PALLAS_KIND.get(loss)
 
 
 def _norm_of(data: LabeledData) -> NormalizationContext:
@@ -74,7 +53,12 @@ class GlmObjective(NamedTuple):
     has_hessian: bool
 
 
-def make_glm_objective(loss: Type[PointwiseLoss]) -> GlmObjective:
+def make_glm_objective(
+    loss: Type[PointwiseLoss], use_pallas: bool = None
+) -> GlmObjective:
+    """``use_pallas``: route eligible dense problems through the fused
+    pallas kernel; None (default) defers to the PHOTON_ML_TPU_PALLAS flag
+    (ops/pallas_kernels.enabled), read once at objective construction."""
     def margins(w: jax.Array, data: LabeledData) -> jax.Array:
         norm = _norm_of(data)
         ew = norm.effective_coefficients(w)
@@ -90,14 +74,17 @@ def make_glm_objective(loss: Type[PointwiseLoss]) -> GlmObjective:
         loss_sum = jnp.sum(_wmask(data.weights, loss.value(z, data.labels)))
         return loss_sum + 0.5 * l2 * jnp.dot(w, w)
 
-    pallas_kind = _pallas_kind_for(loss)
+    if use_pallas is None:
+        from photon_ml_tpu.ops import pallas_kernels
+
+        use_pallas = pallas_kernels.enabled()
 
     def value_and_grad(
         w: jax.Array, data: LabeledData, l2: jax.Array
     ) -> Tuple[jax.Array, jax.Array]:
         norm = _norm_of(data)
         if (
-            pallas_kind is not None
+            use_pallas
             and isinstance(data.features, DenseFeatures)
             and data.features.matrix.ndim == 2
             and norm.is_identity
@@ -108,7 +95,7 @@ def make_glm_objective(loss: Type[PointwiseLoss]) -> GlmObjective:
 
             fused = fused_value_grad_auto(
                 data.features.matrix, data.labels, data.offsets,
-                data.weights, w, kind=pallas_kind,
+                data.weights, w, kind=loss,
             )
             if fused is not None:
                 loss_sum, raw, _ = fused

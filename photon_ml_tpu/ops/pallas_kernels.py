@@ -34,23 +34,12 @@ except ImportError:  # pragma: no cover
 ROW_BLOCK = 256
 LANE = 128
 
-_LOSSES = ("logistic", "squared", "poisson")
 
-
-def _loss_terms(kind: str, z, y):
-    """(l(z,y), dl/dz) on the VPU; formulas mirror losses/pointwise.py."""
-    if kind == "logistic":
-        l = jnp.logaddexp(0.0, z) - y * z
-        d1 = jax.nn.sigmoid(z) - y
-    elif kind == "squared":
-        delta = z - y
-        l = 0.5 * delta * delta
-        d1 = delta
-    else:  # poisson
-        ez = jnp.exp(z)
-        l = ez - y * z
-        d1 = ez - y
-    return l, d1
+def _loss_terms(kind, z, y):
+    """(l(z,y), dl/dz) on the VPU. ``kind`` is a PointwiseLoss class (its
+    value/d1 are pure elementwise jnp, valid inside a kernel) — one source
+    of truth with the XLA objective."""
+    return kind.value(z, y), kind.d1(z, y)
 
 
 def _kernel(kind: str, x_ref, y_ref, off_ref, wt_ref, w_ref,
@@ -103,13 +92,13 @@ def fused_value_grad(
     offsets: jax.Array,   # [n]
     weights: jax.Array,   # [n]
     w: jax.Array,         # [d]
-    kind: str = "logistic",
+    kind=None,  # PointwiseLoss class (static); required
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One-pass (Σ wᵢ·l, Σ wᵢ·l′·xᵢ, Σ wᵢ·l′) — loss sum, gradient, and the
     coefficient sum the normalization shift path needs."""
-    if kind not in _LOSSES:
-        raise ValueError(f"unknown loss kind: {kind}")
+    if kind is None:
+        raise ValueError("kind (a PointwiseLoss class) is required")
     n, d = matrix.shape
     x = _pad_to(_pad_to(matrix, 0, ROW_BLOCK), 1, LANE)
     np_, dp = x.shape
@@ -177,12 +166,12 @@ def fused_value_grad_single(
     offsets: jax.Array,   # [s]
     weights: jax.Array,   # [s]
     w: jax.Array,         # [d]
-    kind: str = "logistic",
+    kind=None,  # PointwiseLoss class (static); required
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Single-block fused pass; vmap-safe (use for per-entity solves)."""
-    if kind not in _LOSSES:
-        raise ValueError(f"unknown loss kind: {kind}")
+    if kind is None:
+        raise ValueError("kind (a PointwiseLoss class) is required")
     s, d = matrix.shape
     x = _pad_to(_pad_to(matrix, 0, 8), 1, LANE)
     sp, dp = x.shape

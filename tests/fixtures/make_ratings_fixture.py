@@ -1,0 +1,94 @@
+"""Generate the golden movie-ratings fixture (run once; outputs committed).
+
+The reference ships a Yahoo! Music ratings fixture
+(photon-client/src/integTest/resources/GameIntegTest/input/train/
+yahoo-music-train.avro) and asserts captured RMSE thresholds against it
+(DriverTest.scala:84-98 et al.). This is the equivalent: a deterministic
+synthetic ratings problem with global (genre), per-user, and per-movie
+structure, written as TrainingExampleAvro.
+
+    python tests/fixtures/make_ratings_fixture.py
+
+Regenerating changes nothing (seeded); thresholds live in
+tests/test_golden_fixture.py.
+"""
+
+import os
+
+import numpy as np
+
+N_USERS = 40
+N_MOVIES = 60
+N_GENRES = 8
+RATINGS_PER_USER = 30
+NOISE = 0.3
+SEED = 20260729
+
+
+def generate(seed=SEED):
+    rng = np.random.default_rng(seed)
+    genre_w = rng.normal(size=N_GENRES) * 0.8              # global taste
+    movie_genres = rng.dirichlet(np.ones(N_GENRES) * 0.5, size=N_MOVIES)
+    movie_bias = rng.normal(size=N_MOVIES) * 0.6
+    user_bias = rng.normal(size=N_USERS) * 0.5
+    user_genre_w = rng.normal(size=(N_USERS, N_GENRES)) * 0.7  # per-user taste
+
+    records = []
+    for u in range(N_USERS):
+        movies = rng.choice(N_MOVIES, size=RATINGS_PER_USER, replace=False)
+        for m in movies:
+            x = movie_genres[m]
+            rating = (
+                3.0
+                + x @ genre_w
+                + x @ user_genre_w[u]
+                + movie_bias[m]
+                + user_bias[u]
+                + NOISE * rng.normal()
+            )
+            records.append(
+                {
+                    "uid": f"u{u:03d}-m{m:03d}",
+                    "label": float(rating),
+                    "features": [
+                        ("genre", str(g), float(x[g]))
+                        for g in range(N_GENRES)
+                        if x[g] > 1e-6
+                    ],
+                    "userFeatures": [
+                        ("genre", str(g), float(x[g]))
+                        for g in range(N_GENRES)
+                        if x[g] > 1e-6
+                    ] + [("userBias", "", 1.0)],
+                    "movieFeatures": [("movieBias", "", 1.0)],
+                    "metadataMap": {"userId": f"u{u:03d}", "movieId": f"m{m:03d}"},
+                }
+            )
+    rng.shuffle(records)
+    return records
+
+
+def main():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    records = generate()
+    n_train = int(0.8 * len(records))
+    train_dir = os.path.join(here, "ratings", "train")
+    test_dir = os.path.join(here, "ratings", "test")
+    os.makedirs(train_dir, exist_ok=True)
+    os.makedirs(test_dir, exist_ok=True)
+    write_training_examples(
+        os.path.join(train_dir, "part-00000.avro"), records[:n_train]
+    )
+    write_training_examples(
+        os.path.join(test_dir, "part-00000.avro"), records[n_train:]
+    )
+    print(f"wrote {n_train} train / {len(records) - n_train} test records")
+
+
+if __name__ == "__main__":
+    main()

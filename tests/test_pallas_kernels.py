@@ -8,10 +8,13 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu.losses.pointwise import LogisticLoss, PoissonLoss, SquaredLoss
 from photon_ml_tpu.ops.pallas_kernels import (
     fused_value_grad,
     fused_value_grad_single,
 )
+
+_LOSS = {"logistic": LogisticLoss, "squared": SquaredLoss, "poisson": PoissonLoss}
 
 
 def _reference(kind, X, y, off, wt, w):
@@ -47,7 +50,7 @@ def test_fused_matches_reference(rng, kind, variant):
         y = rng.normal(size=n).astype(np.float32)
 
     fn = fused_value_grad if variant == "blocked" else fused_value_grad_single
-    val, grad, csum = fn(X, y, off, wt, w, kind=kind, interpret=True)
+    val, grad, csum = fn(X, y, off, wt, w, kind=_LOSS[kind], interpret=True)
     rv, rg, rc = _reference(kind, X, y, off, wt, w)
     assert float(val) == pytest.approx(rv, rel=2e-4)
     np.testing.assert_allclose(np.asarray(grad), rg, rtol=2e-3, atol=2e-3)
@@ -62,7 +65,7 @@ def test_blocked_multi_block_accumulation(rng):
     y = (rng.random(n) > 0.5).astype(np.float32)
     z = np.zeros(n, dtype=np.float32)
     wt = np.ones(n, dtype=np.float32)
-    val, grad, csum = fused_value_grad(X, y, z, wt, w, kind="logistic",
+    val, grad, csum = fused_value_grad(X, y, z, wt, w, kind=LogisticLoss,
                                        interpret=True)
     rv, rg, rc = _reference("logistic", X, y, z, wt, w)
     assert float(val) == pytest.approx(rv, rel=2e-4)
@@ -80,7 +83,7 @@ def test_single_kernel_vmaps(rng):
 
     batched = jax.vmap(
         lambda Xi, yi, oi, wti, wi: fused_value_grad_single(
-            Xi, yi, oi, wti, wi, kind="logistic", interpret=True
+            Xi, yi, oi, wti, wi, kind=LogisticLoss, interpret=True
         )
     )
     vals, grads, csums = batched(X, y, off, wt, w)
@@ -107,7 +110,7 @@ def test_native_tpu_lowering(variant, n, d):
         jax.ShapeDtypeStruct((n,), jnp.float32),
         jax.ShapeDtypeStruct((d,), jnp.float32),
     )
-    f = jax.jit(functools.partial(fn, kind="logistic", interpret=False))
+    f = jax.jit(functools.partial(fn, kind=LogisticLoss, interpret=False))
     exported = jax.export.export(f, platforms=["tpu"])(*args)
     assert len(exported.mlir_module()) > 0
 
@@ -119,7 +122,7 @@ def test_single_kernel_native_lowering_under_vmap():
     E, s, d = 4, 24, 10
     f = jax.vmap(
         functools.partial(
-            fused_value_grad_single, kind="logistic", interpret=False
+            fused_value_grad_single, kind=LogisticLoss, interpret=False
         )
     )
     args = (
