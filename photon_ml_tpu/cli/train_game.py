@@ -58,6 +58,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     )
     p.add_argument("--train-data-dirs", nargs="+", required=True)
     p.add_argument("--validation-data-dirs", nargs="*", default=[])
+    p.add_argument("--train-date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd; expands each data dir to its "
+                        "daily yyyy/MM/dd subdirs (reference "
+                        "--train-date-range)")
+    p.add_argument("--train-date-days-ago", default=None,
+                   help="start-end days ago, e.g. 90-1")
     p.add_argument("--coordinate-config", required=True,
                    help="typed JSON config: feature shards + coordinates")
     p.add_argument("--task", required=True,
@@ -145,10 +151,20 @@ def run(args: argparse.Namespace) -> GameFit:
     with timer.time("prepare feature maps"):
         index_maps = load_index_maps(args.offheap_indexmap_dir, shard_configs)
 
+    from photon_ml_tpu.utils.date_range import paths_for_date_range
+
+    train_dirs = paths_for_date_range(
+        args.train_data_dirs, args.train_date_range, args.train_date_days_ago
+    )
+    if not train_dirs:
+        raise FileNotFoundError(
+            f"no input dirs in date range under {args.train_data_dirs}"
+        )
+
     id_tags = id_tags_needed(coordinates)
     with timer.time("read training data"):
         data, index_maps, _ = read_game_data(
-            args.train_data_dirs, shard_configs, index_maps, id_tags=id_tags
+            train_dirs, shard_configs, index_maps, id_tags=id_tags
         )
     logger.info("training rows: %d", data.num_rows)
 

@@ -55,6 +55,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--validation-data-dirs", nargs="*", default=[])
     p.add_argument("--task", required=True, choices=[t.name for t in TaskType])
     p.add_argument("--output-dir", required=True)
+    p.add_argument("--input-format", default="AVRO",
+                   choices=["AVRO", "LIBSVM"],
+                   help="TRAINING_EXAMPLE avro or LibSVM text (reference "
+                        "InputFormatFactory / LibSVMInputDataFormat)")
     p.add_argument("--feature-bags", nargs="+", default=["features"])
     p.add_argument("--add-intercept", dest="add_intercept",
                    action="store_true", default=True)
@@ -118,10 +122,22 @@ def run(args: argparse.Namespace) -> dict:
     }
 
     with timer.time("preprocess"):
-        data, index_maps, _ = read_game_data(
-            args.training_data_dirs, shard_cfg
-        )
-        imap = index_maps["features"]
+        if args.input_format == "LIBSVM":
+            from photon_ml_tpu.io.libsvm import read_libsvm
+
+            if len(args.training_data_dirs) > 1:
+                raise ValueError("LIBSVM input takes a single path")
+            data, imap = read_libsvm(
+                args.training_data_dirs[0],
+                use_intercept=args.add_intercept,
+                binarize_labels=task.is_classification,
+            )
+            index_maps = {"features": imap}
+        else:
+            data, index_maps, _ = read_game_data(
+                args.training_data_dirs, shard_cfg
+            )
+            imap = index_maps["features"]
         labeled = _labeled_from_game(data, "features")
         validate_labeled_data(
             labeled, task, DataValidationType[args.data_validation]
@@ -175,9 +191,21 @@ def run(args: argparse.Namespace) -> dict:
     best_lambda = None
     if args.validation_data_dirs:
         with timer.time("validate"):
-            vdata, _, _ = read_game_data(
-                args.validation_data_dirs, shard_cfg, index_maps
-            )
+            if args.input_format == "LIBSVM":
+                from photon_ml_tpu.io.libsvm import read_libsvm
+
+                vdata, _ = read_libsvm(
+                    args.validation_data_dirs[0],
+                    feature_dimension=(
+                        len(imap) - 1 if args.add_intercept else len(imap)
+                    ),
+                    use_intercept=args.add_intercept,
+                    binarize_labels=task.is_classification,
+                )
+            else:
+                vdata, _, _ = read_game_data(
+                    args.validation_data_dirs, shard_cfg, index_maps
+                )
             vfeats = vdata.ell_features("features")
             for fit in fits:
                 scores = np.asarray(

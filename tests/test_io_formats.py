@@ -1,0 +1,222 @@
+"""LibSVM input format + date-range path expansion tests (reference
+LibSVMInputDataFormat / libsvm converter script / DateRangeTest +
+pathsForDateRange)."""
+
+import datetime
+import os
+
+import numpy as np
+import pytest
+
+
+A1A_SAMPLE = """\
+-1 3:1 11:1 14:1 19:1 39:1
++1 5:1 7:1 14:1 19:1 39:1
+-1 2:1 11:1
++1 5:1 11:1 14:1
+"""
+
+
+class TestLibSVM:
+    def test_read_libsvm(self, tmp_path):
+        from photon_ml_tpu.io.libsvm import read_libsvm
+
+        p = tmp_path / "a1a.txt"
+        p.write_text(A1A_SAMPLE)
+        data, imap = read_libsvm(str(p), feature_dimension=123)
+        assert data.num_rows == 4
+        np.testing.assert_array_equal(data.labels, [0, 1, 0, 1])
+        s = data.feature_shards["features"]
+        assert s.dim == 124  # 123 + intercept
+        # 1-based index 3 -> column 2
+        r0 = s.cols[s.rows == 0]
+        assert 2 in r0 and 123 in r0  # feature + intercept
+        assert imap.get_index("2") == 2
+
+    def test_zero_based_and_no_intercept(self, tmp_path):
+        from photon_ml_tpu.io.libsvm import read_libsvm
+
+        p = tmp_path / "d.txt"
+        p.write_text("1 0:2.5 3:1\n-1 1:1\n")
+        data, imap = read_libsvm(str(p), zero_based=True, use_intercept=False)
+        s = data.feature_shards["features"]
+        assert s.dim == 4
+        assert s.vals[(s.rows == 0) & (s.cols == 0)][0] == pytest.approx(2.5)
+
+    def test_regression_labels_kept(self, tmp_path):
+        from photon_ml_tpu.io.libsvm import read_libsvm
+
+        p = tmp_path / "r.txt"
+        p.write_text("2.5 1:1\n-0.5 1:2\n")
+        data, _ = read_libsvm(str(p), binarize_labels=False)
+        np.testing.assert_allclose(data.labels, [2.5, -0.5])
+
+    def test_converter_round_trip(self, tmp_path):
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_game_data,
+        )
+        from photon_ml_tpu.io.libsvm import libsvm_to_training_example_avro
+
+        p = tmp_path / "a1a.txt"
+        p.write_text(A1A_SAMPLE)
+        out = tmp_path / "a1a.avro"
+        n = libsvm_to_training_example_avro(str(p), str(out))
+        assert n == 4
+        data, maps, _ = read_game_data(
+            str(out),
+            {"features": FeatureShardConfiguration(["features"], add_intercept=False)},
+        )
+        assert data.num_rows == 4
+        np.testing.assert_array_equal(data.labels, [0, 1, 0, 1])
+
+    def test_train_glm_libsvm_end_to_end(self, tmp_path, rng):
+        """Legacy driver over LibSVM input — the BASELINE config-1 shape."""
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        n, d = 300, 20
+        X = (rng.random((n, d)) < 0.15).astype(np.float32)
+        w = rng.normal(size=d + 1).astype(np.float32)
+        z = X @ w[:d] + w[d]
+        y = np.where(1 / (1 + np.exp(-z)) > rng.random(n), 1, -1)
+
+        def fmt(split):
+            lines = []
+            for i in split:
+                items = " ".join(
+                    f"{j + 1}:1" for j in np.flatnonzero(X[i])
+                )
+                lines.append(f"{y[i]:+d} {items}")
+            return "\n".join(lines) + "\n"
+
+        (tmp_path / "train.txt").write_text(fmt(range(0, 240)))
+        (tmp_path / "test.txt").write_text(fmt(range(240, 300)))
+        result = run(parse_args([
+            "--training-data-dirs", str(tmp_path / "train.txt"),
+            "--validation-data-dirs", str(tmp_path / "test.txt"),
+            "--input-format", "LIBSVM",
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(tmp_path / "out"),
+            "--regularization-weights", "0.1", "10",
+        ]))
+        assert result["metrics"][result["best_lambda"]] > 0.6  # AUC
+
+
+class TestDateRange:
+    def test_parse_and_iterate(self):
+        from photon_ml_tpu.utils.date_range import DateRange
+
+        r = DateRange.from_dates("20260128-20260202")
+        days = list(r.days())
+        assert len(days) == 6
+        assert days[0] == datetime.date(2026, 1, 28)
+        assert days[-1] == datetime.date(2026, 2, 2)
+        with pytest.raises(ValueError):
+            DateRange.from_dates("20260202-20260128")
+        with pytest.raises(ValueError):
+            DateRange.from_dates("garbage")
+
+    def test_days_ago(self):
+        from photon_ml_tpu.utils.date_range import DateRange
+
+        today = datetime.date(2026, 7, 29)
+        r = DateRange.from_days_ago("3-1", today=today)
+        assert r.start_date == datetime.date(2026, 7, 26)
+        assert r.end_date == datetime.date(2026, 7, 28)
+        with pytest.raises(ValueError):
+            DateRange.from_days_ago("1-3", today=today)  # inverted
+
+    def test_path_expansion(self, tmp_path):
+        from photon_ml_tpu.utils.date_range import paths_for_date_range
+
+        base = tmp_path / "data"
+        for day in ("2026/01/30", "2026/01/31", "2026/02/02"):
+            (base / day).mkdir(parents=True)
+        got = paths_for_date_range([str(base)], "20260129-20260202")
+        assert [os.path.relpath(p, base) for p in got] == [
+            os.path.join("2026", "01", "30"),
+            os.path.join("2026", "01", "31"),
+            os.path.join("2026", "02", "02"),
+        ]
+        # no spec: base dirs unchanged
+        assert paths_for_date_range([str(base)]) == [str(base)]
+        with pytest.raises(ValueError):
+            paths_for_date_range([str(base)], "20260101-20260102", "3-1")
+
+    def test_train_game_with_date_range(self, tmp_path, rng):
+        from photon_ml_tpu.io.data_reader import write_training_examples
+        import json
+
+        day_dir = tmp_path / "data" / "2026" / "07" / "28"
+        day_dir.mkdir(parents=True)
+        recs = [
+            {"label": float(i % 2),
+             "features": [("f", str(j), float(rng.normal())) for j in range(4)]}
+            for i in range(100)
+        ]
+        write_training_examples(str(day_dir / "part-00000.avro"), recs)
+        cfg = tmp_path / "g.json"
+        cfg.write_text(json.dumps({
+            "feature_shards": {"g": {"feature_bags": ["features"]}},
+            "coordinates": {"fixed": {"type": "fixed", "feature_shard": "g"}},
+        }))
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        fit = run(parse_args([
+            "--train-data-dirs", str(tmp_path / "data"),
+            "--train-date-range", "20260727-20260729",
+            "--coordinate-config", str(cfg),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(tmp_path / "out"),
+        ]))
+        assert fit.model is not None
+        with pytest.raises(FileNotFoundError):
+            run(parse_args([
+                "--train-data-dirs", str(tmp_path / "data"),
+                "--train-date-range", "20250101-20250102",
+                "--coordinate-config", str(cfg),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(tmp_path / "out2"),
+            ]))
+
+
+class TestLibSVMEdgeCases:
+    def test_validation_features_beyond_training_dim_dropped(self, tmp_path):
+        """a1a-style: the test split has indices the train split never saw —
+        they must be dropped, not crash (scoring-over-fixed-index
+        semantics)."""
+        from photon_ml_tpu.io.libsvm import read_libsvm
+
+        p = tmp_path / "v.txt"
+        p.write_text("+1 1:1 500:1\n-1 2:1\n")
+        data, _ = read_libsvm(str(p), feature_dimension=10)
+        s = data.feature_shards["features"]
+        assert s.dim == 11
+        assert s.cols.max() == 10  # intercept; 500 dropped
+
+    def test_directory_with_marker_files(self, tmp_path):
+        from photon_ml_tpu.io.libsvm import read_libsvm
+
+        d = tmp_path / "data"
+        d.mkdir()
+        (d / "part-0").write_text("+1 1:1\n")
+        (d / "_SUCCESS").write_text("")
+        (d / "subdir").mkdir()
+        data, _ = read_libsvm(str(d))
+        assert data.num_rows == 1
+
+    def test_svm_task_binarizes(self, tmp_path, rng):
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        p = tmp_path / "t.txt"
+        lines = [f"{'+1' if rng.random() > 0.5 else '-1'} {i % 5 + 1}:1"
+                 for i in range(60)]
+        p.write_text("\n".join(lines) + "\n")
+        result = run(parse_args([
+            "--training-data-dirs", str(p),
+            "--input-format", "LIBSVM",
+            "--task", "SMOOTHED_HINGE_LOSS_LINEAR_SVM",
+            "--output-dir", str(tmp_path / "out"),
+            "--regularization-weights", "1.0",
+        ]))
+        assert result["fits"]
