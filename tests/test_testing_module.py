@@ -1,0 +1,107 @@
+"""Tests for the public testing/generator module (photon-test-utils
+parity): regime properties, label validity per task, factory shapes, and
+that the generators compose with validators and estimators."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import testing as ptest
+from photon_ml_tpu.types import TaskType
+
+
+class TestDrawSample:
+    @pytest.mark.parametrize("task", list(TaskType))
+    def test_benign(self, task):
+        X, y, w = ptest.draw_sample(task, n=150, d=8, seed=1)
+        assert X.shape == (150, 8) and np.isfinite(X).all()
+        if task.is_classification:
+            assert set(np.unique(y)) <= {0.0, 1.0}
+            assert 0.1 < y.mean() < 0.9  # roughly balanced
+        if task is TaskType.POISSON_REGRESSION:
+            assert (y >= 0).all()
+
+    def test_outlier_regime_is_ill_conditioned(self):
+        X, _, _ = ptest.draw_sample(
+            TaskType.LINEAR_REGRESSION, n=300, d=6, regime="outlier", seed=2
+        )
+        assert np.isfinite(X).all()
+        col_scale = np.abs(X).max(axis=0)
+        assert col_scale.max() / max(col_scale.min(), 1e-30) > 1e4
+
+    def test_invalid_regime_fails_validation(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.validators import (
+            DataValidationError,
+            validate_labeled_data,
+        )
+        from photon_ml_tpu.ops.data import LabeledData
+        from photon_ml_tpu.ops.features import DenseFeatures
+
+        X, y, _ = ptest.draw_sample(
+            TaskType.LINEAR_REGRESSION, n=100, d=5, regime="invalid", seed=3
+        )
+        assert not np.isfinite(X).all()
+        data = LabeledData.create(
+            DenseFeatures(matrix=jnp.asarray(X)), jnp.asarray(y)
+        )
+        with pytest.raises(DataValidationError):
+            validate_labeled_data(data, TaskType.LINEAR_REGRESSION)
+
+    @pytest.mark.parametrize("task", list(TaskType))
+    def test_invalid_labels(self, task):
+        y = ptest.draw_invalid_labels(task, n=80, seed=4)
+        if task is TaskType.POISSON_REGRESSION:
+            assert (y < 0).any()
+        elif task.is_classification:
+            assert ((y != 0) & (y != 1)).any()
+        else:
+            assert np.isnan(y).any()
+
+
+class TestFactories:
+    def test_fixed_effect_data_trains(self):
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+        )
+
+        data, w_true = ptest.generate_fixed_effect_data(
+            TaskType.LOGISTIC_REGRESSION, n=300, d=8, seed=5
+        )
+        fit = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinates={"g": FixedEffectCoordinateConfiguration("global")},
+        ).fit(data)
+        scores = fit.model.score(data)
+        acc = ((scores > 0) == (data.labels > 0.5)).mean()
+        assert acc > 0.8
+
+    def test_glmix_data_structure(self):
+        data, truth = ptest.generate_glmix_data(
+            n_entities=5, rows_per_entity=10, seed=6
+        )
+        assert data.num_rows == 50
+        assert set(data.feature_shards) == {"global", "per_entity"}
+        assert len(set(data.id_tags["userId"])) == 5
+        assert "w_fixed" in truth and "w_e0000" in truth
+
+    def test_generate_game_model_scores(self):
+        data, _ = ptest.generate_glmix_data(
+            n_entities=4, rows_per_entity=8, seed=7
+        )
+        model = ptest.generate_game_model(
+            data,
+            TaskType.LINEAR_REGRESSION,
+            {
+                "fixed": {"feature_shard": "global"},
+                "per_user": {
+                    "feature_shard": "per_entity",
+                    "random_effect_type": "userId",
+                },
+            },
+        )
+        scores = model.score(data)
+        assert scores.shape == (32,)
+        assert np.isfinite(scores).all()
+        assert np.abs(scores).sum() > 0
