@@ -85,6 +85,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "an existing checkpoint there is resumed")
     p.add_argument("--save-feature-stats", action="store_true",
                    help="write per-shard FeatureSummarizationResultAvro")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   metavar="module.Class",
+                   help="EventListener classes to register")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax profiler trace of the fit phase here "
+                        "(view with TensorBoard / xprof)")
     p.add_argument("--log-file", default=None)
     return p.parse_args(argv)
 
@@ -141,155 +147,194 @@ def _save_feature_stats(output_dir, shard, summary, index_map) -> None:
 
 
 def run(args: argparse.Namespace) -> GameFit:
+    import contextlib
+    import time
+
+    from photon_ml_tpu.event import (
+        EventEmitter,
+        PhotonOptimizationLogEvent,
+        PhotonSetupEvent,
+        TrainingFinishEvent,
+        TrainingStartEvent,
+    )
+
     logger = setup_logger(args.log_file)
     timer = Timer()
     task = TaskType[args.task]
-    shard_configs, coordinates, update_order, raw_config = load_game_config(
-        args.coordinate_config
-    )
-
-    with timer.time("prepare feature maps"):
-        index_maps = load_index_maps(args.offheap_indexmap_dir, shard_configs)
-
-    from photon_ml_tpu.utils.date_range import paths_for_date_range
-
-    train_dirs = paths_for_date_range(
-        args.train_data_dirs, args.train_date_range, args.train_date_days_ago
-    )
-    if not train_dirs:
-        raise FileNotFoundError(
-            f"no input dirs in date range under {args.train_data_dirs}"
+    emitter = EventEmitter()
+    for name in args.event_listeners:
+        emitter.register_listener_class(name)
+    emitter.send_event(PhotonSetupEvent(params=vars(args)))
+    t_start = time.perf_counter()
+    try:
+        shard_configs, coordinates, update_order, raw_config = load_game_config(
+            args.coordinate_config
         )
 
-    id_tags = id_tags_needed(coordinates)
-    with timer.time("read training data"):
-        data, index_maps, _ = read_game_data(
-            train_dirs, shard_configs, index_maps, id_tags=id_tags
+        with timer.time("prepare feature maps"):
+            index_maps = load_index_maps(args.offheap_indexmap_dir, shard_configs)
+
+        from photon_ml_tpu.utils.date_range import paths_for_date_range
+
+        train_dirs = paths_for_date_range(
+            args.train_data_dirs, args.train_date_range, args.train_date_days_ago
         )
-    logger.info("training rows: %d", data.num_rows)
-
-    # a sharded evaluator ('AUC:tag') needs its tag in the validation read
-    # even when no coordinate uses it
-    val_tags = list(id_tags)
-    if args.evaluator and ":" in args.evaluator:
-        tag = args.evaluator.partition(":")[2].strip()
-        if tag and tag not in val_tags:
-            val_tags.append(tag)
-
-    validation_data = None
-    if args.validation_data_dirs:
-        with timer.time("read validation data"):
-            validation_data, _, _ = read_game_data(
-                args.validation_data_dirs, shard_configs, index_maps,
-                id_tags=val_tags,
+        if not train_dirs:
+            raise FileNotFoundError(
+                f"no input dirs in date range under {args.train_data_dirs}"
             )
-        logger.info("validation rows: %d", validation_data.num_rows)
 
-    norm_type = NormalizationType[args.normalization_type]
-    normalization = {}
-    intercept_indices = {}
-    # normalization applies to fixed-effect coordinates (see GameEstimator);
-    # stats are computed/saved for every shard
-    from photon_ml_tpu.estimators.game import FixedEffectCoordinateConfiguration
+        id_tags = id_tags_needed(coordinates)
+        with timer.time("read training data"):
+            data, index_maps, _ = read_game_data(
+                train_dirs, shard_configs, index_maps, id_tags=id_tags
+            )
+        logger.info("training rows: %d", data.num_rows)
 
-    fe_shards = {
-        c.feature_shard
-        for c in coordinates.values()
-        if isinstance(c, FixedEffectCoordinateConfiguration)
-    }
-    # summarize only what's needed: fe shards for normalization, every shard
-    # when stats output was requested
-    stat_shards = (
-        list(shard_configs) if args.save_feature_stats else sorted(fe_shards)
-    )
-    if norm_type is not NormalizationType.NONE or args.save_feature_stats:
-        for sid in stat_shards:
-            with timer.time(f"feature stats [{sid}]"):
-                import jax.numpy as jnp
+        # a sharded evaluator ('AUC:tag') needs its tag in the validation read
+        # even when no coordinate uses it
+        val_tags = list(id_tags)
+        if args.evaluator and ":" in args.evaluator:
+            tag = args.evaluator.partition(":")[2].strip()
+            if tag and tag not in val_tags:
+                val_tags.append(tag)
 
-                labeled = LabeledData.create(
-                    data.ell_features(sid), jnp.asarray(data.labels),
-                    weights=jnp.asarray(data.weights),
+        validation_data = None
+        if args.validation_data_dirs:
+            with timer.time("read validation data"):
+                validation_data, _, _ = read_game_data(
+                    args.validation_data_dirs, shard_configs, index_maps,
+                    id_tags=val_tags,
                 )
-                summary = summarize(labeled)
-            if args.save_feature_stats:
-                _save_feature_stats(args.output_dir, sid, summary, index_maps[sid])
-            icpt = index_maps[sid].get_index(INTERCEPT_KEY)
-            intercept_indices[sid] = icpt if icpt >= 0 else None
-            if norm_type is not NormalizationType.NONE and sid in fe_shards:
-                normalization[sid] = build_normalization_context(
-                    norm_type,
-                    mean=summary.mean,
-                    variance=summary.variance,
-                    max_magnitude=summary.max_abs,
-                    intercept_index=intercept_indices[sid],
+            logger.info("validation rows: %d", validation_data.num_rows)
+
+        norm_type = NormalizationType[args.normalization_type]
+        normalization = {}
+        intercept_indices = {}
+        # normalization applies to fixed-effect coordinates (see GameEstimator);
+        # stats are computed/saved for every shard
+        from photon_ml_tpu.estimators.game import FixedEffectCoordinateConfiguration
+
+        fe_shards = {
+            c.feature_shard
+            for c in coordinates.values()
+            if isinstance(c, FixedEffectCoordinateConfiguration)
+        }
+        # summarize only what's needed: fe shards for normalization, every shard
+        # when stats output was requested
+        stat_shards = (
+            list(shard_configs) if args.save_feature_stats else sorted(fe_shards)
+        )
+        if norm_type is not NormalizationType.NONE or args.save_feature_stats:
+            for sid in stat_shards:
+                with timer.time(f"feature stats [{sid}]"):
+                    import jax.numpy as jnp
+
+                    labeled = LabeledData.create(
+                        data.ell_features(sid), jnp.asarray(data.labels),
+                        weights=jnp.asarray(data.weights),
+                    )
+                    summary = summarize(labeled)
+                if args.save_feature_stats:
+                    _save_feature_stats(args.output_dir, sid, summary, index_maps[sid])
+                icpt = index_maps[sid].get_index(INTERCEPT_KEY)
+                intercept_indices[sid] = icpt if icpt >= 0 else None
+                if norm_type is not NormalizationType.NONE and sid in fe_shards:
+                    normalization[sid] = build_normalization_context(
+                        norm_type,
+                        mean=summary.mean,
+                        variance=summary.variance,
+                        max_magnitude=summary.max_abs,
+                        intercept_index=intercept_indices[sid],
+                    )
+
+        evaluator = (
+            _make_evaluator(args.evaluator, task, validation_data)
+            if validation_data is not None
+            else None
+        )
+        estimator = GameEstimator(
+            task=task,
+            coordinates=coordinates,
+            update_order=update_order,
+            num_outer_iterations=args.num_outer_iterations,
+            evaluator=evaluator,
+            normalization=normalization,
+            intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
+        )
+
+        emitter.send_event(TrainingStartEvent(task=task.name))
+        profile_ctx = contextlib.nullcontext()
+        if args.profile_dir:
+            import jax
+
+            profile_ctx = jax.profiler.trace(args.profile_dir)
+        with profile_ctx, timer.time("fit"):
+            fit = estimator.fit(
+                data,
+                validation_data=validation_data,
+                checkpoint_dir=args.checkpoint_dir,
+            )
+        for cid, value in fit.objective_history:
+            cfg = estimator.coordinate_configs.get(cid)
+            emitter.send_event(PhotonOptimizationLogEvent(
+                coordinate_id=cid,
+                regularization_weight=(
+                    cfg.optimizer.regularization_weight if cfg else 0.0
+                ),
+                objective_value=value,
+                iterations=-1,  # per-coordinate iteration counts live in trackers
+                convergence_reason="",
+            ))
+            logger.info("objective [%s]: %.6f", cid, value)
+        if fit.validation_metric is not None:
+            logger.info("validation metric: %.6f", fit.validation_metric)
+
+        best = fit
+        if (
+            args.hyperparameter_tuning != "NONE"
+            and validation_data is not None
+            and args.hyperparameter_tuning_iter > 0
+        ):
+            with timer.time("hyperparameter tuning"):
+                trials = run_hyperparameter_tuning(
+                    estimator, data, validation_data,
+                    mode=args.hyperparameter_tuning,
+                    num_iterations=args.hyperparameter_tuning_iter,
+                    prior_fits=[fit],
                 )
+            for t in trials:
+                logger.info(
+                    "trial lambda=%s metric=%.6f",
+                    ["%.4g" % (10.0 ** v) for v in t.hyperparameters], t.value,
+                )
+            candidates = [fit] + [t.fit for t in trials]
+            better = estimator.evaluator.better_than
+            for c in candidates:
+                if c.validation_metric is not None and (
+                    best.validation_metric is None
+                    or better(c.validation_metric, best.validation_metric)
+                ):
+                    best = c
 
-    evaluator = (
-        _make_evaluator(args.evaluator, task, validation_data)
-        if validation_data is not None
-        else None
-    )
-    estimator = GameEstimator(
-        task=task,
-        coordinates=coordinates,
-        update_order=update_order,
-        num_outer_iterations=args.num_outer_iterations,
-        evaluator=evaluator,
-        normalization=normalization,
-        intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
-    )
-
-    with timer.time("fit"):
-        fit = estimator.fit(
-            data,
-            validation_data=validation_data,
-            checkpoint_dir=args.checkpoint_dir,
-        )
-    for name, value in fit.objective_history:
-        logger.info("objective [%s]: %.6f", name, value)
-    if fit.validation_metric is not None:
-        logger.info("validation metric: %.6f", fit.validation_metric)
-
-    best = fit
-    if (
-        args.hyperparameter_tuning != "NONE"
-        and validation_data is not None
-        and args.hyperparameter_tuning_iter > 0
-    ):
-        with timer.time("hyperparameter tuning"):
-            trials = run_hyperparameter_tuning(
-                estimator, data, validation_data,
-                mode=args.hyperparameter_tuning,
-                num_iterations=args.hyperparameter_tuning_iter,
-                prior_fits=[fit],
+        with timer.time("save model"):
+            save_game_model(
+                best.model,
+                os.path.join(args.output_dir, "best"),
+                index_maps=index_maps,
+                model_name=args.model_name,
+                configurations=raw_config,
             )
-        for t in trials:
-            logger.info(
-                "trial lambda=%s metric=%.6f",
-                ["%.4g" % (10.0 ** v) for v in t.hyperparameters], t.value,
-            )
-        candidates = [fit] + [t.fit for t in trials]
-        better = estimator.evaluator.better_than
-        for c in candidates:
-            if c.validation_metric is not None and (
-                best.validation_metric is None
-                or better(c.validation_metric, best.validation_metric)
-            ):
-                best = c
-
-    with timer.time("save model"):
-        save_game_model(
-            best.model,
-            os.path.join(args.output_dir, "best"),
-            index_maps=index_maps,
-            model_name=args.model_name,
-            configurations=raw_config,
-        )
-    logger.info("model saved to %s", os.path.join(args.output_dir, "best"))
-    for name, seconds in timer.durations.items():
-        logger.info("timing %-28s %.3fs", name, seconds)
-    return best
+        logger.info("model saved to %s", os.path.join(args.output_dir, "best"))
+        emitter.send_event(TrainingFinishEvent(
+            task=task.name, wall_seconds=time.perf_counter() - t_start
+        ))
+        for name, seconds in timer.durations.items():
+            logger.info("timing %-28s %.3fs", name, seconds)
+        return best
+    finally:
+        # listeners must flush/close even when the run fails
+        emitter.clear_listeners()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

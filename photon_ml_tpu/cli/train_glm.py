@@ -78,6 +78,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.name for v in DataValidationType])
     p.add_argument("--compute-variances", action="store_true")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   metavar="module.Class",
+                   help="EventListener classes to register (reference "
+                        "--event-listeners, Params.scala:186)")
     p.add_argument("--diagnostic-mode", default="NONE",
                    choices=["NONE", "ALL"],
                    help="ALL writes model-diagnostic.html (bootstrap, "
@@ -112,170 +116,201 @@ def _write_model_text(path: str, w, variances, index_map) -> None:
 
 
 def run(args: argparse.Namespace) -> dict:
+    import time
+
+    from photon_ml_tpu.event import (
+        EventEmitter,
+        PhotonOptimizationLogEvent,
+        PhotonSetupEvent,
+        TrainingFinishEvent,
+        TrainingStartEvent,
+    )
+
     logger = setup_logger(args.log_file)
     timer = Timer()
     task = TaskType[args.task]
-    shard_cfg = {
-        "features": FeatureShardConfiguration(
-            feature_bags=args.feature_bags, add_intercept=args.add_intercept
-        )
-    }
-
-    with timer.time("preprocess"):
-        if args.input_format == "LIBSVM":
-            from photon_ml_tpu.io.libsvm import read_libsvm
-
-            if len(args.training_data_dirs) > 1:
-                raise ValueError("LIBSVM input takes a single path")
-            data, imap = read_libsvm(
-                args.training_data_dirs[0],
-                use_intercept=args.add_intercept,
-                binarize_labels=task.is_classification,
+    emitter = EventEmitter()
+    for name in args.event_listeners:
+        emitter.register_listener_class(name)
+    emitter.send_event(PhotonSetupEvent(params=vars(args)))
+    t_start = time.perf_counter()
+    try:
+        shard_cfg = {
+            "features": FeatureShardConfiguration(
+                feature_bags=args.feature_bags, add_intercept=args.add_intercept
             )
-            index_maps = {"features": imap}
-        else:
-            data, index_maps, _ = read_game_data(
-                args.training_data_dirs, shard_cfg
-            )
-            imap = index_maps["features"]
-        labeled = _labeled_from_game(data, "features")
-        validate_labeled_data(
-            labeled, task, DataValidationType[args.data_validation]
-        )
-        icpt = imap.get_index(INTERCEPT_KEY)
-        intercept_index = icpt if icpt >= 0 else None
-        norm = None
-        norm_type = NormalizationType[args.normalization_type]
-        if norm_type is not NormalizationType.NONE:
-            summary = summarize(labeled)
-            norm = build_normalization_context(
-                norm_type,
-                mean=summary.mean,
-                variance=summary.variance,
-                max_magnitude=summary.max_abs,
-                intercept_index=intercept_index,
-            )
-            labeled = _labeled_from_game(data, "features", norm=norm)
-    logger.info("rows: %d features: %d", data.num_rows, len(imap))
+        }
 
-    opt_cfg = {
-        "optimizer": args.optimizer,
-        "regularization": args.regularization,
-    }
-    if args.elastic_net_alpha is not None:
-        opt_cfg["alpha"] = args.elastic_net_alpha
-    if args.max_iterations is not None:
-        opt_cfg["max_iterations"] = args.max_iterations
-    if args.tolerance is not None:
-        opt_cfg["tolerance"] = args.tolerance
-    if args.coefficient_box_constraints:
-        box = json.loads(args.coefficient_box_constraints)
-        opt_cfg["constraint_lower"] = box.get("lower")
-        opt_cfg["constraint_upper"] = box.get("upper")
-    configuration = parse_optimizer_config(opt_cfg)
-
-    with timer.time("train"):
-        fits = train_glm(
-            labeled,
-            task,
-            configuration,
-            regularization_weights=args.regularization_weights,
-            compute_variances=args.compute_variances,
-            intercept_index=intercept_index,
-        )
-
-    # validate: metric per λ; best-λ selection by the task's default metric
-    # (reference Driver.validate + ModelSelection.selectBestModel)
-    evaluator = default_evaluator(task)
-    metrics = {}
-    best_lambda = None
-    if args.validation_data_dirs:
-        with timer.time("validate"):
+        with timer.time("preprocess"):
             if args.input_format == "LIBSVM":
                 from photon_ml_tpu.io.libsvm import read_libsvm
 
-                vdata, _ = read_libsvm(
-                    args.validation_data_dirs[0],
-                    feature_dimension=(
-                        len(imap) - 1 if args.add_intercept else len(imap)
-                    ),
+                if len(args.training_data_dirs) > 1:
+                    raise ValueError("LIBSVM input takes a single path")
+                data, imap = read_libsvm(
+                    args.training_data_dirs[0],
                     use_intercept=args.add_intercept,
                     binarize_labels=task.is_classification,
                 )
+                index_maps = {"features": imap}
             else:
-                vdata, _, _ = read_game_data(
-                    args.validation_data_dirs, shard_cfg, index_maps
+                data, index_maps, _ = read_game_data(
+                    args.training_data_dirs, shard_cfg
                 )
-            vfeats = vdata.ell_features("features")
-            for fit in fits:
-                scores = np.asarray(
-                    fit.model.compute_score(vfeats)
-                ) + vdata.offsets
-                m = evaluator.evaluate(scores, vdata.labels, vdata.weights)
-                metrics[fit.regularization_weight] = m
-                logger.info(
-                    "lambda=%g %s=%.6f", fit.regularization_weight,
-                    evaluator.name, m,
-                )
-        best_lambda = None
-        for lam, m in metrics.items():
-            # nan-aware comparison (NaN never wins; reference
-            # Evaluator.betterThan semantics)
-            if best_lambda is None or evaluator.better_than(m, metrics[best_lambda]):
-                best_lambda = lam
-        logger.info("best lambda: %g", best_lambda)
-    else:
-        best_lambda = fits[0].regularization_weight
-
-    with timer.time("output"):
-        os.makedirs(args.output_dir, exist_ok=True)
-        for fit in fits:
-            _write_model_text(
-                os.path.join(
-                    args.output_dir, f"model-lambda-{fit.regularization_weight:g}.txt"
-                ),
-                fit.model.coefficients.means,
-                fit.model.coefficients.variances,
-                imap,
+                imap = index_maps["features"]
+            labeled = _labeled_from_game(data, "features")
+            validate_labeled_data(
+                labeled, task, DataValidationType[args.data_validation]
             )
-        best = next(f for f in fits if f.regularization_weight == best_lambda)
-        means = np.asarray(best.model.coefficients.means)
-        ntv = []
-        for i in np.flatnonzero(means):
-            key = imap.get_feature_name(int(i)) or str(i)
-            name, _, term = key.partition(NAME_TERM_DELIMITER)
-            ntv.append({"name": name, "term": term, "value": float(means[i])})
-        record = {
-            "modelId": "best",
-            "modelClass": None,
-            "means": ntv,
-            "variances": None,
-            "lossFunction": None,
+            icpt = imap.get_index(INTERCEPT_KEY)
+            intercept_index = icpt if icpt >= 0 else None
+            norm = None
+            norm_type = NormalizationType[args.normalization_type]
+            if norm_type is not NormalizationType.NONE:
+                summary = summarize(labeled)
+                norm = build_normalization_context(
+                    norm_type,
+                    mean=summary.mean,
+                    variance=summary.variance,
+                    max_magnitude=summary.max_abs,
+                    intercept_index=intercept_index,
+                )
+                labeled = _labeled_from_game(data, "features", norm=norm)
+        logger.info("rows: %d features: %d", data.num_rows, len(imap))
+
+        opt_cfg = {
+            "optimizer": args.optimizer,
+            "regularization": args.regularization,
         }
-        write_avro_file(
-            os.path.join(args.output_dir, "best-model.avro"),
-            schemas.bayesian_linear_model_schema(),
-            [record],
-        )
-        with open(os.path.join(args.output_dir, "selection.json"), "w") as f:
-            json.dump(
-                {
-                    "best_lambda": best_lambda,
-                    "metrics": {str(k): v for k, v in metrics.items()},
-                    "evaluator": evaluator.name,
-                },
-                f, indent=2,
-            )
-    if args.diagnostic_mode == "ALL":
-        with timer.time("diagnose"):
-            _diagnose(
-                args, task, data, labeled, fits, best_lambda, imap,
-                intercept_index, configuration, logger,
-            )
+        if args.elastic_net_alpha is not None:
+            opt_cfg["alpha"] = args.elastic_net_alpha
+        if args.max_iterations is not None:
+            opt_cfg["max_iterations"] = args.max_iterations
+        if args.tolerance is not None:
+            opt_cfg["tolerance"] = args.tolerance
+        if args.coefficient_box_constraints:
+            box = json.loads(args.coefficient_box_constraints)
+            opt_cfg["constraint_lower"] = box.get("lower")
+            opt_cfg["constraint_upper"] = box.get("upper")
+        configuration = parse_optimizer_config(opt_cfg)
 
-    for name, seconds in timer.durations.items():
-        logger.info("timing %-12s %.3fs", name, seconds)
-    return {"best_lambda": best_lambda, "metrics": metrics, "fits": fits}
+        emitter.send_event(TrainingStartEvent(task=task.name))
+        with timer.time("train"):
+            fits = train_glm(
+                labeled,
+                task,
+                configuration,
+                regularization_weights=args.regularization_weights,
+                compute_variances=args.compute_variances,
+                intercept_index=intercept_index,
+            )
+        for fit in fits:
+            emitter.send_event(PhotonOptimizationLogEvent(
+                coordinate_id=None,
+                regularization_weight=fit.regularization_weight,
+                objective_value=float(fit.result.value),
+                iterations=int(fit.result.iterations),
+                convergence_reason=fit.result.reason_enum().name,
+            ))
+
+        # validate: metric per λ; best-λ selection by the task's default metric
+        # (reference Driver.validate + ModelSelection.selectBestModel)
+        evaluator = default_evaluator(task)
+        metrics = {}
+        best_lambda = None
+        if args.validation_data_dirs:
+            with timer.time("validate"):
+                if args.input_format == "LIBSVM":
+                    from photon_ml_tpu.io.libsvm import read_libsvm
+
+                    vdata, _ = read_libsvm(
+                        args.validation_data_dirs[0],
+                        feature_dimension=(
+                            len(imap) - 1 if args.add_intercept else len(imap)
+                        ),
+                        use_intercept=args.add_intercept,
+                        binarize_labels=task.is_classification,
+                    )
+                else:
+                    vdata, _, _ = read_game_data(
+                        args.validation_data_dirs, shard_cfg, index_maps
+                    )
+                vfeats = vdata.ell_features("features")
+                for fit in fits:
+                    scores = np.asarray(
+                        fit.model.compute_score(vfeats)
+                    ) + vdata.offsets
+                    m = evaluator.evaluate(scores, vdata.labels, vdata.weights)
+                    metrics[fit.regularization_weight] = m
+                    logger.info(
+                        "lambda=%g %s=%.6f", fit.regularization_weight,
+                        evaluator.name, m,
+                    )
+            best_lambda = None
+            for lam, m in metrics.items():
+                # nan-aware comparison (NaN never wins; reference
+                # Evaluator.betterThan semantics)
+                if best_lambda is None or evaluator.better_than(m, metrics[best_lambda]):
+                    best_lambda = lam
+            logger.info("best lambda: %g", best_lambda)
+        else:
+            best_lambda = fits[0].regularization_weight
+
+        with timer.time("output"):
+            os.makedirs(args.output_dir, exist_ok=True)
+            for fit in fits:
+                _write_model_text(
+                    os.path.join(
+                        args.output_dir, f"model-lambda-{fit.regularization_weight:g}.txt"
+                    ),
+                    fit.model.coefficients.means,
+                    fit.model.coefficients.variances,
+                    imap,
+                )
+            best = next(f for f in fits if f.regularization_weight == best_lambda)
+            means = np.asarray(best.model.coefficients.means)
+            ntv = []
+            for i in np.flatnonzero(means):
+                key = imap.get_feature_name(int(i)) or str(i)
+                name, _, term = key.partition(NAME_TERM_DELIMITER)
+                ntv.append({"name": name, "term": term, "value": float(means[i])})
+            record = {
+                "modelId": "best",
+                "modelClass": None,
+                "means": ntv,
+                "variances": None,
+                "lossFunction": None,
+            }
+            write_avro_file(
+                os.path.join(args.output_dir, "best-model.avro"),
+                schemas.bayesian_linear_model_schema(),
+                [record],
+            )
+            with open(os.path.join(args.output_dir, "selection.json"), "w") as f:
+                json.dump(
+                    {
+                        "best_lambda": best_lambda,
+                        "metrics": {str(k): v for k, v in metrics.items()},
+                        "evaluator": evaluator.name,
+                    },
+                    f, indent=2,
+                )
+        if args.diagnostic_mode == "ALL":
+            with timer.time("diagnose"):
+                _diagnose(
+                    args, task, data, labeled, fits, best_lambda, imap,
+                    intercept_index, configuration, logger,
+                )
+
+        emitter.send_event(TrainingFinishEvent(
+            task=task.name, wall_seconds=time.perf_counter() - t_start
+        ))
+        for name, seconds in timer.durations.items():
+            logger.info("timing %-12s %.3fs", name, seconds)
+        return {"best_lambda": best_lambda, "metrics": metrics, "fits": fits}
+    finally:
+        # listeners must flush/close even when the run fails
+        emitter.clear_listeners()
 
 
 def _diagnose(

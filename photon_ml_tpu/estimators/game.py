@@ -175,7 +175,9 @@ class GameEstimator:
 
     @staticmethod
     def _check_resume_compatible(
-        models: Dict[str, object], coordinates: Dict[str, Coordinate]
+        models: Dict[str, object],
+        coordinates: Dict[str, Coordinate],
+        require_all: bool = True,
     ) -> None:
         """Fail fast (with a clear message) when a checkpoint's layout does
         not match the datasets rebuilt from the current data/config."""
@@ -204,7 +206,7 @@ class GameEstimator:
                         f"{cid}: checkpoint entity layout differs from the "
                         "dataset rebuilt from the current data/config"
                     )
-        if set(coordinates) - set(models):
+        if require_all and set(coordinates) - set(models):
             missing = sorted(set(coordinates) - set(models))
             problems.append(f"coordinates missing from checkpoint: {missing}")
         if problems:
@@ -219,11 +221,15 @@ class GameEstimator:
         data: GameData,
         validation_data: Optional[GameData] = None,
         checkpoint_dir: Optional[str] = None,
+        initial_models: Optional[Dict[str, object]] = None,
     ) -> GameFit:
         """With ``checkpoint_dir``, training state is written atomically
         after every outer CD iteration and an existing checkpoint there is
         resumed automatically (skipping completed iterations) — see
-        photon_ml_tpu.checkpoint."""
+        photon_ml_tpu.checkpoint. ``initial_models`` warm-starts coordinates
+        (reference warmStartModels across tuning trials,
+        cli/game/training/Driver.scala:484-501); a resumed checkpoint takes
+        precedence."""
         coordinates = {
             cid: self._build_coordinate(cid, cfg, data)
             for cid, cfg in self.coordinate_configs.items()
@@ -258,12 +264,16 @@ class GameEstimator:
             validation_better_than=self.evaluator.better_than,
         )
 
-        initial_models = None
         start_iteration = 0
         initial_best = None
         on_iteration_end = None
         prior_objective_history: List[Tuple[str, float]] = []
         prior_validation_history: List[Tuple[str, float]] = []
+        if initial_models is not None:
+            # warm start may cover a subset of coordinates
+            self._check_resume_compatible(
+                initial_models, coordinates, require_all=False
+            )
         if checkpoint_dir is not None:
             from photon_ml_tpu import checkpoint as ckpt
 

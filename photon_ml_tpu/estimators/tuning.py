@@ -48,11 +48,20 @@ class GameEstimatorEvaluationFunction:
         data,
         validation_data,
         min_weight: float = 1e-8,
+        warm_start: bool = True,
+        initial_warm_models: Optional[Dict[str, object]] = None,
     ) -> None:
         self.estimator = estimator
         self.data = data
         self.validation_data = validation_data
         self.min_weight = min_weight
+        # Each trial warm-starts from the previous trial's models (reference
+        # warmStartModels, cli/game/training/Driver.scala:484-501);
+        # ``initial_warm_models`` seeds the first trial.
+        self.warm_start = warm_start
+        self._warm_models: Optional[Dict[str, object]] = (
+            dict(initial_warm_models) if initial_warm_models else None
+        )
         # Sorted coordinate ids for a deterministic vector layout
         # (the reference uses SortedMap for the same reason).
         self._order = sorted(estimator.coordinate_configs)
@@ -119,7 +128,13 @@ class GameEstimatorEvaluationFunction:
             normalization=self.estimator.normalization,
             intercept_indices=self.estimator.intercept_indices,
         )
-        fit = estimator.fit(self.data, validation_data=self.validation_data)
+        fit = estimator.fit(
+            self.data,
+            validation_data=self.validation_data,
+            initial_models=self._warm_models if self.warm_start else None,
+        )
+        if self.warm_start:
+            self._warm_models = dict(fit.model.models)
         if fit.validation_metric is None:
             raise ValueError("tuning requires validation data")
         value = float(fit.validation_metric)
@@ -159,13 +174,21 @@ def run_hyperparameter_tuning(
     log10_range: Tuple[float, float] = (-4.0, 4.0),
     prior_fits: Sequence[GameFit] = (),
     seed: int = 0,
+    warm_start: bool = True,
 ) -> List[TuningTrial]:
     """Driver.runHyperparameterTuning equivalent. Returns all trials; callers
     select the best with ``estimator.evaluator.better_than``."""
     mode = mode.upper()
     if mode == "NONE" or num_iterations <= 0:
         return []
-    fn = GameEstimatorEvaluationFunction(estimator, data, validation_data)
+    fn = GameEstimatorEvaluationFunction(
+        estimator, data, validation_data,
+        warm_start=warm_start,
+        initial_warm_models=(
+            dict(prior_fits[-1].model.models) if prior_fits and warm_start
+            else None
+        ),
+    )
     ranges = [log10_range] * fn.num_params
     if mode == "BAYESIAN":
         searcher: RandomSearch[TuningTrial] = GaussianProcessSearch(

@@ -1,0 +1,16 @@
+"""Event-listener test double, importable by dotted name from
+--event-listeners (must live outside the test module so the driver's
+importlib load and the test share one module object)."""
+
+from photon_ml_tpu.event import Event, EventListener
+
+
+class CollectingListener(EventListener):
+    received = []  # class-level on purpose: the driver instantiates the class
+    closed = 0
+
+    def on_event(self, event: Event) -> None:
+        CollectingListener.received.append(event)
+
+    def close(self) -> None:
+        CollectingListener.closed += 1

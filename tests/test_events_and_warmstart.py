@@ -1,0 +1,170 @@
+"""Event emission from the drivers, profiler hook, and warm start across
+estimator fits / tuning trials (reference event/EventEmitter wiring in
+Driver.scala:120-186 and warmStartModels, Driver.scala:484-501)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.event import (
+    PhotonOptimizationLogEvent,
+    PhotonSetupEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+from tests._listeners import CollectingListener
+
+
+@pytest.fixture
+def collecting():
+    CollectingListener.received = []
+    CollectingListener.closed = 0
+    return CollectingListener
+
+
+def _glm_fixture(tmp_path, rng):
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    recs = [
+        {"label": float(i % 2),
+         "features": [("f", str(j), float(rng.normal())) for j in range(5)]}
+        for i in range(120)
+    ]
+    p = tmp_path / "train"
+    p.mkdir()
+    write_training_examples(str(p / "part-00000.avro"), recs)
+    return p
+
+
+class TestDriverEvents:
+    def test_train_glm_emits_lifecycle(self, tmp_path, rng, collecting):
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        train = _glm_fixture(tmp_path, rng)
+        run(parse_args([
+            "--training-data-dirs", str(train),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(tmp_path / "out"),
+            "--regularization-weights", "0.1", "1",
+            "--event-listeners",
+            "tests._listeners.CollectingListener",
+        ]))
+        kinds = [type(e) for e in collecting.received]
+        assert kinds[0] is PhotonSetupEvent
+        assert TrainingStartEvent in kinds
+        assert kinds[-1] is TrainingFinishEvent
+        opt_events = [e for e in collecting.received
+                      if isinstance(e, PhotonOptimizationLogEvent)]
+        assert {e.regularization_weight for e in opt_events} == {0.1, 1.0}
+        assert all(e.iterations > 0 for e in opt_events)
+        assert all(e.convergence_reason for e in opt_events)
+        assert collecting.closed == 1
+
+    def test_train_game_emits_and_profiles(self, tmp_path, rng, collecting):
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        train = _glm_fixture(tmp_path, rng)
+        cfg = tmp_path / "g.json"
+        cfg.write_text(json.dumps({
+            "feature_shards": {"g": {"feature_bags": ["features"]}},
+            "coordinates": {"fixed": {"type": "fixed", "feature_shard": "g"}},
+        }))
+        prof = tmp_path / "prof"
+        run(parse_args([
+            "--train-data-dirs", str(train),
+            "--coordinate-config", str(cfg),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(tmp_path / "out"),
+            "--event-listeners",
+            "tests._listeners.CollectingListener",
+            "--profile-dir", str(prof),
+        ]))
+        kinds = [type(e) for e in collecting.received]
+        assert kinds[0] is PhotonSetupEvent and kinds[-1] is TrainingFinishEvent
+        opt = [e for e in collecting.received
+               if isinstance(e, PhotonOptimizationLogEvent)]
+        assert opt and opt[0].coordinate_id == "fixed"
+        # profiler wrote a trace
+        assert prof.is_dir() and any(prof.rglob("*"))
+
+
+class TestWarmStart:
+    def _data(self, rng):
+        from photon_ml_tpu.testing import generate_fixed_effect_data
+        from photon_ml_tpu.types import TaskType
+
+        data, _ = generate_fixed_effect_data(
+            TaskType.LINEAR_REGRESSION, n=200, d=8, seed=11
+        )
+        vdata, _ = generate_fixed_effect_data(
+            TaskType.LINEAR_REGRESSION, n=80, d=8, seed=12
+        )
+        return data, vdata
+
+    def test_fit_initial_models_warm_start(self, rng):
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        data, vdata = self._data(rng)
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates={"g": FixedEffectCoordinateConfiguration("global")},
+        )
+        first = est.fit(data, validation_data=vdata)
+        warm = est.fit(
+            data, validation_data=vdata,
+            initial_models=dict(first.model.models),
+        )
+        np.testing.assert_allclose(
+            warm.model.score(vdata), first.model.score(vdata),
+            rtol=1e-3, atol=1e-3,
+        )
+
+    def test_tuning_trials_warm_start(self, rng):
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+        )
+        from photon_ml_tpu.estimators.tuning import run_hyperparameter_tuning
+        from photon_ml_tpu.types import TaskType
+
+        data, vdata = self._data(rng)
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates={"g": FixedEffectCoordinateConfiguration("global")},
+        )
+        base = est.fit(data, validation_data=vdata)
+        trials = run_hyperparameter_tuning(
+            est, data, vdata, mode="RANDOM", num_iterations=3,
+            log10_range=(-2.0, 1.0), prior_fits=[base], seed=1,
+        )
+        assert len(trials) == 3
+        # warm-started trials still produce sane models
+        assert all(np.isfinite(t.value) for t in trials)
+
+    def test_incompatible_warm_start_rejected(self, rng):
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+        )
+        from photon_ml_tpu.testing import generate_fixed_effect_data
+        from photon_ml_tpu.types import TaskType
+
+        data, vdata = self._data(rng)
+        other, _ = generate_fixed_effect_data(
+            TaskType.LINEAR_REGRESSION, n=100, d=3, seed=13
+        )
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates={"g": FixedEffectCoordinateConfiguration("global")},
+        )
+        donor = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates={"g": FixedEffectCoordinateConfiguration("global")},
+        ).fit(other)
+        with pytest.raises(ValueError, match="incompatible"):
+            est.fit(data, initial_models=dict(donor.model.models))
