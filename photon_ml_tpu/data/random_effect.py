@@ -171,6 +171,45 @@ def _local_dense(
     out[rep[match], j_clip[match]] = v[match]
 
 
+def _pearson_scores_flat(
+    ukeys: np.ndarray,
+    ecol: np.ndarray,
+    n_ent: int,
+    nz_keys: np.ndarray,
+    nz_v: np.ndarray,
+    y_nz: np.ndarray,
+    w_nz: np.ndarray,
+    e_act: np.ndarray,
+    y_act: np.ndarray,
+    w_act: np.ndarray,
+) -> np.ndarray:
+    """|weighted Pearson| per (entity, local column), computed from segment
+    sums over the nonzeros only — the vectorized equivalent of
+    :func:`_pearson_scores` over every entity at once (zero feature values
+    contribute nothing to the x-moments but their samples still weight the
+    label moments, identical to the dense formula)."""
+    W = np.bincount(e_act, weights=w_act, minlength=n_ent)
+    W = np.maximum(W, 1e-12)
+    my = np.bincount(e_act, weights=w_act * y_act, minlength=n_ent) / W
+    vy = (
+        np.bincount(e_act, weights=w_act * y_act * y_act, minlength=n_ent) / W
+        - my * my
+    )
+    kidx = np.searchsorted(ukeys, nz_keys)
+    m = len(ukeys)
+    Sx = np.bincount(kidx, weights=w_nz * nz_v, minlength=m)
+    Sxx = np.bincount(kidx, weights=w_nz * nz_v * nz_v, minlength=m)
+    Sxy = np.bincount(kidx, weights=w_nz * nz_v * y_nz, minlength=m)
+    We = W[ecol]
+    mx = Sx / We
+    cov = Sxy / We - mx * my[ecol]
+    vx = Sxx / We - mx * mx
+    denom = np.sqrt(np.maximum(vx * vy[ecol], 0.0))
+    corr = np.where(denom > 1e-12, np.abs(cov) / np.maximum(denom, 1e-12), 0.0)
+    const_nonzero = (vx <= 1e-12) & (np.abs(mx) > 0)
+    return np.where(const_nonzero, np.inf, corr)
+
+
 def _pearson_scores(x: np.ndarray, y: np.ndarray, w: np.ndarray) -> np.ndarray:
     """|Pearson correlation| of each local feature with the label over one
     entity's samples (reference LocalDataSet.scala:221-287). Constant features
@@ -215,11 +254,13 @@ def build_random_effect_dataset(
     weights = np.ones(n, dtype=np.float32) if weights is None else np.asarray(weights, dtype=np.float32)
     rng = np.random.default_rng(config.seed)
 
-    ids = np.asarray([str(e) for e in entity_ids])
-    order = np.argsort(ids, kind="stable")
-    sorted_ids = ids[order]
-    uniq, starts = np.unique(sorted_ids, return_index=True)
-    ends = np.append(starts[1:], n)
+    # Entity codes: np.unique on the raw array (no per-row Python str()); the
+    # string form is only materialized once per ENTITY for the id maps.
+    ids_arr = np.asarray(entity_ids)
+    uniq_raw, codes = np.unique(ids_arr, return_inverse=True)
+    uniq = uniq_raw.astype(str)
+    n_ent = len(uniq)
+    counts = np.bincount(codes, minlength=n_ent)
 
     # CSR-ify the COO features once (row-sorted)
     feature_rows = np.asarray(feature_rows, dtype=np.int64)
@@ -230,51 +271,40 @@ def build_random_effect_dataset(
     row_start = np.searchsorted(fr, np.arange(n))
     row_end = np.searchsorted(fr, np.arange(n) + 1)
 
+    # ---- active/passive split, all entities at once -----------------------
+    # Group rows by entity (random order within an entity when capping) and
+    # keep the first `cap` per entity: a uniform without-replacement subset —
+    # the vectorized equivalent of the reference's per-entity reservoir
+    # (RandomEffectDataSet.scala:325-388).
     cap = config.active_data_upper_bound
-    entities = []  # (id, active_rows, passive_rows, local_cols)
-    for e_i, (s, t) in enumerate(zip(starts, ends)):
-        rows = order[s:t]
-        if cap is not None and len(rows) > cap:
-            # reservoir-equivalent: uniform random subset without replacement
-            # (reference RandomEffectDataSet.scala:325-388)
-            keep = rng.choice(len(rows), size=cap, replace=False)
-            keep_mask = np.zeros(len(rows), dtype=bool)
-            keep_mask[keep] = True
-            active_rows = rows[keep_mask]
-            lb = config.passive_data_lower_bound
-            passive_rows = rows[~keep_mask] if (lb is None or len(rows) >= lb) else np.empty(0, dtype=np.int64)
-        else:
-            active_rows = rows
-            passive_rows = np.empty(0, dtype=np.int64)
+    if cap is not None:
+        perm = np.lexsort((rng.random(n), codes))
+    else:
+        perm = np.argsort(codes, kind="stable")
+    codes_p = codes[perm]
+    ent_start_p = np.searchsorted(codes_p, np.arange(n_ent))
+    rank_p = np.arange(n, dtype=np.int64) - ent_start_p[codes_p]
+    if cap is not None:
+        active_m = rank_p < cap
+        lb = config.passive_data_lower_bound
+        pas_m = ~active_m
+        if lb is not None:
+            pas_m &= counts[codes_p] >= lb
+    else:
+        active_m = np.ones(n, dtype=bool)
+        pas_m = np.zeros(n, dtype=bool)
+    act = perm[active_m]            # active rows, grouped by entity
+    e_act_g = codes_p[active_m]     # entity code per active row
+    s_act_g = rank_p[active_m]      # slot within entity
+    pas = perm[pas_m]
+    e_pas_g = codes_p[pas_m]
+    acounts = np.bincount(e_act_g, minlength=n_ent)
 
-        if config.projector is ProjectorType.RANDOM:
-            # shared Gaussian projection: no per-entity column map
-            local_cols = np.empty(0, dtype=np.int64)
-            entities.append((uniq[e_i], active_rows, passive_rows, local_cols))
-            continue
-        if config.projector is ProjectorType.IDENTITY:
-            local_cols = np.arange(global_dim, dtype=np.int64)
-        else:
-            # per-entity observed features (from ACTIVE data only, reference
-            # IndexMapProjectorRDD.scala:164)
-            cols_parts = [fc[row_start[r]:row_end[r]] for r in active_rows]
-            local_cols = np.unique(np.concatenate(cols_parts)) if cols_parts else np.empty(0, dtype=np.int64)
-
-        # feature selection cap (ratio * samples, hard cap)
-        d_cap = None
-        if config.features_to_samples_ratio is not None:
-            d_cap = max(int(config.features_to_samples_ratio * len(active_rows)), 1)
-        if config.max_local_features is not None:
-            d_cap = min(d_cap, config.max_local_features) if d_cap is not None else config.max_local_features
-        if d_cap is not None and len(local_cols) > d_cap:
-            # rank by |Pearson| on a small dense local matrix
-            xm = np.zeros((len(active_rows), len(local_cols)), dtype=np.float32)
-            _local_dense(active_rows, local_cols, row_start, row_end, fc, fv, xm)
-            scores = _pearson_scores(xm, labels[active_rows], weights[active_rows])
-            top = np.argsort(-scores, kind="stable")[:d_cap]
-            local_cols = np.sort(local_cols[top])
-
-        entities.append((uniq[e_i], active_rows, passive_rows, local_cols))
+    # Active nnz, expanded once (reused by projection + Pearson + scatter).
+    rep_a, fidx_a = _expand_nnz(act, row_start, row_end)
+    nz_e = e_act_g[rep_a]           # entity code per active nonzero
+    nz_c = fc[fidx_a]
+    nz_v = fv[fidx_a]
 
     rproj = (
         RandomProjectionMatrix(
@@ -285,17 +315,94 @@ def build_random_effect_dataset(
         if config.projector is ProjectorType.RANDOM
         else None
     )
+    identity = config.projector is ProjectorType.IDENTITY
+    G1 = global_dim + 1
 
-    # size-bucketing by (samples, local dim) product to bound padding waste
-    nb = max(1, min(config.num_buckets, len(entities)))
-    sizes = np.array(
-        [
-            len(a) * (rproj.projected_dim if rproj else max(len(lc), 1))
-            for (_, a, _, lc) in entities
-        ]
+    # ---- per-entity local column maps (INDEX_MAP), no entity loop ---------
+    if rproj is not None or identity:
+        ukeys = np.empty(0, dtype=np.int64)
+        ecol = np.empty(0, dtype=np.int64)
+        ucol = np.empty(0, dtype=np.int64)
+        dlocs = (
+            np.full(n_ent, global_dim, dtype=np.int64)
+            if identity
+            else np.zeros(n_ent, dtype=np.int64)
+        )
+    else:
+        # observed (entity, col) pairs from ACTIVE data only (reference
+        # IndexMapProjectorRDD.scala:164); np.unique returns them sorted by
+        # entity then column — exactly the flat local-col layout.
+        ukeys = np.unique(nz_e * G1 + nz_c)
+        ecol = ukeys // G1
+        ucol = ukeys % G1
+        dlocs = np.bincount(ecol, minlength=n_ent)
+
+        # feature-selection caps (ratio * samples, hard cap)
+        d_cap_e = None
+        if config.features_to_samples_ratio is not None:
+            d_cap_e = np.maximum(
+                (config.features_to_samples_ratio * acounts).astype(np.int64), 1
+            )
+        if config.max_local_features is not None:
+            hard = int(config.max_local_features)
+            d_cap_e = np.full(n_ent, hard, dtype=np.int64) if d_cap_e is None else np.minimum(d_cap_e, hard)
+        if d_cap_e is not None and np.any(dlocs > d_cap_e):
+            scores = _pearson_scores_flat(
+                ukeys,
+                ecol,
+                n_ent,
+                nz_keys=nz_e * G1 + nz_c,
+                nz_v=nz_v,
+                y_nz=labels[act][rep_a],
+                w_nz=weights[act][rep_a],
+                e_act=e_act_g,
+                y_act=labels[act],
+                w_act=weights[act],
+            )
+            # top-k per entity, stable on ties by column order (the flat
+            # layout is column-sorted per entity, matching the reference's
+            # stable argsort over local columns)
+            sel = np.lexsort((np.arange(len(ukeys)), -scores, ecol))
+            estart = np.searchsorted(ecol[sel], np.arange(n_ent))
+            r2 = np.arange(len(ukeys), dtype=np.int64) - estart[ecol[sel]]
+            kept = np.sort(sel[r2 < d_cap_e[ecol[sel]]])
+            ukeys, ecol, ucol = ukeys[kept], ecol[kept], ucol[kept]
+            dlocs = np.bincount(ecol, minlength=n_ent)
+
+    dstart = np.zeros(n_ent + 1, dtype=np.int64)
+    np.cumsum(dlocs, out=dstart[1:])
+
+    # ---- size-bucketing by (samples x local dim) --------------------------
+    nb = max(1, min(config.num_buckets, n_ent))
+    sizes = acounts * (
+        rproj.projected_dim if rproj else np.maximum(dlocs, 1)
     )
     bucket_edges = np.quantile(sizes, np.linspace(0, 1, nb + 1)[1:-1]) if nb > 1 else []
-    bucket_of = np.searchsorted(bucket_edges, sizes, side="left") if nb > 1 else np.zeros(len(entities), dtype=int)
+    bucket_of = (
+        np.searchsorted(bucket_edges, sizes, side="left")
+        if nb > 1
+        else np.zeros(n_ent, dtype=np.int64)
+    )
+
+    # Resolve every active nonzero's local column once (INDEX_MAP only).
+    if rproj is None and not identity:
+        qk = nz_e * G1 + nz_c
+        ii = np.searchsorted(ukeys, qk)
+        ii_c = np.minimum(ii, max(len(ukeys) - 1, 0))
+        nz_match = (
+            (ii < len(ukeys)) & (ukeys[ii_c] == qk)
+            if len(ukeys)
+            else np.zeros(len(qk), dtype=bool)
+        )
+        nz_j = ii_c - dstart[nz_e]  # local column per active nonzero
+    elif identity:
+        nz_match = np.ones(len(nz_c), dtype=bool)
+        nz_j = nz_c
+
+    def _project_rows(rows_g: np.ndarray) -> np.ndarray:
+        """x_projected = B^T x per sample of ``rows_g`` (RANDOM projector)."""
+        rep, fidx = _expand_nnz(rows_g, row_start, row_end)
+        return rproj.project_coo(rep, fc[fidx], fv[fidx], len(rows_g))
 
     buckets: List[ReBucket] = []
     passives: List[Optional[RePassiveRows]] = []
@@ -303,109 +410,81 @@ def build_random_effect_dataset(
     entity_to_loc: Dict[str, Tuple[int, int]] = {}
 
     for b in range(nb):
-        members = [entities[i] for i in range(len(entities)) if bucket_of[i] == b]
-        if not members:
+        ent_m = bucket_of == b
+        E = int(ent_m.sum())
+        if E == 0:
             continue
         bi = len(buckets)
-        E = len(members)
-        S = max(len(a) for (_, a, _, _) in members)
-        D = (
+        new_e = np.cumsum(ent_m) - 1  # entity code -> row within bucket
+        S = int(acounts[ent_m].max())
+        D = int(
             rproj.projected_dim
             if rproj
-            else max(max(len(lc), 1) for (_, _, _, lc) in members)
+            else max(int(np.maximum(dlocs[ent_m], 1).max()), 1)
         )
-        X = np.zeros((E, S, D), dtype=np.float32)
+
         lab = np.zeros((E, S), dtype=np.float32)
         off = np.zeros((E, S), dtype=np.float32)
         wt = np.zeros((E, S), dtype=np.float32)
         pos = np.zeros((E, S), dtype=np.int32)
+        rm = ent_m[e_act_g]
+        er, sr = new_e[e_act_g[rm]], s_act_g[rm]
+        lab[er, sr] = labels[act[rm]]
+        off[er, sr] = offsets[act[rm]]
+        wt[er, sr] = weights[act[rm]]
+        pos[er, sr] = act[rm]
+
         pidx = np.zeros((E, D), dtype=np.int32)
         pval = np.zeros((E, D), dtype=bool)
-        ids_b: List[str] = []
-
-        dlocs = np.array([len(lc) for (_, _, _, lc) in members], dtype=np.int64)
-        for e, (eid, _, _, local_cols) in enumerate(members):
-            ids_b.append(str(eid))
-            entity_to_loc[str(eid)] = (bi, e)
-            if rproj is None:
-                pidx[e, : len(local_cols)] = local_cols
-                pval[e, : len(local_cols)] = True
         if rproj is not None:
-            # projected-space coordinates are all live; back-projection to the
-            # original space goes through the shared matrix, not pidx
+            # projected-space coordinates are all live; back-projection goes
+            # through the shared matrix, not pidx
             pval[:, :] = True
-
-        # Flat key space entity*(G+1)+col is globally sorted (entities ascend,
-        # each local_cols list is sorted), so ONE searchsorted resolves every
-        # nonzero's local column — no per-sample Python loops.
-        G1 = global_dim + 1
-        flat_cols = (
-            np.concatenate([lc for (_, _, _, lc) in members])
-            if dlocs.sum()
-            else np.empty(0, dtype=np.int64)
-        )
-        flat_keys = np.repeat(np.arange(E, dtype=np.int64), dlocs) * G1 + flat_cols
-        dstart = np.concatenate([[0], np.cumsum(dlocs)[:-1]])
-
-        def local_scatter(rows_g: np.ndarray, e_of: np.ndarray, fill) -> None:
-            """Resolve (row, global col, val) triplets of ``rows_g`` to
-            (sample index into rows_g, local col, val); dropped features
-            (outside the entity's projected space) are skipped."""
-            rep, fidx = _expand_nnz(rows_g, row_start, row_end)
-            c, v = fc[fidx], fv[fidx]
-            qk = e_of[rep] * G1 + c
-            ii = np.searchsorted(flat_keys, qk)
-            ii_c = np.minimum(ii, max(len(flat_keys) - 1, 0))
-            match = (
-                (ii < len(flat_keys)) & (flat_keys[ii_c] == qk)
-                if len(flat_keys)
-                else np.zeros(len(qk), dtype=bool)
-            )
-            j = ii_c - dstart[e_of[rep]]
-            fill(rep[match], j[match], v[match])
-
-        alens = np.array([len(a) for (_, a, _, _) in members], dtype=np.int64)
-        act = (
-            np.concatenate([a for (_, a, _, _) in members])
-            if alens.sum()
-            else np.empty(0, dtype=np.int64)
-        )
-        e_act = np.repeat(np.arange(E, dtype=np.int64), alens)
-        s_act = (
-            np.concatenate([np.arange(l, dtype=np.int64) for l in alens])
-            if alens.sum()
-            else np.empty(0, dtype=np.int64)
-        )
-        lab[e_act, s_act] = labels[act]
-        off[e_act, s_act] = offsets[act]
-        wt[e_act, s_act] = weights[act]
-        pos[e_act, s_act] = act
-
-        def random_project(rows_g: np.ndarray) -> np.ndarray:
-            """x_projected = Bᵀ x per sample of ``rows_g`` (RANDOM projector)."""
-            rep, fidx = _expand_nnz(rows_g, row_start, row_end)
-            return rproj.project_coo(rep, fc[fidx], fv[fidx], len(rows_g))
-
-        if rproj is not None:
-            X[e_act, s_act] = random_project(act)
+        elif identity:
+            pidx[:, :] = np.arange(global_dim, dtype=np.int32)[None, :]
+            pval[:, :] = True
         else:
-            local_scatter(
-                act, e_act, lambda k, j, v: X.__setitem__((e_act[k], s_act[k], j), v)
-            )
+            km = ent_m[ecol]
+            jj = np.arange(len(ukeys), dtype=np.int64) - dstart[ecol]
+            pidx[new_e[ecol[km]], jj[km]] = ucol[km]
+            pval[new_e[ecol[km]], jj[km]] = True
 
-        plens = np.array([len(p) for (_, _, p, _) in members], dtype=np.int64)
-        n_pas = int(plens.sum())
-        pas = (
-            np.concatenate([p for (_, _, p, _) in members])
-            if n_pas
-            else np.empty(0, dtype=np.int64)
-        )
-        e_pas = np.repeat(np.arange(E, dtype=np.int64), plens)
+        X = np.zeros((E, S, D), dtype=np.float32)
+        if rproj is not None:
+            X[er, sr] = _project_rows(act[rm])
+        else:
+            zm = ent_m[nz_e] & nz_match
+            X[new_e[nz_e[zm]], s_act_g[rep_a[zm]], nz_j[zm]] = nz_v[zm]
+
+        pm = ent_m[e_pas_g]
+        pas_b = pas[pm]
+        n_pas = len(pas_b)
         pX = np.zeros((n_pas, D), dtype=np.float32)
-        if rproj is not None:
-            pX = random_project(pas)
-        else:
-            local_scatter(pas, e_pas, lambda k, j, v: pX.__setitem__((k, j), v))
+        if n_pas:
+            if rproj is not None:
+                pX = _project_rows(pas_b)
+            else:
+                rep_p, fidx_p = _expand_nnz(pas_b, row_start, row_end)
+                pc, pv_ = fc[fidx_p], fv[fidx_p]
+                pe = e_pas_g[pm][rep_p]
+                if identity:
+                    pX[rep_p, pc] = pv_
+                else:
+                    qk = pe * G1 + pc
+                    ii = np.searchsorted(ukeys, qk)
+                    ii_c = np.minimum(ii, max(len(ukeys) - 1, 0))
+                    match = (
+                        (ii < len(ukeys)) & (ukeys[ii_c] == qk)
+                        if len(ukeys)
+                        else np.zeros(len(qk), dtype=bool)
+                    )
+                    jcol = ii_c - dstart[pe]
+                    pX[rep_p[match], jcol[match]] = pv_[match]
+
+        ids_b = uniq[ent_m].tolist()
+        entity_to_loc.update(
+            (eid, (bi, e)) for e, eid in enumerate(ids_b)
+        )
 
         buckets.append(
             ReBucket(
@@ -421,8 +500,8 @@ def build_random_effect_dataset(
         passives.append(
             RePassiveRows(
                 X=jnp.asarray(pX),
-                entity_index=jnp.asarray(e_pas.astype(np.int32)),
-                sample_pos=jnp.asarray(pas.astype(np.int32)),
+                entity_index=jnp.asarray(new_e[e_pas_g[pm]].astype(np.int32)),
+                sample_pos=jnp.asarray(pas_b.astype(np.int32)),
             )
             if n_pas
             else None
