@@ -56,10 +56,13 @@ def device_plan(plan: PermPlan) -> DevicePlan:
     kinds = []
     for st in plan.stages:
         if isinstance(st, LaneShuffle):
-            idx.append(jnp.asarray(st.idx, dtype=jnp.int32))
+            # lane indices are < 128, sublane indices < 8: int8 on device
+            # halves the plan's HBM footprint and per-pass index traffic
+            # (kernels upcast in VMEM, which is free next to the loads)
+            idx.append(jnp.asarray(st.idx, dtype=jnp.int8))
             kinds.append(("lane",))
         elif isinstance(st, SublaneShuffle):
-            idx.append(jnp.asarray(st.idx, dtype=jnp.int32))
+            idx.append(jnp.asarray(st.idx, dtype=jnp.int8))
             kinds.append(("sublane", st.rows))
         elif isinstance(st, Enter):
             kinds.append(("enter", st.blocks, st.rows))
@@ -77,12 +80,18 @@ def _row_block(m: int) -> int:
     return m
 
 
+# Test hook: run the Pallas kernels through the interpreter (CPU) so their
+# semantics are covered by the 8-virtual-device harness, not just on TPU.
+_INTERPRET = False
+
+
 def _lane_shuffle_pallas(v: jax.Array, idx: jax.Array) -> jax.Array:
     m = v.shape[0]
     rb = _row_block(m)
 
     def kernel(x_ref, i_ref, o_ref):
-        o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=1)
+        sel = i_ref[:].astype(jnp.int32)
+        o_ref[:] = jnp.take_along_axis(x_ref[:], sel, axis=1)
 
     return pl.pallas_call(
         kernel,
@@ -93,6 +102,7 @@ def _lane_shuffle_pallas(v: jax.Array, idx: jax.Array) -> jax.Array:
         ],
         out_specs=pl.BlockSpec((rb, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m, LANES), v.dtype),
+        interpret=_INTERPRET,
     )(v, idx)
 
 
@@ -102,13 +112,19 @@ def _sublane_shuffle_pallas(v: jax.Array, idx: jax.Array, rows: int) -> jax.Arra
     assert rb % rows == 0
 
     def kernel(x_ref, i_ref, o_ref):
-        def body(g, _):
-            blk = x_ref[pl.ds(g * rows, rows), :]
-            sel = i_ref[pl.ds(g * rows, rows), :]
-            o_ref[pl.ds(g * rows, rows), :] = jnp.take_along_axis(blk, sel, axis=0)
-            return 0
-
-        jax.lax.fori_loop(0, rb // rows, body, 0)
+        # Loop-free within-group row movement: rows <= 8 source rows per
+        # group, so materialize each group-constant source row and select.
+        # (A fori_loop of tiny dynamic slices compiles pathologically in
+        # Mosaic at rb/rows ~ hundreds of steps; 'rows' selects vectorize.)
+        x = x_ref[:].reshape(rb // rows, rows, LANES)
+        sel = i_ref[:].astype(jnp.int32).reshape(rb // rows, rows, LANES)
+        acc = jnp.zeros_like(x)
+        for k in range(rows):
+            src_row = jax.lax.broadcast_in_dim(
+                x[:, k, :], x.shape, (0, 2)
+            )
+            acc = jnp.where(sel == k, src_row, acc)
+        o_ref[:] = acc.reshape(rb, LANES)
 
     return pl.pallas_call(
         kernel,
@@ -119,27 +135,30 @@ def _sublane_shuffle_pallas(v: jax.Array, idx: jax.Array, rows: int) -> jax.Arra
         ],
         out_specs=pl.BlockSpec((rb, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m, LANES), v.dtype),
+        interpret=_INTERPRET,
     )(v, idx)
 
 
 def _lane_shuffle_xla(v: jax.Array, idx: jax.Array) -> jax.Array:
-    return jnp.take_along_axis(v, idx, axis=1)
+    return jnp.take_along_axis(v, idx.astype(jnp.int32), axis=1)
 
 
 def _sublane_shuffle_xla(v: jax.Array, idx: jax.Array, rows: int) -> jax.Array:
     m = v.shape[0]
     blk = v.reshape(m // rows, rows, LANES)
-    sel = idx.reshape(m // rows, rows, LANES)
+    sel = idx.astype(jnp.int32).reshape(m // rows, rows, LANES)
     return jnp.take_along_axis(blk, sel, axis=1).reshape(m, LANES)
 
 
 def _use_pallas(m: int, rows: int | None = None) -> bool:
     if not (_HAS_PLTPU and pallas_available()):
         return False
-    if m < 8:
-        return False  # tiny plans: XLA handles them; no alignment games
-    if rows is not None and rows != 8:
-        return False  # sublane window != 8 would need unaligned tile slices
+    if m < 32:
+        return False  # tiny plans: XLA handles them; int8 tiles need >=32 rows
+    if _row_block(m) % 32 != 0:
+        return False  # int8 index blocks must respect the (32, 128) tile
+    if rows is not None and _row_block(m) % rows != 0:
+        return False
     return True
 
 
@@ -164,6 +183,8 @@ def apply_plan(dplan: DevicePlan, x: jax.Array) -> jax.Array:
             idx = dplan.idx[ai]
             ai += 1
             rows = kind[1]
+            if rows == 1:
+                continue  # single-row groups: identity movement
             if _use_pallas(v.shape[0], rows):
                 v = _sublane_shuffle_pallas(v, idx, rows)
             else:

@@ -238,12 +238,18 @@ class PermPlan:
 
 
 def valid_size(n: int) -> int:
-    """Smallest routable network size >= n: c * 128**(m+1), 1 <= c <= 8."""
+    """Smallest routable network size >= n: c * 128**(m+1), c in {1,2,4,8}.
+
+    c is restricted to powers of two so the recursion base emits
+    SublaneShuffle stages with rows in {1,2,4,8} — shapes the vectorized
+    Pallas sublane kernel handles; a non-power-of-two c would force the
+    scalar XLA gather fallback on TPU for that stage.
+    """
     if n <= 0:
         raise ValueError("size must be positive")
     base = LANES
     while True:
-        for c in range(1, MAX_SUBLANES + 1):
+        for c in (1, 2, 4, 8):
             if c * base >= n:
                 return c * base
         base *= LANES

@@ -49,6 +49,14 @@ class BenesSparseFeatures:
 
     Drop-in sibling of ``ops.features.EllFeatures`` (same matvec/rmatvec/
     rmatvec_sq/row_norms_sq protocol) for the large-d fixed-effect path.
+
+    High-degree ("hot") columns — intercept and frequent features, whose
+    degree would otherwise set the CSC padding KP and blow up the routed
+    network — are split out into a dense [n, H] side matrix that rides the
+    MXU directly (z += X_hot @ w[hot_cols]; g[hot_cols] += X_hot^T c). The
+    long tail stays in the permutation-routed sparse engine. The reference
+    has no analog (Breeze sparse axpy is degree-oblivious); on TPU the
+    split is what keeps both sides dense-regular.
     """
 
     ell_values: jax.Array     # [n, K] float32, 0 in padding slots
@@ -56,6 +64,8 @@ class BenesSparseFeatures:
                               # ell_values; stored to skip one permute)
     plan: DevicePlan          # CSC position q -> ELL position p
     plan_inv: DevicePlan      # ELL position p -> CSC position q
+    hot_matrix: Optional[jax.Array]  # [n, H] dense hot columns (or None)
+    hot_cols: Optional[jax.Array]    # [H] int32 original column ids
     num_rows_: int = struct.field(pytree_node=False)
     num_cols_: int = struct.field(pytree_node=False)
 
@@ -92,24 +102,36 @@ class BenesSparseFeatures:
         wexp = jnp.broadcast_to(w[:, None], (d, kp)).reshape(-1)
         wexp = self._pad_ell(wexp) if wexp.shape[0] < self.plan.size else wexp
         w_ell = self._to_ell(wexp)[: n * k].reshape(n, k)
-        return jnp.sum(self.ell_values * w_ell, axis=-1)
+        z = jnp.sum(self.ell_values * w_ell, axis=-1)
+        if self.hot_matrix is not None:
+            z = z + self.hot_matrix @ w[self.hot_cols]
+        return z
 
     def rmatvec(self, c: jax.Array) -> jax.Array:
-        return self._rmatvec_impl(self.ell_values, c)
+        return self._rmatvec_impl(self.ell_values, self.hot_matrix, c)
 
     def rmatvec_sq(self, c: jax.Array) -> jax.Array:
-        return self._rmatvec_impl(self.ell_values * self.ell_values, c)
+        hot_sq = None if self.hot_matrix is None else self.hot_matrix * self.hot_matrix
+        return self._rmatvec_impl(self.ell_values * self.ell_values, hot_sq, c)
 
-    def _rmatvec_impl(self, vals: jax.Array, c: jax.Array) -> jax.Array:
+    def _rmatvec_impl(
+        self, vals: jax.Array, hot: Optional[jax.Array], c: jax.Array
+    ) -> jax.Array:
         n, k = vals.shape
         d, kp = self.csc_values.shape
         t = (vals * c[:, None]).reshape(-1)
         t = self._pad_ell(t) if t.shape[0] < self.plan.size else t
         t_csc = self._to_csc(t)[: d * kp].reshape(d, kp)
-        return jnp.sum(t_csc, axis=-1)
+        g = jnp.sum(t_csc, axis=-1)
+        if hot is not None:
+            g = g.at[self.hot_cols].add(hot.T @ c)
+        return g
 
     def row_norms_sq(self) -> jax.Array:
-        return jnp.sum(self.ell_values * self.ell_values, axis=-1)
+        sq = jnp.sum(self.ell_values * self.ell_values, axis=-1)
+        if self.hot_matrix is not None:
+            sq = sq + jnp.sum(self.hot_matrix * self.hot_matrix, axis=-1)
+        return sq
 
     def to_dense(self):
         """Densify via one matvec per unit vector — test-scale only."""
@@ -127,6 +149,8 @@ def from_coo(
     shape,
     max_nnz_row: Optional[int] = None,
     plan_cache: Optional[str] = None,
+    hot_col_threshold: Optional[int] = None,
+    max_hot_cols: int = 128,
 ) -> BenesSparseFeatures:
     """Build from COO triplets (host, vectorized numpy + one Benes routing).
 
@@ -134,6 +158,12 @@ def from_coo(
     is the expensive one-time prep step (seconds to ~a minute at 1e7 nnz —
     the analog of the reference's one-time RDD dataset build); pass
     ``plan_cache`` (a directory) to memoize it keyed on the sparsity pattern.
+
+    Columns with degree > ``hot_col_threshold`` (default: auto — 4x the mean
+    column degree, at least 8) are split into a dense MXU side matrix, capped
+    at the ``max_hot_cols`` highest-degree columns. Without the split an
+    intercept column (degree n) would pad every CSC column to n slots. Pass
+    ``max_hot_cols=0`` to disable.
     """
     n, d = shape
     rows = np.asarray(rows, dtype=np.int64)
@@ -159,12 +189,50 @@ def from_coo(
         vals = summed.astype(np.float32)
 
     nnz = rows.size
+    if max_nnz_row is not None and nnz:
+        k_orig = int(np.bincount(rows, minlength=n).max())
+        if k_orig > int(max_nnz_row):
+            raise ValueError(
+                f"row with {k_orig} nnz exceeds max_nnz_row={max_nnz_row}"
+            )
+
+    # Hot-column split: move the highest-degree columns to a dense side
+    # matrix so the CSC padding KP tracks the long tail, not the intercept.
+    # A column only qualifies when densifying it is actually cheap: degree
+    # >= n/16 bounds the dense-storage inflation at 16x the entries moved
+    # (mildly-hot columns would waste n floats each for little KP relief).
+    # The n*H dense block is further capped at ~512 MB.
+    hot_matrix = None
+    hot_ids = None
+    if nnz and max_hot_cols > 0:
+        col_counts_all = np.bincount(cols, minlength=d)
+        if hot_col_threshold is None:
+            thr = max(8, int(4 * np.ceil(nnz / max(d, 1))), n // 16)
+        else:
+            thr = int(hot_col_threshold)
+        h_cap = min(int(max_hot_cols), max(1, (128 << 20) // max(n, 1)))
+        hot_mask_cols = col_counts_all > thr
+        n_hot = int(hot_mask_cols.sum())
+        if n_hot > h_cap:
+            top = np.argpartition(col_counts_all, -h_cap)[-h_cap:]
+            hot_ids = np.sort(top)
+        elif n_hot > 0:
+            hot_ids = np.flatnonzero(hot_mask_cols)
+        if hot_ids is not None:
+            hot_pos = np.full(d, -1, dtype=np.int64)
+            hot_pos[hot_ids] = np.arange(hot_ids.size)
+            is_hot = hot_pos[cols] >= 0
+            hot_matrix = np.zeros((n, hot_ids.size), dtype=np.float32)
+            hot_matrix[rows[is_hot], hot_pos[cols[is_hot]]] = vals[is_hot]
+            rows, cols, vals = rows[~is_hot], cols[~is_hot], vals[~is_hot]
+            nnz = rows.size
+
     row_counts = np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
     col_counts = np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
     k_needed = int(row_counts.max()) if nnz else 1
+    # max_nnz_row doubles as a K floor so callers get shape-stable [n, K]
+    # ELL arrays across datasets (one jit compilation serves them all).
     K = max(k_needed, int(max_nnz_row) if max_nnz_row is not None else 1, 1)
-    if k_needed > K:
-        raise ValueError(f"row with {k_needed} nnz exceeds max_nnz_row={K}")
     KP = max(int(col_counts.max()) if nnz else 1, 1)
 
     S = routing.valid_size(max(n * K, d * KP))
@@ -206,6 +274,8 @@ def from_coo(
         csc_values=jnp.asarray(csc_values),
         plan=device_plan(plan),
         plan_inv=device_plan(plan_inv),
+        hot_matrix=None if hot_matrix is None else jnp.asarray(hot_matrix),
+        hot_cols=None if hot_ids is None else jnp.asarray(hot_ids, dtype=jnp.int32),
         num_rows_=int(n),
         num_cols_=int(d),
     )

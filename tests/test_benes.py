@@ -210,3 +210,87 @@ class TestBenesSparseFeatures:
         assert np.allclose(
             results["ell"].w, results["benes"].w, atol=2e-3
         )
+
+
+class TestPallasKernelsInterpret:
+    """Interpreter-mode coverage of the TPU shuffle kernels (the 8-virtual-
+    device harness can't run Mosaic natively; semantics still must match the
+    XLA fallback exactly)."""
+
+    def _with_interpret(self, fn):
+        from photon_ml_tpu.ops import permute_net
+
+        old = permute_net._INTERPRET
+        permute_net._INTERPRET = True
+        try:
+            return fn()
+        finally:
+            permute_net._INTERPRET = old
+
+    def test_lane_shuffle_kernel(self, rng):
+        from photon_ml_tpu.ops import permute_net
+
+        m = 256
+        v = jnp.asarray(rng.standard_normal((m, 128)), dtype=jnp.float32)
+        idx = jnp.asarray(rng.integers(0, 128, (m, 128)), dtype=jnp.int8)
+        got = self._with_interpret(
+            lambda: permute_net._lane_shuffle_pallas(v, idx)
+        )
+        want = permute_net._lane_shuffle_xla(v, idx)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("rows", [2, 4, 8])
+    def test_sublane_shuffle_kernel(self, rng, rows):
+        from photon_ml_tpu.ops import permute_net
+
+        m = 256
+        v = jnp.asarray(rng.standard_normal((m, 128)), dtype=jnp.float32)
+        idx = jnp.asarray(rng.integers(0, rows, (m, 128)), dtype=jnp.int8)
+        got = self._with_interpret(
+            lambda: permute_net._sublane_shuffle_pallas(v, idx, rows)
+        )
+        want = permute_net._sublane_shuffle_xla(v, idx, rows)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestHotColumnSplit:
+    def test_intercept_column_goes_dense(self, rng):
+        """An intercept (degree n) column must not inflate the CSC padding:
+        it rides the dense MXU side channel (reference data always carries
+        an intercept feature, Constants.scala INTERCEPT_KEY)."""
+        n, d, k = 512, 256, 4
+        rows = np.repeat(np.arange(n), k + 1)
+        cols = np.concatenate(
+            [rng.integers(1, d, (n, k)), np.zeros((n, 1), np.int64)], axis=1
+        ).reshape(-1)
+        vals = rng.standard_normal(n * (k + 1)).astype(np.float32)
+        bsf = from_coo(rows, cols, vals, (n, d))
+        assert bsf.hot_matrix is not None
+        assert 0 in np.asarray(bsf.hot_cols)  # intercept column split out
+        # CSC padding tracks the tail, not the intercept
+        assert bsf.csc_values.shape[1] < n // 4
+
+        dense = np.zeros((n, d), dtype=np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        w = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+        c = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+        assert np.allclose(bsf.matvec(w), dense @ np.asarray(w), atol=1e-4)
+        assert np.allclose(bsf.rmatvec(c), dense.T @ np.asarray(c), atol=1e-4)
+        assert np.allclose(
+            bsf.rmatvec_sq(c), (dense * dense).T @ np.asarray(c), atol=1e-4
+        )
+        assert np.allclose(
+            bsf.row_norms_sq(), (dense * dense).sum(1), atol=1e-4
+        )
+
+    def test_disable_hot_split(self, rng):
+        n, d, k = 64, 32, 2
+        rows = np.repeat(np.arange(n), k)
+        cols = rng.integers(0, d, n * k)
+        vals = rng.standard_normal(n * k).astype(np.float32)
+        bsf = from_coo(rows, cols, vals, (n, d), max_hot_cols=0)
+        assert bsf.hot_matrix is None
+        dense = np.zeros((n, d), dtype=np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        w = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+        assert np.allclose(bsf.matvec(w), dense @ np.asarray(w), atol=1e-4)
