@@ -106,7 +106,9 @@ def parse_coordinate_config(cfg: dict) -> CoordinateConfiguration:
     optimizer = parse_optimizer_config(cfg.get("optimizer", {}))
     if ctype == "fixed":
         return FixedEffectCoordinateConfiguration(
-            feature_shard=shard, optimizer=optimizer
+            feature_shard=shard,
+            optimizer=optimizer,
+            sparse_engine=cfg.get("sparse_engine", "auto"),
         )
     re_type = cfg["random_effect_type"]
     data = parse_re_data_config(cfg.get("data", {}), re_type)

@@ -231,7 +231,7 @@ def run(args: argparse.Namespace) -> GameFit:
                     import jax.numpy as jnp
 
                     labeled = LabeledData.create(
-                        data.ell_features(sid), jnp.asarray(data.labels),
+                        data.sparse_features(sid, engine="auto"), jnp.asarray(data.labels),
                         weights=jnp.asarray(data.weights),
                     )
                     summary = summarize(labeled)

@@ -93,7 +93,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 def _labeled_from_game(data, shard: str, norm=None) -> LabeledData:
     return LabeledData.create(
-        data.ell_features(shard),
+        data.sparse_features(shard, engine="auto"),
         jnp.asarray(data.labels),
         offsets=jnp.asarray(data.offsets),
         weights=jnp.asarray(data.weights),
@@ -235,7 +235,7 @@ def run(args: argparse.Namespace) -> dict:
                     vdata, _, _ = read_game_data(
                         args.validation_data_dirs, shard_cfg, index_maps
                     )
-                vfeats = vdata.ell_features("features")
+                vfeats = vdata.sparse_features("features", engine="auto")
                 for fit in fits:
                     scores = np.asarray(
                         fit.model.compute_score(vfeats)
@@ -332,7 +332,7 @@ def _diagnose(
     )
 
     best = next(f for f in fits if f.regularization_weight == best_lambda)
-    feats = data.ell_features("features")
+    feats = data.sparse_features("features", engine="auto")
     scores = np.asarray(best.model.compute_score(feats)) + data.offsets
     metrics = evaluate_metrics(scores, data.labels, task, data.weights)
 
@@ -347,7 +347,7 @@ def _diagnose(
             regularization_weights=[best_lambda],
             intercept_index=intercept_index,
         )[0]
-        s = np.asarray(fit.model.compute_score(sub.ell_features("features")))
+        s = np.asarray(fit.model.compute_score(sub.sparse_features("features", engine="auto")))
         return (
             np.asarray(fit.model.coefficients.means),
             evaluate_metrics(s + sub.offsets, sub.labels, task, sub.weights),

@@ -124,15 +124,44 @@ class GameData:
     def ell_features(self, shard_name: str):
         """Device ELL layout of one shard, built once and cached (validation
         re-scores the same data after every coordinate update)."""
-        cache = getattr(self, "_ell_cache", None)
+        return self.sparse_features(shard_name, engine="ell")
+
+    def sparse_features(self, shard_name: str, engine: str = "auto"):
+        """Device sparse layout of one shard, built once and cached.
+
+        engine:
+        - "ell"   — padded row-sparse gather/scatter layout (XLA).
+        - "benes" — permutation-routed engine (ops/sparse_perm.py): vector-
+          speed matvec/rmatvec on TPU, with a one-time host routing cost.
+        - "auto"  — "benes" on a TPU backend when the shard is large enough
+          for the routing prep to pay for itself, else "ell".
+        """
+        if engine not in ("auto", "ell", "benes"):
+            raise ValueError(
+                f"unknown sparse engine {engine!r}; expected auto/ell/benes"
+            )
+        cache = getattr(self, "_feat_cache", None)
         if cache is None:
             cache = {}
-            self._ell_cache = cache
-        if shard_name not in cache:
-            from photon_ml_tpu.ops.features import from_scipy_like
+            self._feat_cache = cache
+        shard = self.feature_shards[shard_name]
+        if engine == "auto":
+            import jax
 
-            shard = self.feature_shards[shard_name]
-            cache[shard_name] = from_scipy_like(
-                shard.rows, shard.cols, shard.vals, (self.num_rows, shard.dim)
-            )
-        return cache[shard_name]
+            on_tpu = jax.default_backend() == "tpu"
+            engine = "benes" if on_tpu and shard.rows.size >= (1 << 20) else "ell"
+        key = (shard_name, engine)
+        if key not in cache:
+            if engine == "benes":
+                from photon_ml_tpu.ops.sparse_perm import from_coo
+
+                cache[key] = from_coo(
+                    shard.rows, shard.cols, shard.vals, (self.num_rows, shard.dim)
+                )
+            else:
+                from photon_ml_tpu.ops.features import from_scipy_like
+
+                cache[key] = from_scipy_like(
+                    shard.rows, shard.cols, shard.vals, (self.num_rows, shard.dim)
+                )
+        return cache[key]

@@ -54,6 +54,10 @@ class FixedEffectCoordinateConfiguration:
 
     feature_shard: str
     optimizer: GlmOptimizationConfiguration = GlmOptimizationConfiguration()
+    # sparse engine for the global problem: "auto" | "ell" | "benes"
+    # (GameData.sparse_features; "auto" routes large TPU problems through
+    # the permutation engine)
+    sparse_engine: str = "auto"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,7 +126,7 @@ class GameEstimator:
         shard = data.feature_shards[cfg.feature_shard]
         if isinstance(cfg, FixedEffectCoordinateConfiguration):
             labeled = LabeledData.create(
-                data.ell_features(cfg.feature_shard),
+                data.sparse_features(cfg.feature_shard, engine=cfg.sparse_engine),
                 jnp.asarray(data.labels),
                 offsets=jnp.asarray(data.offsets),
                 weights=jnp.asarray(data.weights),

@@ -52,7 +52,11 @@ class GameModel:
         m = self.meta[cid]
         shard = data.feature_shards[m.feature_shard]
         if isinstance(model, GeneralizedLinearModel):
-            return np.asarray(model.compute_score(data.ell_features(m.feature_shard)))
+            return np.asarray(
+                model.compute_score(
+                    data.sparse_features(m.feature_shard, engine="auto")
+                )
+            )
         assert m.random_effect_type is not None
         entity_ids = data.id_tags[m.random_effect_type]
         from photon_ml_tpu.algorithm.factored_random_effect import (

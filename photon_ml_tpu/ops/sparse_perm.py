@@ -166,6 +166,42 @@ def from_coo(
     ``max_hot_cols=0`` to disable.
     """
     n, d = shape
+    rows, cols, vals = coalesce_coo(rows, cols, vals, n, d)
+
+    nnz = rows.size
+    if max_nnz_row is not None and nnz:
+        k_orig = int(np.bincount(rows, minlength=n).max())
+        if k_orig > int(max_nnz_row):
+            raise ValueError(
+                f"row with {k_orig} nnz exceeds max_nnz_row={max_nnz_row}"
+            )
+
+    hot_ids = select_hot_cols(
+        rows, cols, n, d, hot_col_threshold, max_hot_cols
+    )
+    hot_matrix = None
+    if hot_ids is not None:
+        rows, cols, vals, hot_matrix = split_hot_entries(
+            rows, cols, vals, n, d, hot_ids
+        )
+        nnz = rows.size
+
+    row_counts = np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
+    col_counts = np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
+    k_needed = int(row_counts.max()) if nnz else 1
+    # max_nnz_row doubles as a K floor so callers get shape-stable [n, K]
+    # ELL arrays across datasets (one jit compilation serves them all).
+    K = max(k_needed, int(max_nnz_row) if max_nnz_row is not None else 1, 1)
+    KP = max(int(col_counts.max()) if nnz else 1, 1)
+
+    return _assemble(
+        rows, cols, vals, n, d, K, KP, hot_matrix, hot_ids, plan_cache
+    )
+
+
+def coalesce_coo(rows, cols, vals, n: int, d: int):
+    """Validate index ranges and coalesce duplicate (row, col) entries by
+    summation (scipy COO semantics; accumulation in float64)."""
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     vals = np.asarray(vals, dtype=np.float32)
@@ -174,9 +210,6 @@ def from_coo(
             raise ValueError(f"row index out of range [0, {n})")
         if cols.min() < 0 or cols.max() >= d:
             raise ValueError(f"column index out of range [0, {d})")
-
-    # Coalesce duplicates (sort by (row, col), sum runs).
-    if rows.size:
         order = np.lexsort((cols, rows))
         rows, cols, vals = rows[order], cols[order], vals[order]
         boundary = np.empty(rows.size, dtype=bool)
@@ -187,55 +220,79 @@ def from_coo(
         np.add.at(summed, seg, vals)
         rows, cols = rows[boundary], cols[boundary]
         vals = summed.astype(np.float32)
+    return rows, cols, vals
 
+
+def select_hot_cols(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n_rows_per_shard: int,
+    d: int,
+    hot_col_threshold: Optional[int],
+    max_hot_cols: int,
+) -> Optional[np.ndarray]:
+    """Pick the hot-column set (sorted ids) or None.
+
+    A column only qualifies when densifying it is actually cheap: degree
+    >= n/16 bounds the dense-storage inflation at 16x the entries moved
+    (mildly-hot columns would waste n floats each for little KP relief).
+    The n*H dense block is further capped at ~512 MB. ``n_rows_per_shard``
+    is the dense side's row count (the local row count for sharded data).
+    """
     nnz = rows.size
-    if max_nnz_row is not None and nnz:
-        k_orig = int(np.bincount(rows, minlength=n).max())
-        if k_orig > int(max_nnz_row):
-            raise ValueError(
-                f"row with {k_orig} nnz exceeds max_nnz_row={max_nnz_row}"
-            )
+    if not nnz or max_hot_cols <= 0:
+        return None
+    col_counts_all = np.bincount(cols, minlength=d)
+    if hot_col_threshold is None:
+        thr = max(8, int(4 * np.ceil(nnz / max(d, 1))), n_rows_per_shard // 16)
+    else:
+        thr = int(hot_col_threshold)
+    h_cap = min(
+        int(max_hot_cols), max(1, (128 << 20) // max(n_rows_per_shard, 1))
+    )
+    hot_mask = col_counts_all > thr
+    n_hot = int(hot_mask.sum())
+    if n_hot > h_cap:
+        top = np.argpartition(col_counts_all, -h_cap)[-h_cap:]
+        return np.sort(top)
+    if n_hot > 0:
+        return np.flatnonzero(hot_mask)
+    return None
 
-    # Hot-column split: move the highest-degree columns to a dense side
-    # matrix so the CSC padding KP tracks the long tail, not the intercept.
-    # A column only qualifies when densifying it is actually cheap: degree
-    # >= n/16 bounds the dense-storage inflation at 16x the entries moved
-    # (mildly-hot columns would waste n floats each for little KP relief).
-    # The n*H dense block is further capped at ~512 MB.
-    hot_matrix = None
-    hot_ids = None
-    if nnz and max_hot_cols > 0:
-        col_counts_all = np.bincount(cols, minlength=d)
-        if hot_col_threshold is None:
-            thr = max(8, int(4 * np.ceil(nnz / max(d, 1))), n // 16)
-        else:
-            thr = int(hot_col_threshold)
-        h_cap = min(int(max_hot_cols), max(1, (128 << 20) // max(n, 1)))
-        hot_mask_cols = col_counts_all > thr
-        n_hot = int(hot_mask_cols.sum())
-        if n_hot > h_cap:
-            top = np.argpartition(col_counts_all, -h_cap)[-h_cap:]
-            hot_ids = np.sort(top)
-        elif n_hot > 0:
-            hot_ids = np.flatnonzero(hot_mask_cols)
-        if hot_ids is not None:
-            hot_pos = np.full(d, -1, dtype=np.int64)
-            hot_pos[hot_ids] = np.arange(hot_ids.size)
-            is_hot = hot_pos[cols] >= 0
-            hot_matrix = np.zeros((n, hot_ids.size), dtype=np.float32)
-            hot_matrix[rows[is_hot], hot_pos[cols[is_hot]]] = vals[is_hot]
-            rows, cols, vals = rows[~is_hot], cols[~is_hot], vals[~is_hot]
-            nnz = rows.size
 
+def split_hot_entries(rows, cols, vals, n: int, d: int, hot_ids: np.ndarray):
+    """Split entries into (cold rows/cols/vals, dense [n, H] hot matrix)."""
+    hot_pos = np.full(d, -1, dtype=np.int64)
+    hot_pos[hot_ids] = np.arange(hot_ids.size)
+    is_hot = hot_pos[cols] >= 0
+    hot_matrix = np.zeros((n, hot_ids.size), dtype=np.float32)
+    hot_matrix[rows[is_hot], hot_pos[cols[is_hot]]] = vals[is_hot]
+    return rows[~is_hot], cols[~is_hot], vals[~is_hot], hot_matrix
+
+
+def _assemble(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n: int,
+    d: int,
+    K: int,
+    KP: int,
+    hot_matrix: Optional[np.ndarray],
+    hot_ids: Optional[np.ndarray],
+    plan_cache: Optional[str],
+    size_floor: int = 0,
+) -> BenesSparseFeatures:
+    """Route + lay out one (cold-entries, hot-side) pair with pinned paddings.
+
+    K/KP/size_floor are caller-pinned so independent shards of one dataset
+    can be forced onto identical network shapes (the sharded builder stacks
+    them under one compiled program).
+    """
+    nnz = rows.size
     row_counts = np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
     col_counts = np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
-    k_needed = int(row_counts.max()) if nnz else 1
-    # max_nnz_row doubles as a K floor so callers get shape-stable [n, K]
-    # ELL arrays across datasets (one jit compilation serves them all).
-    K = max(k_needed, int(max_nnz_row) if max_nnz_row is not None else 1, 1)
-    KP = max(int(col_counts.max()) if nnz else 1, 1)
-
-    S = routing.valid_size(max(n * K, d * KP))
+    S = routing.valid_size(max(n * K, d * KP, size_floor, 1))
 
     # ELL slot of each entry: row-major position row*K + slot.
     row_starts = np.zeros(n + 1, dtype=np.int64)
