@@ -169,9 +169,39 @@ def _cpu_baseline(fe_np, re_np, fe_iters, re_iters):
     return fe_per_eval * fe_iters + re_per_eval * re_iters
 
 
+def _arm_watchdog(seconds: int = 2700) -> None:
+    """Hard deadline: if the accelerator backend hangs (e.g. the device
+    tunnel is wedged), still emit one well-formed JSON line and exit instead
+    of blocking the caller forever."""
+    import os
+    import sys
+    import threading
+
+    def fire():
+        print(
+            json.dumps(
+                {
+                    "metric": "glmix_logistic_train_throughput",
+                    "value": 0.0,
+                    "unit": "example_passes/sec/chip",
+                    "vs_baseline": 0.0,
+                    "error": f"watchdog: no result within {seconds}s (backend hang?)",
+                }
+            ),
+            flush=True,
+        )
+        sys.stderr.write("bench watchdog fired\n")
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+
+
 def main():
     import sys
 
+    _arm_watchdog(int(__import__("os").environ.get("BENCH_WATCHDOG_S", "2700")))
     fe_np, fe_data, re_np, re_data = _build()
     passes, tpu_time, fe_iters, re_iters = _tpu_run(fe_data, re_data)
 

@@ -45,11 +45,20 @@ _SAMPLE_FRACTION = 0.10  # VALIDATE_SAMPLE fraction
 
 
 def _feature_values(data: LabeledData) -> np.ndarray:
+    """Per-row explicit feature values as [n, *] (padding slots are 0.0 and
+    vacuously finite, so they never mask a NaN/Inf)."""
     feats = data.features
     if isinstance(feats, DenseFeatures):
         return np.asarray(feats.matrix)
     if isinstance(feats, EllFeatures):
         return np.asarray(feats.values)
+    from photon_ml_tpu.ops.sparse_perm import BenesSparseFeatures
+
+    if isinstance(feats, BenesSparseFeatures):
+        cold = np.asarray(feats.ell_values)
+        if feats.hot_matrix is None:
+            return cold
+        return np.concatenate([cold, np.asarray(feats.hot_matrix)], axis=1)
     raise TypeError(f"unknown feature matrix type {type(feats)!r}")
 
 

@@ -169,7 +169,10 @@ class GameEstimator:
         meta = {}
         for cid, cfg in self.coordinate_configs.items():
             if isinstance(cfg, FixedEffectCoordinateConfiguration):
-                meta[cid] = CoordinateMeta(feature_shard=cfg.feature_shard)
+                meta[cid] = CoordinateMeta(
+                    feature_shard=cfg.feature_shard,
+                    sparse_engine=cfg.sparse_engine,
+                )
             else:
                 meta[cid] = CoordinateMeta(
                     feature_shard=cfg.feature_shard,

@@ -28,6 +28,9 @@ class CoordinateMeta:
 
     feature_shard: str
     random_effect_type: Optional[str] = None
+    # sparse engine the coordinate was configured with (fixed effects):
+    # scoring reuses the same representation instead of building a second
+    sparse_engine: str = "auto"
 
 
 SubModel = Union[
@@ -54,7 +57,7 @@ class GameModel:
         if isinstance(model, GeneralizedLinearModel):
             return np.asarray(
                 model.compute_score(
-                    data.sparse_features(m.feature_shard, engine="auto")
+                    data.sparse_features(m.feature_shard, engine=m.sparse_engine)
                 )
             )
         assert m.random_effect_type is not None

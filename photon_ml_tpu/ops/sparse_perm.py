@@ -195,7 +195,8 @@ def from_coo(
     KP = max(int(col_counts.max()) if nnz else 1, 1)
 
     return _assemble(
-        rows, cols, vals, n, d, K, KP, hot_matrix, hot_ids, plan_cache
+        rows, cols, vals, n, d, K, KP, hot_matrix, hot_ids, plan_cache,
+        row_counts=row_counts, col_counts=col_counts,
     )
 
 
@@ -282,16 +283,28 @@ def _assemble(
     hot_ids: Optional[np.ndarray],
     plan_cache: Optional[str],
     size_floor: int = 0,
+    row_counts: Optional[np.ndarray] = None,
+    col_counts: Optional[np.ndarray] = None,
 ) -> BenesSparseFeatures:
     """Route + lay out one (cold-entries, hot-side) pair with pinned paddings.
 
     K/KP/size_floor are caller-pinned so independent shards of one dataset
     can be forced onto identical network shapes (the sharded builder stacks
-    them under one compiled program).
+    them under one compiled program). Callers that already hold the degree
+    bincounts pass them to skip a recount.
     """
     nnz = rows.size
-    row_counts = np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
-    col_counts = np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
+    if row_counts is None:
+        row_counts = (
+            np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
+        )
+    if col_counts is None:
+        col_counts = (
+            np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
+        )
+    assert not nnz or (
+        row_counts.max() <= K and col_counts.max() <= KP
+    ), "pinned paddings smaller than actual degrees"
     S = routing.valid_size(max(n * K, d * KP, size_floor, 1))
 
     # ELL slot of each entry: row-major position row*K + slot.

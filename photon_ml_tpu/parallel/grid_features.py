@@ -1,0 +1,330 @@
+"""2-D (data x feature) sharded fixed-effect features: the 1B-coefficient path.
+
+Reference parity: the reference scales the fixed effect by partitioning
+examples across executors and broadcasting the full coefficient vector to
+every task each evaluation (DistributedObjectiveFunction convertFromVector;
+treeAggregate ValueAndGradientAggregator.scala:243-247). That caps the
+model at driver/executor heap. Here BOTH axes shard: the example axis over
+a "data" mesh axis and the coefficient axis over a "feat" mesh axis, so a
+1e9-coefficient vector lives as n_feat-way shards (w, grad, and the L-BFGS
+history never materialize on one chip — SURVEY.md §7 hard part (d)).
+
+Collectives per objective evaluation (all ICI, inserted here or by GSPMD):
+- matvec:  psum of partial margins over "feat" (each device owns a column
+  range; z_tile = X_tile @ w_local).
+- rmatvec: psum of partial gradients over "data" (each device reduces its
+  row block; output stays feat-sharded — no device ever holds full grad).
+- loss sums / w dot products: GSPMD inserts the psums (sharded operands).
+
+Each (data, feat) mesh tile holds its own sparse engine instance — the
+permutation-routed Benes engine (TPU) or the ELL gather layout (CPU tests)
+— routed with identical paddings so one compiled program serves the grid.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.ops import routing
+from photon_ml_tpu.ops.features import EllFeatures
+from photon_ml_tpu.ops.sparse_perm import (
+    _assemble,
+    coalesce_coo,
+    select_hot_cols,
+    split_hot_entries,
+)
+from photon_ml_tpu.parallel.mesh import shard_map
+
+DATA_AXIS = "data"
+FEAT_AXIS = "feat"
+
+
+def grid_mesh(
+    n_data: int, n_feat: int, devices=None
+) -> Mesh:
+    """(n_data x n_feat) mesh over the flat device list."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_data * n_feat
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_data, n_feat)
+    return Mesh(grid, (DATA_AXIS, FEAT_AXIS))
+
+
+@struct.dataclass
+class GridShardedFeatures:
+    """[n, d] sparse matrix tiled over a (data, feat) mesh.
+
+    FeatureMatrix protocol over GLOBAL logical shapes with sharded layouts:
+    ``matvec`` maps a feat-sharded ``w`` [d_pad] to data-sharded margins
+    [n_pad]; ``rmatvec`` maps data-sharded coefficients to a feat-sharded
+    gradient. Use :func:`shard_vector_feat` / :func:`shard_vector_data` to
+    place vectors accordingly.
+    """
+
+    shards: object  # per-tile engine pytree; array leaves [n_dd, n_df, ...]
+    mesh: Mesh = struct.field(pytree_node=False)
+    num_rows_: int = struct.field(pytree_node=False)  # padded global rows
+    num_cols_: int = struct.field(pytree_node=False)  # padded global cols
+
+    @property
+    def num_rows(self) -> int:
+        return self.num_rows_
+
+    @property
+    def dim(self) -> int:
+        return self.num_cols_
+
+    def _n_dd(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    def _n_df(self) -> int:
+        return self.mesh.shape[FEAT_AXIS]
+
+    def matvec(self, w: jax.Array) -> jax.Array:
+        w2 = w.reshape(self._n_df(), -1)
+
+        def local_mv(shards, w_blk):
+            tile = jax.tree.map(lambda a: a[0, 0], shards)
+            z = tile.matvec(w_blk[0])
+            return jax.lax.psum(z, FEAT_AXIS)[None]
+
+        out = shard_map(
+            local_mv,
+            mesh=self.mesh,
+            in_specs=(P(DATA_AXIS, FEAT_AXIS), P(FEAT_AXIS)),
+            out_specs=P(DATA_AXIS),
+        )(self.shards, w2)
+        return out.reshape(-1)
+
+    def rmatvec(self, c: jax.Array) -> jax.Array:
+        return self._rmatvec(c, squared=False)
+
+    def rmatvec_sq(self, c: jax.Array) -> jax.Array:
+        return self._rmatvec(c, squared=True)
+
+    def _rmatvec(self, c: jax.Array, squared: bool) -> jax.Array:
+        c2 = c.reshape(self._n_dd(), -1)
+
+        def local_rmv(shards, c_blk):
+            tile = jax.tree.map(lambda a: a[0, 0], shards)
+            g = tile.rmatvec_sq(c_blk[0]) if squared else tile.rmatvec(c_blk[0])
+            return jax.lax.psum(g, DATA_AXIS)[None]
+
+        out = shard_map(
+            local_rmv,
+            mesh=self.mesh,
+            in_specs=(P(DATA_AXIS, FEAT_AXIS), P(DATA_AXIS)),
+            out_specs=P(FEAT_AXIS),
+        )(self.shards, c2)
+        return out.reshape(-1)
+
+    def row_norms_sq(self) -> jax.Array:
+        def local_rn(shards):
+            tile = jax.tree.map(lambda a: a[0, 0], shards)
+            return jax.lax.psum(tile.row_norms_sq(), FEAT_AXIS)[None]
+
+        out = shard_map(
+            local_rn,
+            mesh=self.mesh,
+            in_specs=(P(DATA_AXIS, FEAT_AXIS),),
+            out_specs=P(DATA_AXIS),
+        )(self.shards)
+        return out.reshape(-1)
+
+
+def shard_vector_feat(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place a [d_pad] vector sharded over the feat axis (replicated over
+    data) — the layout for w, grad, and optimizer history rows."""
+    return jax.device_put(x, NamedSharding(mesh, P(FEAT_AXIS)))
+
+
+def shard_vector_data(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place an [n_pad] vector sharded over the data axis (labels, offsets,
+    weights, margins)."""
+    return jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS)))
+
+
+def grid_from_coo(
+    rows,
+    cols,
+    vals,
+    shape: Tuple[int, int],
+    mesh: Mesh,
+    engine: str = "benes",
+    plan_cache: Optional[str] = None,
+    hot_col_threshold: Optional[int] = None,
+    max_hot_cols: int = 128,
+) -> GridShardedFeatures:
+    """Tile COO entries over the (data, feat) mesh and route each tile
+    identically.
+
+    Rows pad to a multiple of the data-axis size, columns to a multiple of
+    the feat-axis size; callers padding labels/weights must give padding
+    rows weight 0 (padded columns are simply never touched).
+    """
+    if engine not in ("benes", "ell"):
+        raise ValueError(f"unknown engine {engine!r}; expected benes/ell")
+    n, d = shape
+    n_dd = mesh.shape[DATA_AXIS]
+    n_df = mesh.shape[FEAT_AXIS]
+    rows, cols, vals = coalesce_coo(rows, cols, vals, n, d)
+
+    n_loc = -(-n // n_dd)
+    d_loc = -(-d // n_df)
+    dd_of = rows // n_loc
+    df_of = cols // d_loc
+
+    # One sort by (tile id) then slice: O(nnz log nnz) once instead of one
+    # full boolean-mask pass per tile (matters at 1e8+ nnz on big grids).
+    tile_id = dd_of * n_df + df_of
+    order = np.argsort(tile_id, kind="stable")
+    rows, cols, vals, tile_id = (
+        rows[order], cols[order], vals[order], tile_id[order]
+    )
+    bounds = np.searchsorted(tile_id, np.arange(n_dd * n_df + 1))
+
+    # Per-tile hot sets must stack: find each tile's hot columns, then pad
+    # every tile to the common H with repeats of its first id and an
+    # all-zero dense column (an exact no-op in every linear map).
+    tile_entries = {}
+    tile_hot = {}
+    h_common = 0
+    for dd in range(n_dd):
+        for df in range(n_df):
+            lo, hi = bounds[dd * n_df + df], bounds[dd * n_df + df + 1]
+            tr = rows[lo:hi] - dd * n_loc
+            tc = cols[lo:hi] - df * d_loc
+            tv = vals[lo:hi]
+            hot = select_hot_cols(
+                tr, tc, n_loc, d_loc, hot_col_threshold, max_hot_cols
+            )
+            tile_entries[dd, df] = (tr, tc, tv)
+            tile_hot[dd, df] = hot
+            if hot is not None:
+                h_common = max(h_common, hot.size)
+
+    # Common paddings across tiles.
+    K = 1
+    KP = 1
+    tiles_cold = {}
+    for key, (tr, tc, tv) in tile_entries.items():
+        hot = tile_hot[key]
+        hm = None
+        if h_common:
+            if hot is None:
+                hot = np.zeros(0, dtype=np.int64)
+            tr, tc, tv, hm_real = (
+                split_hot_entries(tr, tc, tv, n_loc, d_loc, hot)
+                if hot.size
+                else (tr, tc, tv, np.zeros((n_loc, 0), np.float32))
+            )
+            hm = np.zeros((n_loc, h_common), dtype=np.float32)
+            hm[:, : hm_real.shape[1]] = hm_real
+            pad_id = int(hot[0]) if hot.size else 0
+            hot_full = np.full(h_common, pad_id, dtype=np.int64)
+            hot_full[: hot.size] = hot
+            tile_hot[key] = hot_full
+        tiles_cold[key] = (tr, tc, tv, hm)
+        if tr.size:
+            K = max(K, int(np.bincount(tr).max()))
+            KP = max(KP, int(np.bincount(tc).max()))
+
+    structs = []
+    for dd in range(n_dd):
+        row_structs = []
+        for df in range(n_df):
+            tr, tc, tv, hm = tiles_cold[dd, df]
+            hot_ids = tile_hot[dd, df] if h_common else None
+            if engine == "benes":
+                S = routing.valid_size(max(n_loc * K, d_loc * KP, 1))
+                row_structs.append(
+                    _assemble(
+                        tr, tc, tv, n_loc, d_loc, K, KP, hm, hot_ids,
+                        plan_cache, size_floor=S,
+                    )
+                )
+            else:
+                ell = _ell_tile(tr, tc, tv, n_loc, d_loc, K)
+                if h_common:
+                    row_structs.append(
+                        _EllWithHot(
+                            ell=ell,
+                            hot_matrix=jnp.asarray(hm),
+                            hot_cols=jnp.asarray(hot_ids, dtype=jnp.int32),
+                        )
+                    )
+                else:
+                    row_structs.append(ell)
+        structs.append(row_structs)
+
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[jax.tree.map(lambda *ys: jnp.stack(ys), *row) for row in structs],
+    )
+    stacked = jax.tree.map(
+        lambda a: jax.device_put(
+            a,
+            NamedSharding(
+                mesh, P(DATA_AXIS, FEAT_AXIS, *([None] * (a.ndim - 2)))
+            ),
+        ),
+        stacked,
+    )
+    return GridShardedFeatures(
+        shards=stacked,
+        mesh=mesh,
+        num_rows_=int(n_loc * n_dd),
+        num_cols_=int(d_loc * n_df),
+    )
+
+
+def _ell_tile(tr, tc, tv, n_loc: int, d_loc: int, K: int) -> EllFeatures:
+    """One tile in padded ELL layout with pinned row width K."""
+    order = np.argsort(tr, kind="stable")
+    tr, tc, tv = tr[order], tc[order], tv[order]
+    counts = np.bincount(tr, minlength=n_loc)
+    starts = np.zeros(n_loc + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    slots = np.arange(tr.size, dtype=np.int64) - starts[tr]
+    values = np.zeros((n_loc, K), dtype=np.float32)
+    indices = np.zeros((n_loc, K), dtype=np.int32)
+    values[tr, slots] = tv
+    indices[tr, slots] = tc
+    return EllFeatures(
+        values=jnp.asarray(values), indices=jnp.asarray(indices), num_cols=d_loc
+    )
+
+
+@struct.dataclass
+class _EllWithHot:
+    """ELL tile + dense hot side (mirrors BenesSparseFeatures hot-split
+    semantics for the CPU/test engine)."""
+
+    ell: EllFeatures
+    hot_matrix: jax.Array
+    hot_cols: jax.Array
+
+    def matvec(self, w: jax.Array) -> jax.Array:
+        return self.ell.matvec(w) + self.hot_matrix @ w[self.hot_cols]
+
+    def rmatvec(self, c: jax.Array) -> jax.Array:
+        g = self.ell.rmatvec(c)
+        return g.at[self.hot_cols].add(self.hot_matrix.T @ c)
+
+    def rmatvec_sq(self, c: jax.Array) -> jax.Array:
+        g = self.ell.rmatvec_sq(c)
+        hm2 = self.hot_matrix * self.hot_matrix
+        return g.at[self.hot_cols].add(hm2.T @ c)
+
+    def row_norms_sq(self) -> jax.Array:
+        return self.ell.row_norms_sq() + jnp.sum(
+            self.hot_matrix * self.hot_matrix, axis=-1
+        )

@@ -65,11 +65,57 @@ def _ell_stats(feats: EllFeatures, weights):
     return s1, s2, sabs, nnz, mn, mx, wsum
 
 
+def _benes_stats(feats, weights):
+    """Stats through the permutation engine's own linear maps: the weighted
+    sums are rmatvec-style reductions; min/max route the row-weight mask to
+    the column-grouped side once and reduce per column there."""
+    d = feats.dim
+    wsum = jnp.sum(weights)
+    ell = feats.ell_values
+    hot = feats.hot_matrix
+    s1 = feats.rmatvec(weights)
+    s2 = feats.rmatvec_sq(weights)
+    sabs = feats._rmatvec_impl(
+        jnp.abs(ell), None if hot is None else jnp.abs(hot), weights
+    )
+    nnz = feats._rmatvec_impl(
+        (ell != 0).astype(ell.dtype),
+        None if hot is None else (hot != 0).astype(ell.dtype),
+        weights,
+    )
+    # live-row mask routed to CSC slot order: explicit entries of columns
+    # are contiguous there, so per-column min/max are row reductions
+    n, k = ell.shape
+    mask_ell = jnp.broadcast_to((weights > 0)[:, None], (n, k)).astype(ell.dtype)
+    mask_flat = feats._pad_ell(mask_ell.reshape(-1))
+    dkp = feats.csc_values.shape[0] * feats.csc_values.shape[1]
+    mask_csc = feats._to_csc(mask_flat)[:dkp].reshape(feats.csc_values.shape)
+    live = (feats.csc_values != 0) & (mask_csc > 0)
+    mx = jnp.max(
+        jnp.where(live, feats.csc_values, -jnp.inf), axis=1
+    )
+    mn = jnp.min(
+        jnp.where(live, feats.csc_values, jnp.inf), axis=1
+    )
+    if hot is not None:
+        hlive = (hot != 0) & (weights > 0)[:, None]
+        hmx = jnp.max(jnp.where(hlive, hot, -jnp.inf), axis=0)
+        hmn = jnp.min(jnp.where(hlive, hot, jnp.inf), axis=0)
+        mx = mx.at[feats.hot_cols].max(hmx)
+        mn = mn.at[feats.hot_cols].min(hmn)
+    return s1, s2, sabs, nnz, mn, mx, wsum
+
+
 def summarize(data: LabeledData) -> BasicStatisticalSummary:
+    from photon_ml_tpu.ops.sparse_perm import BenesSparseFeatures
+
     feats = data.features
     if isinstance(feats, DenseFeatures):
         s1, s2, sabs, nnz, mn, mx, wsum = _dense_stats(feats.matrix, data.weights)
         sparse = False
+    elif isinstance(feats, BenesSparseFeatures):
+        s1, s2, sabs, nnz, mn, mx, wsum = _benes_stats(feats, data.weights)
+        sparse = True
     else:
         s1, s2, sabs, nnz, mn, mx, wsum = _ell_stats(feats, data.weights)
         sparse = True

@@ -294,3 +294,63 @@ class TestHotColumnSplit:
         np.add.at(dense, (rows, cols), vals)
         w = jnp.asarray(rng.standard_normal(d).astype(np.float32))
         assert np.allclose(bsf.matvec(w), dense @ np.asarray(w), atol=1e-4)
+
+
+class TestBenesAuxPaths:
+    """Validation and feature-summary must accept the Benes engine (the
+    auto-engine TPU path feeds it into both before training starts)."""
+
+    def _data(self, rng, weights=None):
+        from photon_ml_tpu.ops.data import LabeledData
+
+        n, d, k = 256, 96, 4
+        rows = np.repeat(np.arange(n), k + 1)
+        cols = np.concatenate(
+            [rng.integers(1, d, (n, k)), np.zeros((n, 1), np.int64)], axis=1
+        ).reshape(-1)
+        vals = rng.standard_normal(rows.size).astype(np.float32)
+        y = rng.integers(0, 2, n).astype(np.float32)
+        mk = lambda feats: LabeledData.create(
+            feats, jnp.asarray(y),
+            weights=None if weights is None else jnp.asarray(weights),
+        )
+        return (
+            mk(from_scipy_like(rows, cols, vals, (n, d))),
+            mk(from_coo(rows, cols, vals, (n, d))),
+        )
+
+    def test_summarize_matches_ell(self, rng):
+        from photon_ml_tpu.stat.summary import summarize
+
+        w = np.ones(256, np.float32)
+        w[::7] = 0.0  # padding rows exercise the live-mask routing
+        ell_data, benes_data = self._data(rng, weights=w)
+        a = summarize(ell_data)
+        b = summarize(benes_data)
+        for field in (
+            "mean", "variance", "num_nonzeros", "max_abs", "min_val",
+            "max_val", "mean_abs",
+        ):
+            np.testing.assert_allclose(
+                np.asarray(getattr(b, field)),
+                np.asarray(getattr(a, field)),
+                atol=1e-4,
+                err_msg=field,
+            )
+
+    def test_validation_accepts_benes(self, rng):
+        from photon_ml_tpu.data.validators import (
+            DataValidationType,
+            validate_labeled_data,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        _, benes_data = self._data(rng)
+        validate_labeled_data(
+            benes_data, TaskType.LOGISTIC_REGRESSION,
+            DataValidationType.VALIDATE_FULL,
+        )
+        validate_labeled_data(
+            benes_data, TaskType.LOGISTIC_REGRESSION,
+            DataValidationType.VALIDATE_SAMPLE,
+        )
