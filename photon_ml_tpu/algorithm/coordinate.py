@@ -73,12 +73,28 @@ class FixedEffectCoordinate(Coordinate):
     last_tracker: Optional[FixedEffectOptimizationTracker] = dataclasses.field(
         default=None, repr=False
     )
+    # multi-chip layouts pad the batch and the feature axis to the device
+    # grid; the coordinate speaks global (unpadded) shapes at its boundary
+    # (models carry [num_real_cols] coefficients, scores are [num_real_rows])
+    num_real_rows: Optional[int] = None
+    num_real_cols: Optional[int] = None
+    # the padded solve vector of the model last returned by update_model,
+    # kept with the sharding the jit'd solve produced (feat-sharded on a
+    # grid): warm starts and scoring reuse it instead of re-materializing
+    # the full [d_pad] vector on one device each outer iteration
+    _w_padded_cache: Optional[tuple] = dataclasses.field(
+        default=None, repr=False
+    )
 
     def update_model(
         self, model: Optional[GeneralizedLinearModel], residual_scores: np.ndarray
     ) -> GeneralizedLinearModel:
+        residual = np.asarray(residual_scores)
+        n_pad = self.data.num_rows
+        if residual.shape[0] < n_pad:
+            residual = np.pad(residual, (0, n_pad - residual.shape[0]))
         data = self.data.replace(
-            offsets=self.data.offsets + jnp.asarray(residual_scores)
+            offsets=self.data.offsets + jnp.asarray(residual)
         )
         rate = self.configuration.down_sampling_rate
         if rate < 1.0:
@@ -95,16 +111,67 @@ class FixedEffectCoordinate(Coordinate):
             data,
             self.task,
             self.configuration,
-            initial_model=model,
+            initial_model=self._pad_model(model),
             intercept_index=self.intercept_index,
         )[0]
         self.last_tracker = FixedEffectOptimizationTracker(
             states=OptimizationStatesTracker.from_result(fit.result)
         )
-        return fit.model
+        trimmed = self._trim_model(fit.model)
+        if self.num_real_cols is not None:
+            # fit.model's means come straight out of the jit'd solve with
+            # whatever sharding GSPMD chose (feat-sharded on a grid)
+            self._w_padded_cache = (id(trimmed), fit.model.coefficients.means)
+        return trimmed
+
+    def _cached_padded_w(self, model) -> Optional[jax.Array]:
+        if self._w_padded_cache is not None and self._w_padded_cache[0] == id(model):
+            return self._w_padded_cache[1]
+        return None
+
+    def _pad_model(
+        self, model: Optional[GeneralizedLinearModel]
+    ) -> Optional[GeneralizedLinearModel]:
+        """Warm starts arrive in real [d]; the padded layout trains in
+        [d_pad] (trailing zeros for the dead columns). The padded vector of
+        the model this coordinate itself produced is served from the
+        sharded cache."""
+        if model is None or self.num_real_cols is None:
+            return model
+        d_pad = self.data.dim
+        w = self._cached_padded_w(model)
+        if w is None:
+            w = jnp.asarray(model.coefficients.means)
+            if w.shape[0] < d_pad:
+                w = jnp.pad(w, (0, d_pad - w.shape[0]))
+        return model.replace(
+            coefficients=model.coefficients.replace(means=w, variances=None)
+        )
+
+    def _trim_model(self, model: GeneralizedLinearModel) -> GeneralizedLinearModel:
+        if self.num_real_cols is None:
+            return model
+        d = self.num_real_cols
+        coef = model.coefficients
+        if coef.means.shape[0] == d:
+            return model
+        return model.replace(
+            coefficients=coef.replace(
+                means=coef.means[:d],
+                variances=None if coef.variances is None else coef.variances[:d],
+            )
+        )
 
     def score(self, model: GeneralizedLinearModel) -> np.ndarray:
-        return np.asarray(model.compute_score(self.data.features))
+        w = self._cached_padded_w(model)
+        if w is None:
+            w = jnp.asarray(model.coefficients.means)
+            if self.num_real_cols is not None and w.shape[0] < self.data.dim:
+                w = jnp.pad(w, (0, self.data.dim - w.shape[0]))
+        scores = np.asarray(self.data.features.matvec(w))
+        if self.num_real_rows is not None:
+            scores = scores[: self.num_real_rows]
+        return scores
 
 
 @dataclasses.dataclass
@@ -122,17 +189,35 @@ class RandomEffectCoordinate(Coordinate):
     last_tracker: Optional[RandomEffectOptimizationTracker] = dataclasses.field(
         default=None, repr=False
     )
+    # multi-chip: shard each bucket's entity axis over these mesh axes
+    # (entity solves are independent — no collectives); re-applied after
+    # every offset rebuild
+    mesh: Optional[object] = None
+    mesh_axes: Optional[tuple] = None
+
+    def _place(self, ds: RandomEffectDataset) -> RandomEffectDataset:
+        if self.mesh is None:
+            return ds
+        from photon_ml_tpu.data.random_effect import place_dataset
+
+        return place_dataset(ds, self.mesh, self.mesh_axes)
 
     def update_model(
         self, model: Optional[RandomEffectModel], residual_scores: np.ndarray
     ) -> RandomEffectModel:
-        ds = self.dataset.update_offsets(self.base_offsets + residual_scores)
+        ds = self._place(
+            self.dataset.update_offsets(self.base_offsets + residual_scores)
+        )
         new_model, results = train_random_effects(
             ds, self.task, self.configuration, initial_model=model
         )
-        # every entity lane in a bucket is a real entity (buckets are built
-        # exact-size; only the sample axis is padded), so no mask is needed
-        self.last_tracker = RandomEffectOptimizationTracker.from_results(results)
+        # entity lanes beyond the real ids (mesh padding) carry zero weights
+        # and all-invalid projections: their solves are trivial, their
+        # coefficients are forced to 0 by the proj_valid mask, and the
+        # telemetry excludes them
+        self.last_tracker = RandomEffectOptimizationTracker.from_results(
+            results, real_counts=[len(ids) for ids in ds.entity_ids]
+        )
         return new_model
 
     def score(self, model: RandomEffectModel) -> np.ndarray:

@@ -88,6 +88,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--event-listeners", nargs="*", default=[],
                    metavar="module.Class",
                    help="EventListener classes to register")
+    p.add_argument("--parallel-data", type=int, default=0,
+                   help="devices on the batch axis of the (data x feat) "
+                        "training grid (0 = single device)")
+    p.add_argument("--parallel-feat", type=int, default=1,
+                   help="devices on the coefficient axis (shards w / grad / "
+                        "optimizer history for huge feature spaces)")
+    p.add_argument("--parallel-engine", default="benes",
+                   choices=["benes", "ell"],
+                   help="sparse engine per grid tile")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax profiler trace of the fit phase here "
                         "(view with TensorBoard / xprof)")
@@ -253,6 +262,21 @@ def run(args: argparse.Namespace) -> GameFit:
             if validation_data is not None
             else None
         )
+        parallel = None
+        if args.parallel_data > 0:
+            from photon_ml_tpu.estimators.game import ParallelConfiguration
+
+            parallel = ParallelConfiguration(
+                n_data=args.parallel_data,
+                n_feat=args.parallel_feat,
+                engine=args.parallel_engine,
+            )
+        elif args.parallel_feat != 1:
+            raise SystemExit(
+                "--parallel-feat requires --parallel-data >= 1 (the grid "
+                "always has a data axis; use --parallel-data 1 for pure "
+                "coefficient-axis sharding)"
+            )
         estimator = GameEstimator(
             task=task,
             coordinates=coordinates,
@@ -261,6 +285,7 @@ def run(args: argparse.Namespace) -> GameFit:
             evaluator=evaluator,
             normalization=normalization,
             intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
+            parallel=parallel,
         )
 
         emitter.send_event(TrainingStartEvent(task=task.name))

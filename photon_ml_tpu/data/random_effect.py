@@ -517,3 +517,48 @@ def build_random_effect_dataset(
         num_rows=n,
         global_dim=int(global_dim),
     )
+
+
+def pad_entities_to_multiple(
+    dataset: RandomEffectDataset, multiple: int
+) -> RandomEffectDataset:
+    """Pad every bucket's entity axis to a multiple (weight-0 entities with
+    no real samples/features). Padded entity lanes carry no entity ids, so
+    model extraction and scoring ignore them; padding once at build time
+    keeps model/array shapes stable across coordinate-descent updates."""
+    if multiple <= 1:
+        return dataset
+    new_buckets = []
+    for b in dataset.buckets:
+        pad = (-b.num_entities) % multiple
+        if pad == 0:
+            new_buckets.append(b)
+            continue
+        def pad0(a):
+            return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+        new_buckets.append(
+            ReBucket(
+                X=pad0(b.X),
+                labels=pad0(b.labels),
+                offsets=pad0(b.offsets),
+                weights=pad0(b.weights),
+                sample_pos=pad0(b.sample_pos),
+                proj_indices=pad0(b.proj_indices),
+                proj_valid=pad0(b.proj_valid),
+            )
+        )
+    return dataclasses.replace(dataset, buckets=new_buckets)
+
+
+def place_dataset(dataset: RandomEffectDataset, mesh, axis_names) -> "RandomEffectDataset":
+    """Shard every bucket's entity axis over the given mesh axes (replicated
+    otherwise). Entity solves are independent, so this is pure data
+    parallelism with zero collectives inside the vmap'd solver."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def place(a):
+        spec = P(axis_names, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    new_buckets = [jax.tree.map(place, b) for b in dataset.buckets]
+    return dataclasses.replace(dataset, buckets=new_buckets)

@@ -48,6 +48,32 @@ logger = logging.getLogger("photon_ml_tpu")
 
 
 @dataclasses.dataclass(frozen=True)
+class ParallelConfiguration:
+    """Multi-chip layout for GAME training over a (data x feat) device grid.
+
+    - Fixed-effect coordinates train through the grid-sharded sparse engine
+      (parallel/grid_features.py): examples sharded over ``n_data`` devices,
+      coefficients over ``n_feat`` (margins psum over feat, gradients over
+      data) — the reference's treeAggregate+broadcast replaced by ICI
+      collectives, with no chip ever holding the full coefficient vector.
+    - Random-effect coordinates shard their entity blocks over ALL
+      n_data*n_feat devices (independent per-entity solves, no collectives).
+
+    The reference has no analog: Spark parallelism is implicit in the RDD
+    runtime (GameEstimator.scala treeAggregateDepth is its only knob).
+    """
+
+    n_data: int
+    n_feat: int = 1
+    engine: str = "benes"  # grid tile engine: "benes" | "ell"
+
+    def build_mesh(self):
+        from photon_ml_tpu.parallel.grid_features import grid_mesh
+
+        return grid_mesh(self.n_data, self.n_feat)
+
+
+@dataclasses.dataclass(frozen=True)
 class FixedEffectCoordinateConfiguration:
     """Reference FixedEffectDataConfiguration + per-coordinate optimizer
     config (GameEstimator builds both from the CLI mini-languages)."""
@@ -104,6 +130,7 @@ class GameEstimator:
         evaluator: Optional[Evaluator] = None,
         normalization: Optional[Dict[str, NormalizationContext]] = None,
         intercept_indices: Optional[Dict[str, int]] = None,
+        parallel: Optional[ParallelConfiguration] = None,
     ) -> None:
         """``normalization``/``intercept_indices`` are per-feature-shard;
         they apply to fixed-effect coordinates (training runs in normalized
@@ -119,12 +146,16 @@ class GameEstimator:
         self.evaluator = evaluator or default_evaluator(task)
         self.normalization = dict(normalization or {})
         self.intercept_indices = dict(intercept_indices or {})
+        self.parallel = parallel
+        self._mesh = parallel.build_mesh() if parallel is not None else None
 
     def _build_coordinate(
         self, cid: str, cfg: CoordinateConfiguration, data: GameData
     ) -> Coordinate:
         shard = data.feature_shards[cfg.feature_shard]
         if isinstance(cfg, FixedEffectCoordinateConfiguration):
+            if self.parallel is not None:
+                return self._build_grid_fixed_effect(cfg, data)
             labeled = LabeledData.create(
                 data.sparse_features(cfg.feature_shard, engine=cfg.sparse_engine),
                 jnp.asarray(data.labels),
@@ -158,11 +189,81 @@ class GameEstimator:
                 mf_configuration=cfg.mf,
                 base_offsets=data.offsets,
             )
+        mesh = None
+        mesh_axes = None
+        if self.parallel is not None:
+            from photon_ml_tpu.data.random_effect import (
+                pad_entities_to_multiple,
+                place_dataset,
+            )
+            from photon_ml_tpu.parallel.grid_features import DATA_AXIS, FEAT_AXIS
+
+            n_dev = self.parallel.n_data * self.parallel.n_feat
+            mesh = self._mesh
+            mesh_axes = (DATA_AXIS, FEAT_AXIS)
+            re_ds = place_dataset(
+                pad_entities_to_multiple(re_ds, n_dev), mesh, mesh_axes
+            )
         return RandomEffectCoordinate(
             dataset=re_ds,
             task=self.task,
             configuration=cfg.optimizer,
             base_offsets=data.offsets,
+            mesh=mesh,
+            mesh_axes=mesh_axes,
+        )
+
+    def _build_grid_fixed_effect(
+        self, cfg: "FixedEffectCoordinateConfiguration", data: GameData
+    ) -> FixedEffectCoordinate:
+        """Fixed effect over the (data x feat) device grid: features tiled
+        through the grid engine, batch arrays padded + data-sharded, the
+        normalization context padded on the feature axis. The coordinate
+        trims back to real shapes at its boundary."""
+        from photon_ml_tpu.parallel.grid_features import (
+            grid_from_coo,
+            shard_vector_data,
+        )
+
+        shard = data.feature_shards[cfg.feature_shard]
+        n, d = data.num_rows, shard.dim
+        gf = grid_from_coo(
+            shard.rows, shard.cols, shard.vals, (n, d), self._mesh,
+            engine=self.parallel.engine,
+        )
+
+        def pad_rows(a, fill=0.0):
+            a = np.asarray(a, dtype=np.float32)
+            out = np.full(gf.num_rows, fill, dtype=np.float32)
+            out[:n] = a
+            return shard_vector_data(jnp.asarray(out), self._mesh)
+
+        norm = self.normalization.get(cfg.feature_shard)
+        if norm is not None and gf.dim != d:
+            factor = norm.factor
+            shift = norm.shift
+            if factor is not None:
+                factor = jnp.pad(
+                    jnp.asarray(factor), (0, gf.dim - d), constant_values=1.0
+                )
+            if shift is not None:
+                shift = jnp.pad(jnp.asarray(shift), (0, gf.dim - d))
+            norm = norm.replace(factor=factor, shift=shift)
+
+        labeled = LabeledData(
+            features=gf,
+            labels=pad_rows(data.labels),
+            offsets=pad_rows(data.offsets),
+            weights=pad_rows(data.weights),
+            norm=norm,
+        )
+        return FixedEffectCoordinate(
+            data=labeled,
+            task=self.task,
+            configuration=cfg.optimizer,
+            intercept_index=self.intercept_indices.get(cfg.feature_shard),
+            num_real_rows=n,
+            num_real_cols=d,
         )
 
     def _meta(self) -> Dict[str, CoordinateMeta]:

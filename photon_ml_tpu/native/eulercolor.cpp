@@ -24,8 +24,10 @@
 //
 // C ABI only (ctypes-friendly); no exceptions across the boundary.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -44,6 +46,48 @@ void pair_by_node(const int32_t* ids, int64_t m, const int32_t* key,
   for (int64_t i = 0; i < m; i += 2) {
     partner[order[i]] = order[i + 1];
     partner[order[i + 1]] = order[i];
+  }
+}
+
+// One class segment at one level: pair on both sides, 2-color along the
+// partner cycles, then stable-partition into next_ids at [lo, lo+m/2) /
+// [lo+m/2, hi). Segments touch disjoint edge ids and disjoint output
+// ranges, so segments at one level run on different threads with no
+// synchronization beyond per-thread counts/order scratch. The coloring is
+// deterministic regardless of thread schedule (each cycle walk starts from
+// the lowest-position unvisited edge of its own segment).
+void process_segment(const int32_t* seg, int64_t m, int64_t lo,
+                     const int32_t* src, const int32_t* dst, int32_t n_src,
+                     int32_t n_dst, int32_t cbit, int64_t* counts,
+                     int32_t* order, int32_t* partner_src,
+                     int32_t* partner_dst, uint8_t* state, int32_t* color,
+                     int32_t* next_ids) {
+  pair_by_node(seg, m, src, n_src, counts, order, partner_src);
+  pair_by_node(seg, m, dst, n_dst, counts, order, partner_dst);
+  for (int64_t i = 0; i < m; ++i) state[seg[i]] = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    const int32_t e0 = seg[i];
+    if (state[e0] & 1) continue;
+    int32_t e = e0;
+    uint8_t b = 0;
+    bool via_src = true;
+    do {
+      state[e] = static_cast<uint8_t>(1 | (b << 1));
+      e = via_src ? partner_src[e] : partner_dst[e];
+      via_src = !via_src;
+      b ^= 1;
+    } while (e != e0);
+  }
+  // Alternating 2-coloring along even cycles puts exactly half each way.
+  int64_t h0 = lo, h1 = lo + m / 2;
+  for (int64_t i = 0; i < m; ++i) {
+    const int32_t e = seg[i];
+    if (state[e] & 2) {
+      color[e] |= cbit;
+      next_ids[h1++] = e;
+    } else {
+      next_ids[h0++] = e;
+    }
   }
 }
 
@@ -71,59 +115,66 @@ int euler_color(int64_t n_edges, int32_t deg, const int32_t* src,
   const int32_t n_nodes_max = n_src > n_dst ? n_src : n_dst;
   std::vector<int32_t> ids(n_edges), next_ids(n_edges);
   std::vector<int32_t> partner_src(n_edges), partner_dst(n_edges);
-  std::vector<int32_t> order(n_edges);
-  std::vector<int64_t> counts(static_cast<size_t>(n_nodes_max) + 1);
   std::vector<uint8_t> state(n_edges);  // bit 0: visited, bit 1: color bit
   std::vector<int64_t> seg_starts{0}, next_starts;
+
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 1;
+  const size_t max_threads = n_edges >= (1 << 20) ? hw : 1;
 
   for (int64_t e = 0; e < n_edges; ++e) ids[e] = static_cast<int32_t>(e);
   seg_starts.push_back(n_edges);
 
   for (int32_t level = 0; level < levels; ++level) {
+    const size_t n_segs = seg_starts.size() - 1;
+    const int32_t cbit = 1 << (levels - 1 - level);
+    const size_t n_threads =
+        n_segs < max_threads ? n_segs : max_threads;
+    if (n_threads <= 1) {
+      std::vector<int64_t> counts(static_cast<size_t>(n_nodes_max) + 1);
+      std::vector<int32_t> order(n_edges);
+      for (size_t s = 0; s < n_segs; ++s) {
+        const int64_t lo = seg_starts[s], hi = seg_starts[s + 1];
+        process_segment(ids.data() + lo, hi - lo, lo, src, dst, n_src, n_dst,
+                        cbit, counts.data(), order.data(), partner_src.data(),
+                        partner_dst.data(), state.data(), color,
+                        next_ids.data());
+      }
+    } else {
+      // Segments are independent (disjoint edges, disjoint output ranges):
+      // farm them out with per-thread counts/order scratch.
+      int64_t max_m = 0;
+      for (size_t s = 0; s < n_segs; ++s) {
+        const int64_t m = seg_starts[s + 1] - seg_starts[s];
+        if (m > max_m) max_m = m;
+      }
+      std::atomic<size_t> next_seg{0};
+      std::vector<std::thread> workers;
+      workers.reserve(n_threads);
+      for (size_t t = 0; t < n_threads; ++t) {
+        workers.emplace_back([&]() {
+          std::vector<int64_t> counts(static_cast<size_t>(n_nodes_max) + 1);
+          std::vector<int32_t> order(static_cast<size_t>(max_m));
+          for (;;) {
+            const size_t s = next_seg.fetch_add(1);
+            if (s >= n_segs) break;
+            const int64_t lo = seg_starts[s], hi = seg_starts[s + 1];
+            process_segment(ids.data() + lo, hi - lo, lo, src, dst, n_src,
+                            n_dst, cbit, counts.data(), order.data(),
+                            partner_src.data(), partner_dst.data(),
+                            state.data(), color, next_ids.data());
+          }
+        });
+      }
+      for (auto& w : workers) w.join();
+    }
     next_starts.clear();
+    next_starts.reserve(2 * n_segs + 1);
     next_starts.push_back(0);
-    int64_t out_lo = 0;
-    // Classes shrink by half each level; all segments share scratch.
-    for (size_t s = 0; s + 1 < seg_starts.size(); ++s) {
+    for (size_t s = 0; s < n_segs; ++s) {
       const int64_t lo = seg_starts[s], hi = seg_starts[s + 1];
-      const int64_t m = hi - lo;
-      const int32_t* seg = ids.data() + lo;
-      pair_by_node(seg, m, src, n_src, counts.data(), order.data(),
-                   partner_src.data());
-      pair_by_node(seg, m, dst, n_dst, counts.data(), order.data(),
-                   partner_dst.data());
-      for (int64_t i = 0; i < m; ++i) state[seg[i]] = 0;
-      for (int64_t i = 0; i < m; ++i) {
-        const int32_t e0 = seg[i];
-        if (state[e0] & 1) continue;
-        int32_t e = e0;
-        uint8_t b = 0;
-        bool via_src = true;
-        do {
-          state[e] = static_cast<uint8_t>(1 | (b << 1));
-          e = via_src ? partner_src[e] : partner_dst[e];
-          via_src = !via_src;
-          b ^= 1;
-        } while (e != e0);
-      }
-      // Stable in-place-ish partition into next_ids.
-      int64_t h0 = out_lo, h1 = out_lo;
-      for (int64_t i = 0; i < m; ++i)
-        if (!(state[seg[i]] & 2)) h1++;
-      int64_t mid = h1;
-      const int32_t cbit = 1 << (levels - 1 - level);
-      for (int64_t i = 0; i < m; ++i) {
-        const int32_t e = seg[i];
-        if (state[e] & 2) {
-          color[e] |= cbit;
-          next_ids[h1++] = e;
-        } else {
-          next_ids[h0++] = e;
-        }
-      }
-      next_starts.push_back(mid);
-      next_starts.push_back(h1);
-      out_lo = h1;
+      next_starts.push_back(lo + (hi - lo) / 2);
+      next_starts.push_back(hi);
     }
     ids.swap(next_ids);
     seg_starts.swap(next_starts);

@@ -55,7 +55,10 @@ def _load_native():
     try:
         if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
             subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+                [
+                    "g++", "-O3", "-shared", "-fPIC", "-pthread",
+                    "-o", str(_LIB), str(_SRC),
+                ],
                 check=True,
                 capture_output=True,
             )

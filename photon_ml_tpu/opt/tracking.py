@@ -81,13 +81,25 @@ class RandomEffectOptimizationTracker:
     value_stats: Dict[str, float]
 
     @classmethod
-    def from_results(cls, results: List[SolveResult]) -> "RandomEffectOptimizationTracker":
+    def from_results(
+        cls,
+        results: List[SolveResult],
+        real_counts: "Optional[List[int]]" = None,
+    ) -> "RandomEffectOptimizationTracker":
         """``results`` are vmap'd SolveResults (leading entity axis), one per
-        bucket. Every entity lane is a real entity: bucket builds size the
-        entity axis exactly (data/random_effect.py), only samples are padded."""
-        reasons = [np.asarray(res.reason) for res in results]
-        iters = [np.asarray(res.iterations) for res in results]
-        finals = [np.asarray(res.value) for res in results]
+        bucket. ``real_counts`` (per bucket) excludes mesh-padding entity
+        lanes from the telemetry; None means every lane is a real entity."""
+        if real_counts is None:
+            real_counts = [np.asarray(res.reason).shape[0] for res in results]
+        reasons = [
+            np.asarray(res.reason)[:k] for res, k in zip(results, real_counts)
+        ]
+        iters = [
+            np.asarray(res.iterations)[:k] for res, k in zip(results, real_counts)
+        ]
+        finals = [
+            np.asarray(res.value)[:k] for res, k in zip(results, real_counts)
+        ]
         reason_all = np.concatenate(reasons) if reasons else np.zeros(0, np.int32)
         iter_all = np.concatenate(iters) if iters else np.zeros(0, np.int32)
         value_all = np.concatenate(finals) if finals else np.zeros(0, np.float32)

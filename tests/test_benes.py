@@ -354,3 +354,21 @@ class TestBenesAuxPaths:
             benes_data, TaskType.LOGISTIC_REGRESSION,
             DataValidationType.VALIDATE_SAMPLE,
         )
+
+
+class TestEulerColorAtScale:
+    def test_multithreaded_path(self, rng):
+        """>= 2^20 edges takes the threaded branch of the native colorer
+        (worker-per-segment with per-thread scratch); the coloring must stay
+        proper and deterministic."""
+        deg, R = 128, 8192  # 1,048,576 edges
+        src = np.repeat(np.arange(R, dtype=np.int32), deg)
+        dst = np.repeat(np.arange(R, dtype=np.int32), deg)
+        rng.shuffle(dst)
+        c1 = routing.euler_color(src, dst, deg, R, R)
+        assert c1.min() >= 0 and c1.max() < deg
+        # proper on both sides without materializing python sets of 1M pairs
+        assert np.unique(src.astype(np.int64) * deg + c1).size == src.size
+        assert np.unique(dst.astype(np.int64) * deg + c1).size == dst.size
+        c2 = routing.euler_color(src, dst, deg, R, R)
+        np.testing.assert_array_equal(c1, c2)
