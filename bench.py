@@ -169,39 +169,64 @@ def _cpu_baseline(fe_np, re_np, fe_iters, re_iters):
     return fe_per_eval * fe_iters + re_per_eval * re_iters
 
 
+def _emit_failure(error: str) -> None:
+    """The benchmark's machine-read failure contract: one well-formed JSON
+    line with zero value, then a nonzero exit."""
+    import os
+    import sys
+
+    print(
+        json.dumps(
+            {
+                "metric": "glmix_logistic_train_throughput",
+                "value": 0.0,
+                "unit": "example_passes/sec/chip",
+                "vs_baseline": 0.0,
+                "error": error,
+            }
+        ),
+        flush=True,
+    )
+    sys.stderr.write(f"bench failure: {error}\n")
+    os._exit(2)
+
+
 def _arm_watchdog(seconds: int = 2700) -> None:
     """Hard deadline: if the accelerator backend hangs (e.g. the device
     tunnel is wedged), still emit one well-formed JSON line and exit instead
     of blocking the caller forever."""
-    import os
-    import sys
     import threading
 
-    def fire():
-        print(
-            json.dumps(
-                {
-                    "metric": "glmix_logistic_train_throughput",
-                    "value": 0.0,
-                    "unit": "example_passes/sec/chip",
-                    "vs_baseline": 0.0,
-                    "error": f"watchdog: no result within {seconds}s (backend hang?)",
-                }
-            ),
-            flush=True,
-        )
-        sys.stderr.write("bench watchdog fired\n")
-        os._exit(2)
-
-    t = threading.Timer(seconds, fire)
+    t = threading.Timer(
+        seconds, lambda: _emit_failure(f"watchdog: no result within {seconds}s")
+    )
     t.daemon = True
     t.start()
+
+
+def _backend_preflight(timeout_s: int = 300) -> None:
+    """Prove the accelerator backend answers at all before building the
+    workload: a wedged device tunnel hangs on first use, and failing in 5
+    minutes beats burning the full watchdog budget."""
+    import os
+    import subprocess
+    import sys
+
+    code = "import jax, jax.numpy as jnp; jax.block_until_ready(jnp.arange(4).sum())"
+    try:
+        subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            check=True, capture_output=True,
+        )
+    except Exception as e:
+        _emit_failure(f"backend preflight failed: {type(e).__name__}")
 
 
 def main():
     import sys
 
     _arm_watchdog(int(__import__("os").environ.get("BENCH_WATCHDOG_S", "2700")))
+    _backend_preflight(int(__import__("os").environ.get("BENCH_PREFLIGHT_S", "300")))
     fe_np, fe_data, re_np, re_data = _build()
     passes, tpu_time, fe_iters, re_iters = _tpu_run(fe_data, re_data)
 

@@ -78,10 +78,12 @@ class FixedEffectCoordinate(Coordinate):
     # (models carry [num_real_cols] coefficients, scores are [num_real_rows])
     num_real_rows: Optional[int] = None
     num_real_cols: Optional[int] = None
-    # the padded solve vector of the model last returned by update_model,
-    # kept with the sharding the jit'd solve produced (feat-sharded on a
-    # grid): warm starts and scoring reuse it instead of re-materializing
-    # the full [d_pad] vector on one device each outer iteration
+    # (model, padded solve vector) for the model last returned by
+    # update_model, the vector kept with the sharding the jit'd solve
+    # produced (feat-sharded on a grid): warm starts and scoring reuse it
+    # instead of re-materializing the full [d_pad] vector on one device
+    # each outer iteration. The strong model reference keys the cache by
+    # identity safely (no id() reuse after garbage collection).
     _w_padded_cache: Optional[tuple] = dataclasses.field(
         default=None, repr=False
     )
@@ -121,11 +123,11 @@ class FixedEffectCoordinate(Coordinate):
         if self.num_real_cols is not None:
             # fit.model's means come straight out of the jit'd solve with
             # whatever sharding GSPMD chose (feat-sharded on a grid)
-            self._w_padded_cache = (id(trimmed), fit.model.coefficients.means)
+            self._w_padded_cache = (trimmed, fit.model.coefficients.means)
         return trimmed
 
     def _cached_padded_w(self, model) -> Optional[jax.Array]:
-        if self._w_padded_cache is not None and self._w_padded_cache[0] == id(model):
+        if self._w_padded_cache is not None and self._w_padded_cache[0] is model:
             return self._w_padded_cache[1]
         return None
 

@@ -262,6 +262,10 @@ def run(args: argparse.Namespace) -> GameFit:
             if validation_data is not None
             else None
         )
+        if args.parallel_data < 0 or args.parallel_feat < 1:
+            raise SystemExit(
+                "--parallel-data must be >= 0 and --parallel-feat >= 1"
+            )
         parallel = None
         if args.parallel_data > 0:
             from photon_ml_tpu.estimators.game import ParallelConfiguration

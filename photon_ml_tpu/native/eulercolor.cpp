@@ -122,6 +122,10 @@ int euler_color(int64_t n_edges, int32_t deg, const int32_t* src,
   if (hw == 0) hw = 1;
   const size_t max_threads = n_edges >= (1 << 20) ? hw : 1;
 
+  // Scratch for the sequential path, shared across levels/segments.
+  std::vector<int64_t> counts(static_cast<size_t>(n_nodes_max) + 1);
+  std::vector<int32_t> order(n_edges);
+
   for (int64_t e = 0; e < n_edges; ++e) ids[e] = static_cast<int32_t>(e);
   seg_starts.push_back(n_edges);
 
@@ -131,8 +135,6 @@ int euler_color(int64_t n_edges, int32_t deg, const int32_t* src,
     const size_t n_threads =
         n_segs < max_threads ? n_segs : max_threads;
     if (n_threads <= 1) {
-      std::vector<int64_t> counts(static_cast<size_t>(n_nodes_max) + 1);
-      std::vector<int32_t> order(n_edges);
       for (size_t s = 0; s < n_segs; ++s) {
         const int64_t lo = seg_starts[s], hi = seg_starts[s + 1];
         process_segment(ids.data() + lo, hi - lo, lo, src, dst, n_src, n_dst,
