@@ -367,6 +367,9 @@ def run(args: argparse.Namespace) -> GameFit:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from photon_ml_tpu.parallel.multihost import initialize_distributed
+
+    initialize_distributed()  # no-op single-process; must precede jax use
     run(parse_args(argv))
     return 0
 

@@ -385,6 +385,9 @@ def _diagnose(
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from photon_ml_tpu.parallel.multihost import initialize_distributed
+
+    initialize_distributed()  # no-op single-process; must precede jax use
     run(parse_args(argv))
     return 0
 

@@ -1,0 +1,104 @@
+"""Multi-host (DCN) runtime helpers: process init + host-sharded input.
+
+Reference parity: the reference's multi-node story is Spark/YARN — executors
+pull partitions over the network, the driver coordinates (SURVEY.md §2.6).
+The TPU-pod analog: one python process per host, `jax.distributed`
+establishes the global device view, training-step collectives ride ICI
+inside jit'd programs, and DCN carries only the input pipeline and
+checkpoint IO.
+
+These are the runtime seams, called from the CLIs (initialize) and usable
+by multi-host input pipelines (file sharding, global batch assembly). They
+degrade to the identity in single-process runs — which is also all the
+in-repo tests can exercise; the multi-process branches follow the
+documented jax.distributed contracts.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bring this process into the cluster. Returns True when a multi-process
+    cluster is (or already was) established.
+
+    MUST run before anything initializes an XLA backend (first jnp op,
+    ``jax.devices()``, …) — the CLIs call it first thing. With no arguments
+    jax auto-detects cluster environments (TPU pod metadata, Slurm, MPI); a
+    plain single machine is not a cluster and stays single-process.
+    """
+    try:
+        if jax.distributed.is_initialized():
+            return jax.process_count() > 1
+    except AttributeError:  # pragma: no cover - very old jax
+        pass
+    import jax._src.xla_bridge as _xb
+
+    if _xb.backends_are_initialized():
+        # Too late to join a cluster in this process. Fine for single-process
+        # runs; loud for anything that looks like a real cluster request.
+        if coordinator_address is not None:
+            raise RuntimeError(
+                "initialize_distributed(coordinator_address=...) must run "
+                "before any JAX call that initializes the XLA backend"
+            )
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError) as e:
+        if coordinator_address is not None or num_processes is not None:
+            raise  # explicit cluster request must not fail silently
+        # no cluster environment auto-detected: single-process run
+        logger.debug("no distributed environment detected (%s)", e)
+        return False
+    return jax.process_count() > 1
+
+
+def host_shard_files(paths: Sequence[str]) -> List[str]:
+    """This host's slice of the input files (deterministic round-robin over
+    the sorted list, so every host computes the same assignment)."""
+    n = jax.process_count()
+    if n <= 1:
+        return list(paths)
+    i = jax.process_index()
+    return [p for k, p in enumerate(sorted(paths)) if k % n == i]
+
+
+def global_batch_from_host_rows(
+    rows: np.ndarray, mesh, spec, global_rows: Optional[int] = None
+):
+    """Assemble a globally-sharded batch array from this host's row block.
+
+    ``rows`` is the process-local data; ``spec`` a PartitionSpec placing the
+    global batch over ``mesh``. Pass ``global_rows`` (the summed row count
+    over all hosts) whenever hosts may hold unequal counts — round-robin
+    file sharding (:func:`host_shard_files`) generally produces unequal
+    blocks, and without the explicit global shape each process would infer
+    a different one. On one process this is a plain device_put.
+    """
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() <= 1:
+        return jax.device_put(rows, sharding)
+    global_shape = None
+    if global_rows is not None:
+        global_shape = (int(global_rows),) + tuple(rows.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, rows, global_shape=global_shape
+    )

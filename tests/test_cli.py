@@ -293,3 +293,72 @@ class TestBuildIndexDriver:
             "--offheap-indexmap-dir", str(idx_dir),
         ]))
         assert fit.validation_metric > 0.70
+
+
+class TestFullGameCli:
+    def test_end_to_end_full_game_with_factored_re(self, glmix_avro, tmp_path):
+        """BASELINE config 5 shape: FE + per-user RE + factored (MF)
+        coordinate, trained and scored through the CLIs."""
+        import json as _json
+
+        from photon_ml_tpu.cli.score_game import main as score_main
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        with open(glmix_avro["config"]) as f:
+            config = _json.load(f)
+        config["coordinates"]["factored"] = {
+            "type": "factored_random",
+            "feature_shard": "per_user",
+            "random_effect_type": "userId",
+            "mf": {"num_latent_factors": 2, "num_iterations": 1},
+            "optimizer": {
+                "optimizer": "LBFGS",
+                "regularization": "L2",
+                "regularization_weight": 5.0,
+            },
+        }
+        config["update_order"] = ["fixed", "per_user", "factored"]
+        cfg_path = tmp_path / "full-game.json"
+        cfg_path.write_text(_json.dumps(config))
+
+        out = tmp_path / "out_full"
+        fit = run(parse_args([
+            "--train-data-dirs", str(glmix_avro["train"]),
+            "--validation-data-dirs", str(glmix_avro["test"]),
+            "--coordinate-config", str(cfg_path),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--evaluator", "AUC",
+        ]))
+        assert fit.validation_metric > 0.65
+        scores_dir = tmp_path / "scores_full"
+        score_main([
+            "--data-dirs", str(glmix_avro["test"]),
+            "--model-dir", str(out / "best"),
+            "--output-dir", str(scores_dir),
+            "--evaluator", "AUC",
+        ])
+        assert any(scores_dir.iterdir())
+
+
+class TestMultihostHelpers:
+    def test_single_process_degenerates(self):
+        import jax
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        from photon_ml_tpu.parallel.grid_features import grid_mesh
+        from photon_ml_tpu.parallel.multihost import (
+            global_batch_from_host_rows,
+            host_shard_files,
+            initialize_distributed,
+        )
+
+        initialize_distributed()  # no cluster env: must be a no-op
+        assert host_shard_files(["b", "a", "c"]) == ["b", "a", "c"]
+        mesh = grid_mesh(8, 1)
+        arr = global_batch_from_host_rows(
+            np.arange(16, dtype=np.float32), mesh, P("data")
+        )
+        assert arr.shape == (16,)
+        assert jax.process_count() == 1
