@@ -299,7 +299,16 @@ class GameEstimator:
                 problems.append(f"{cid}: not in current configuration")
                 continue
             if isinstance(model, GeneralizedLinearModel):
-                want = coord.data.dim
+                if not isinstance(coord, FixedEffectCoordinate):
+                    problems.append(
+                        f"{cid}: checkpoint holds a fixed-effect model but "
+                        "the coordinate is now configured as "
+                        f"{type(coord).__name__}"
+                    )
+                    continue
+                # parallel layouts pad the coordinate's feature axis;
+                # checkpoints carry real-dim models
+                want = coord.num_real_cols or coord.data.dim
                 if model.dim != want:
                     problems.append(
                         f"{cid}: checkpoint dim {model.dim} != data dim {want}"

@@ -73,7 +73,9 @@ def train_random_effects(
     for b, bucket in enumerate(dataset.buckets):
         data = _bucket_data(bucket)
         if initial_model is not None:
-            w0 = initial_model.coefficients[b]
+            w0 = _fit_entity_axis(
+                initial_model.coefficients[b], bucket.num_entities
+            )
         else:
             w0 = jnp.zeros((bucket.num_entities, bucket.local_dim), dtype=jnp.float32)
         res = batched(w0, data, l2, l1)
@@ -114,6 +116,24 @@ def _score_passive(w: jax.Array, X: jax.Array, entity_index: jax.Array) -> jax.A
     return jnp.einsum("pd,pd->p", X, w[entity_index])
 
 
+def _fit_entity_axis(w: jax.Array, num_entities: int) -> jax.Array:
+    """Adapt a per-bucket coefficient block to the dataset's entity axis.
+
+    Mesh padding grows the entity axis with trivial lanes; a model trained
+    on a padded dataset carries the extra zero rows, a model from an
+    unpadded (or differently-padded) run does not. Real entities always
+    occupy the leading rows in build order, so pad with zeros / trim to
+    align (reference analog: RandomEffectModel joins by REId and tolerates
+    missing entities, RandomEffectModel.scala:~150).
+    """
+    e = w.shape[0]
+    if e == num_entities:
+        return w
+    if e < num_entities:
+        return jnp.pad(w, [(0, num_entities - e)] + [(0, 0)] * (w.ndim - 1))
+    return w[:num_entities]
+
+
 def score_random_effects(
     model: RandomEffectModel, dataset: RandomEffectDataset
 ) -> np.ndarray:
@@ -123,7 +143,8 @@ def score_random_effects(
     included — score algebra composes them at the coordinate level."""
     out = np.zeros(dataset.num_rows, dtype=np.float32)
     for b, bucket in enumerate(dataset.buckets):
-        z = np.asarray(_score_bucket(model.coefficients[b], bucket))
+        w_b = _fit_entity_axis(model.coefficients[b], bucket.num_entities)
+        z = np.asarray(_score_bucket(w_b, bucket))
         wt = np.asarray(bucket.weights)
         pos = np.asarray(bucket.sample_pos)
         mask = wt > 0

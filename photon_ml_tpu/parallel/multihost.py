@@ -43,9 +43,13 @@ def initialize_distributed(
             return jax.process_count() > 1
     except AttributeError:  # pragma: no cover - very old jax
         pass
-    import jax._src.xla_bridge as _xb
+    try:
+        import jax._src.xla_bridge as _xb
 
-    if _xb.backends_are_initialized():
+        backends_up = _xb.backends_are_initialized()
+    except (ImportError, AttributeError):  # pragma: no cover - jax internals moved
+        backends_up = False
+    if backends_up:
         # Too late to join a cluster in this process. Fine for single-process
         # runs; loud for anything that looks like a real cluster request.
         if coordinator_address is not None:
@@ -72,11 +76,12 @@ def initialize_distributed(
 def host_shard_files(paths: Sequence[str]) -> List[str]:
     """This host's slice of the input files (deterministic round-robin over
     the sorted list, so every host computes the same assignment)."""
+    ordered = sorted(paths)
     n = jax.process_count()
     if n <= 1:
-        return list(paths)
+        return ordered
     i = jax.process_index()
-    return [p for k, p in enumerate(sorted(paths)) if k % n == i]
+    return [p for k, p in enumerate(ordered) if k % n == i]
 
 
 def global_batch_from_host_rows(

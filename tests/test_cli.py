@@ -355,7 +355,7 @@ class TestMultihostHelpers:
         )
 
         initialize_distributed()  # no cluster env: must be a no-op
-        assert host_shard_files(["b", "a", "c"]) == ["b", "a", "c"]
+        assert host_shard_files(["b", "a", "c"]) == ["a", "b", "c"]
         mesh = grid_mesh(8, 1)
         arr = global_batch_from_host_rows(
             np.arange(16, dtype=np.float32), mesh, P("data")

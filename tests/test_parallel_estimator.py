@@ -113,3 +113,29 @@ class TestParallelEstimator:
         s_s = m_s.score(data)
         s_g = m_g.score(data)
         np.testing.assert_allclose(s_g, s_s, atol=1e-2)
+
+
+class TestParallelCheckpointResume:
+    def test_single_device_checkpoint_resumes_on_grid(self, rng, tmp_path):
+        """Checkpoints carry real-dim models; a grid estimator (padded
+        feature axis) must accept them (and vice versa)."""
+        data = _glmix_data(rng)
+        ckpt = str(tmp_path / "ckpt")
+
+        est1 = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinates=_coords(),
+            num_outer_iterations=1,
+        )
+        fit1 = est1.fit(data, checkpoint_dir=ckpt)
+
+        est2 = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinates=_coords(),
+            num_outer_iterations=2,
+            parallel=ParallelConfiguration(n_data=2, n_feat=4, engine="benes"),
+        )
+        fit2 = est2.fit(data, checkpoint_dir=ckpt)  # resumes iteration 2
+        w = np.asarray(fit2.model.models["global"].coefficients.means)
+        assert w.shape[0] == data.feature_shards["g"].dim
+        assert np.all(np.isfinite(fit2.model.score(data)))
