@@ -1,0 +1,38 @@
+#!/bin/bash
+# GAME/GLMix training + scoring (the analog of the reference's
+# cli.game.training / cli.game.scoring drivers on a TPU pod slice).
+#
+# Usage: ./run_game_driver.sh WORKING_ROOT [N_DATA [N_FEAT]]
+#   game config: WORKING_ROOT/game.json  (see game.json.example)
+#   train data:  WORKING_ROOT/input/train
+#   test data:   WORKING_ROOT/input/test
+#   model out:   WORKING_ROOT/results ; scores: WORKING_ROOT/scores
+#
+# N_DATA x N_FEAT devices form the training grid: examples shard over the
+# data axis, coefficients over the feat axis (omit both for single-chip).
+set -euo pipefail
+
+ROOT="${1:?usage: $0 WORKING_ROOT [N_DATA [N_FEAT]]}"
+N_DATA="${2:-0}"
+N_FEAT="${3:-1}"
+
+PARALLEL_FLAGS=()
+if [ "$N_DATA" -gt 0 ]; then
+  PARALLEL_FLAGS=(--parallel-data "$N_DATA" --parallel-feat "$N_FEAT")
+fi
+
+python -m photon_ml_tpu.cli.train_game \
+    --train-data-dirs "$ROOT/input/train" \
+    --validation-data-dirs "$ROOT/input/test" \
+    --coordinate-config "$ROOT/game.json" \
+    --task LOGISTIC_REGRESSION \
+    --output-dir "$ROOT/results" \
+    --evaluator AUC \
+    --checkpoint-dir "$ROOT/checkpoints" \
+    "${PARALLEL_FLAGS[@]}"
+
+python -m photon_ml_tpu.cli.score_game \
+    --data-dirs "$ROOT/input/test" \
+    --model-dir "$ROOT/results/best" \
+    --output-dir "$ROOT/scores" \
+    --evaluator AUC

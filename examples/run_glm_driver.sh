@@ -1,0 +1,24 @@
+#!/bin/bash
+# Single-GLM training driver invocation (the analog of the reference's
+# examples/run_photon_ml_driver.sh spark-submit recipe — same workflow
+# knobs, no Spark: the chips this process sees are the cluster).
+#
+# Usage: ./run_glm_driver.sh WORKING_ROOT
+#   train data:  WORKING_ROOT/input/train   (TrainingExampleAvro or LibSVM)
+#   test data:   WORKING_ROOT/input/test
+#   outputs:     WORKING_ROOT/results
+set -euo pipefail
+
+ROOT="${1:?usage: $0 WORKING_ROOT}"
+
+python -m photon_ml_tpu.cli.train_glm \
+    --training-data-dirs "$ROOT/input/train" \
+    --validation-data-dirs "$ROOT/input/test" \
+    --task LOGISTIC_REGRESSION \
+    --output-dir "$ROOT/results" \
+    --regularization-weights 0.1 1 10 100 \
+    --optimizer LBFGS \
+    --regularization L2 \
+    --normalization-type STANDARDIZATION \
+    --diagnostic-mode ALL \
+    --log-file "$ROOT/results/driver.log"
