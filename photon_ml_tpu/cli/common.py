@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -52,6 +53,9 @@ def setup_logger(log_file: Optional[str] = None, level: str = "INFO") -> logging
     handler.setFormatter(fmt)
     logger.addHandler(handler)
     if log_file:
+        parent = os.path.dirname(log_file)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         fh = logging.FileHandler(log_file)
         fh.setFormatter(fmt)
         logger.addHandler(fh)

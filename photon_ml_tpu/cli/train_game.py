@@ -69,7 +69,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--task", required=True,
                    choices=[t.name for t in TaskType])
     p.add_argument("--output-dir", required=True)
-    p.add_argument("--num-outer-iterations", type=int, default=1)
+    p.add_argument("--num-outer-iterations", type=int, default=None,
+                   help="overrides the config file's num_outer_iterations (default 1)")
     p.add_argument("--evaluator", default=None,
                    help="e.g. AUC, RMSE, or sharded 'AUC:userId' "
                         "(reference MultiEvaluatorType syntax)")
@@ -101,7 +102,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="write a jax profiler trace of the fit phase here "
                         "(view with TensorBoard / xprof)")
     p.add_argument("--log-file", default=None)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.parallel_data < 0 or args.parallel_feat < 1:
+        p.error("--parallel-data must be >= 0 and --parallel-feat >= 1")
+    if args.parallel_data == 0 and args.parallel_feat != 1:
+        p.error(
+            "--parallel-feat requires --parallel-data >= 1 (the grid always "
+            "has a data axis; use --parallel-data 1 for pure coefficient-"
+            "axis sharding)"
+        )
+    return args
 
 
 def _make_evaluator(spec: Optional[str], task: TaskType, data):
@@ -262,10 +272,6 @@ def run(args: argparse.Namespace) -> GameFit:
             if validation_data is not None
             else None
         )
-        if args.parallel_data < 0 or args.parallel_feat < 1:
-            raise SystemExit(
-                "--parallel-data must be >= 0 and --parallel-feat >= 1"
-            )
         parallel = None
         if args.parallel_data > 0:
             from photon_ml_tpu.estimators.game import ParallelConfiguration
@@ -275,17 +281,15 @@ def run(args: argparse.Namespace) -> GameFit:
                 n_feat=args.parallel_feat,
                 engine=args.parallel_engine,
             )
-        elif args.parallel_feat != 1:
-            raise SystemExit(
-                "--parallel-feat requires --parallel-data >= 1 (the grid "
-                "always has a data axis; use --parallel-data 1 for pure "
-                "coefficient-axis sharding)"
-            )
         estimator = GameEstimator(
             task=task,
             coordinates=coordinates,
             update_order=update_order,
-            num_outer_iterations=args.num_outer_iterations,
+            num_outer_iterations=(
+                args.num_outer_iterations
+                if args.num_outer_iterations is not None
+                else int(raw_config.get("num_outer_iterations", 1))
+            ),
             evaluator=evaluator,
             normalization=normalization,
             intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},

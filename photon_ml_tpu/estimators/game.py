@@ -232,10 +232,9 @@ class GameEstimator:
             engine=self.parallel.engine,
         )
 
-        def pad_rows(a, fill=0.0):
-            a = np.asarray(a, dtype=np.float32)
-            out = np.full(gf.num_rows, fill, dtype=np.float32)
-            out[:n] = a
+        def pad_rows(a):
+            out = np.zeros(gf.num_rows, dtype=np.float32)
+            out[:n] = np.asarray(a, dtype=np.float32)
             return shard_vector_data(jnp.asarray(out), self._mesh)
 
         norm = self.normalization.get(cfg.feature_shard)

@@ -147,3 +147,60 @@ class TestGridPadding:
             g = np.asarray(gf.rmatvec(cd))
             np.testing.assert_allclose(g[:d], dense.T @ c[:n], atol=1e-3)
             np.testing.assert_allclose(g[d:], 0.0, atol=1e-6)
+
+
+class TestGridSecondOrder:
+    def test_tron_solve_on_grid(self, rng):
+        """TRON's CG runs Hessian-vector products through the grid engine
+        (matvec + rmatvec per CG step, psums on both axes); optimum must
+        match the single-device TRON fit."""
+        from photon_ml_tpu.losses.objective import make_glm_objective
+        from photon_ml_tpu.losses.pointwise import LogisticLoss
+        from photon_ml_tpu.opt.config import (
+            GlmOptimizationConfiguration,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.opt.solve import solve
+        from photon_ml_tpu.ops.data import LabeledData
+
+        rows, cols, vals, shape = _problem(rng, n=512, d=96, k=4)
+        n, d = shape
+        dense = _dense(rows, cols, vals, shape)
+        w_true = (rng.standard_normal(d) * 0.3).astype(np.float32)
+        y = (rng.random(n) < 1.0 / (1.0 + np.exp(-dense @ w_true))).astype(
+            np.float32
+        )
+        objective = make_glm_objective(LogisticLoss)
+        cfg = GlmOptimizationConfiguration(
+            optimizer_config=OptimizerConfig.tron(max_iterations=12),
+            regularization_weight=1.0,
+        )
+
+        single = from_coo(rows, cols, vals, shape)
+        res_s = jax.jit(
+            lambda dd: solve(
+                objective, jnp.zeros(d, jnp.float32), dd, cfg,
+                l2_weight=jnp.float32(1.0),
+            )
+        )(LabeledData.create(single, jnp.asarray(y)))
+
+        mesh = grid_mesh(2, 4)
+        gf = grid_from_coo(rows, cols, vals, shape, mesh, engine="benes")
+        y_pad = np.zeros(gf.num_rows, np.float32)
+        y_pad[:n] = y
+        wt = np.zeros(gf.num_rows, np.float32)
+        wt[:n] = 1.0
+        data_g = LabeledData.create(
+            gf,
+            shard_vector_data(jnp.asarray(y_pad), mesh),
+            weights=shard_vector_data(jnp.asarray(wt), mesh),
+        )
+        res_g = jax.jit(
+            lambda w0, dd: solve(
+                objective, w0, dd, cfg, l2_weight=jnp.float32(1.0)
+            )
+        )(shard_vector_feat(jnp.zeros(gf.dim, jnp.float32), mesh), data_g)
+
+        np.testing.assert_allclose(
+            np.asarray(res_g.w)[:d], np.asarray(res_s.w), atol=2e-3
+        )
