@@ -385,14 +385,21 @@ def read_avro_file(
             raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
 
 
+def list_part_files(path: str) -> list:
+    """The container files under a path: [path] for a file, else the
+    sorted part-*.avro files of the directory (one listing rule shared by
+    every reader)."""
+    if os.path.isfile(path):
+        return [path]
+    return [
+        os.path.join(path, n)
+        for n in sorted(os.listdir(path))
+        if n.endswith(".avro") and not n.startswith(".")
+    ]
+
+
 def read_avro_dir(path: str, schema: Optional[AvroSchema] = None) -> Iterator[Dict[str, Any]]:
     """Read all part files of a directory (the reference's part-*.avro
     layout), or a single file when given one."""
-    if os.path.isfile(path):
-        yield from read_avro_file(path, schema)
-        return
-    names = sorted(
-        n for n in os.listdir(path) if n.endswith(".avro") and not n.startswith(".")
-    )
-    for n in names:
-        yield from read_avro_file(os.path.join(path, n), schema)
+    for p in list_part_files(path):
+        yield from read_avro_file(p, schema)

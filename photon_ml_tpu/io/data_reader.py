@@ -11,6 +11,7 @@ metadataMap — reference GameConverters.getValueFromRow).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -129,6 +130,15 @@ def read_game_data(
     """
     if isinstance(paths, str):
         paths = [paths]
+
+    native = _read_game_data_native(
+        paths, shard_configs, index_maps, id_tags,
+        response_field, offset_field, weight_field, uid_field,
+        is_response_required,
+    )
+    if native is not None:
+        return native
+
     if index_maps is None:
         index_maps = build_index_maps(paths, shard_configs)
 
@@ -197,3 +207,182 @@ def read_game_data(
         weights=np.asarray(weights, dtype=np.float32),
     )
     return data, index_maps, uids
+
+
+def _part_files(paths: Sequence[str]) -> List[str]:
+    from photon_ml_tpu.io.avro import list_part_files
+
+    files: List[str] = []
+    for path in paths:
+        files.extend(list_part_files(path))
+    return files
+
+
+def _read_game_data_native(
+    paths: Sequence[str],
+    shard_configs: Dict[str, FeatureShardConfiguration],
+    index_maps: Optional[Dict[str, IndexMap]],
+    id_tags: Sequence[str],
+    response_field: str,
+    offset_field: str,
+    weight_field: str,
+    uid_field: str,
+    is_response_required: bool,
+):
+    """Columnar fast path through native/avrodecode.cpp; None -> caller
+    falls back to the record-at-a-time Python codec (unsupported schema
+    shape, codec, or missing native toolchain). One decode pass builds both
+    the index maps and the COO shards (the Python path scans twice).
+
+    Feature-index assignment order differs from the Python path (keys are
+    numbered per bag stream, not per record) — ids are run-internal either
+    way; persisted artifacts are name-keyed.
+    """
+    from photon_ml_tpu.io import native_reader as nr
+
+    if not nr.native_available():
+        return None
+    files = _part_files(paths)
+    if not files:
+        return None
+
+    all_bags: List[str] = []
+    for cfg in shard_configs.values():
+        for bag in cfg.feature_bags:
+            if bag not in all_bags:
+                all_bags.append(bag)
+
+    from photon_ml_tpu.io.avro import MAGIC, AvroSchema, _Reader, _decode
+
+    columnar = []
+    for path in files:
+        with open(path, "rb") as f:
+            raw = f.read()  # one read serves header sniff + native decode
+        r = _Reader(raw)
+        if r.read(4) != MAGIC:
+            return None
+        meta = _decode(r, {"type": "map", "values": "bytes"})
+        root = AvroSchema(meta["avro.schema"].decode("utf-8")).root
+        plan = nr.compile_program(
+            root,
+            numeric_fields=[response_field, offset_field, weight_field],
+            string_fields=[uid_field, *id_tags],
+            bags=all_bags,
+            tags=id_tags,
+        )
+        if plan is None:
+            return None
+        cf = nr.read_columnar_file(path, plan, data=raw)
+        if cf is None:
+            return None
+        columnar.append((plan, cf))
+
+    n = sum(cf.n_rows for _, cf in columnar)
+
+    def num_col(field, default):
+        out = np.full(n, default, dtype=np.float32)
+        present = np.zeros(n, dtype=bool)
+        at = 0
+        for plan, cf in columnar:
+            m = cf.n_rows
+            if field in plan.num_fields:
+                out[at : at + m] = np.where(
+                    cf.num_present[field], cf.num[field], default
+                )
+                present[at : at + m] = cf.num_present[field]
+            at += m
+        return out, present
+
+    labels, labels_present = num_col(response_field, np.nan)
+    if is_response_required and not labels_present.all():
+        row = int(np.flatnonzero(~labels_present)[0])
+        raise ValueError(f"record {row} has no '{response_field}'")
+    offsets, _ = num_col(offset_field, 0.0)
+    weights, _ = num_col(weight_field, 1.0)
+
+    def str_col(field, which="strs"):
+        out: List[Optional[str]] = []
+        for _, cf in columnar:
+            cols = cf.strs if which == "strs" else cf.tag_strs
+            if field in cols:
+                out.extend(nr.decode_strings(cols[field]))
+            else:
+                out.extend([None] * cf.n_rows)
+        return out
+
+    uids = str_col(uid_field)
+    tag_values: Dict[str, np.ndarray] = {}
+    for tag in id_tags:
+        # top-level field wins over the metadataMap entry (reference
+        # GameConverters.getValueFromRow)
+        top = str_col(tag)
+        from_map = str_col(tag, which="tags")
+        vals = [t if t is not None else m for t, m in zip(top, from_map)]
+        missing = [i for i, v in enumerate(vals) if v is None]
+        if missing:
+            raise ValueError(f"record {missing[0]} missing id tag '{tag}'")
+        tag_values[tag] = np.asarray(vals)
+
+    shards: Dict[str, FeatureShard] = {}
+    out_maps: Dict[str, IndexMap] = {}
+    for sid, cfg in shard_configs.items():
+        recs, vals, koffs, klens, arenas = [], [], [], [], []
+        arena_base = 0
+        row_base = 0
+        for plan, cf in columnar:
+            for bag in cfg.feature_bags:
+                rec, val, koff, klen = cf.bags[bag]
+                recs.append(rec + row_base)
+                vals.append(val)
+                koffs.append(koff + arena_base)
+                klens.append(klen)
+            arenas.append(cf.key_arena)
+            arena_base += len(cf.key_arena)
+            row_base += cf.n_rows
+        rows = np.concatenate(recs) if recs else np.zeros(0, np.int64)
+        values = np.concatenate(vals) if vals else np.zeros(0, np.float32)
+        key_off = np.concatenate(koffs) if koffs else np.zeros(0, np.int64)
+        key_len = np.concatenate(klens) if klens else np.zeros(0, np.int32)
+        arena = b"".join(arenas)
+
+        ids, uniques = nr.dedup_keys(arena, key_off, key_len)
+        if index_maps is not None:
+            imap = index_maps[sid]
+            lut = np.asarray(
+                [imap.get_index(k) for k in uniques], dtype=np.int64
+            )
+            cols = lut[ids] if len(ids) else np.zeros(0, np.int64)
+            keep = cols >= 0  # unmapped features drop (scoring semantics)
+            rows, cols, values = rows[keep], cols[keep], values[keep]
+        else:
+            key_to_id = {k: i for i, k in enumerate(uniques)}
+            if cfg.add_intercept and INTERCEPT_KEY not in key_to_id:
+                key_to_id[INTERCEPT_KEY] = len(key_to_id)
+            imap = DefaultIndexMap(key_to_id)
+            cols = ids
+        if cfg.add_intercept:
+            icpt = imap.get_index(INTERCEPT_KEY)
+            if icpt >= 0:
+                rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+                cols = np.concatenate(
+                    [cols, np.full(n, icpt, dtype=np.int64)]
+                )
+                values = np.concatenate(
+                    [values, np.ones(n, dtype=np.float32)]
+                )
+        out_maps[sid] = imap
+        shards[sid] = FeatureShard(
+            rows=rows.astype(np.int64),
+            cols=cols.astype(np.int64),
+            vals=values.astype(np.float32),
+            dim=len(imap),
+        )
+
+    data = GameData(
+        labels=labels,
+        feature_shards=shards,
+        id_tags=tag_values,
+        offsets=offsets,
+        weights=weights,
+    )
+    return data, out_maps, uids
