@@ -95,6 +95,9 @@ def build_index_maps(
     (reference 'default index map' path, GameDriver.scala:46-85)."""
     if isinstance(paths, str):
         paths = [paths]
+    native = _build_index_maps_native(paths, shard_configs)
+    if native is not None:
+        return native
     keys: Dict[str, dict] = {sid: {} for sid in shard_configs}
     for path in paths:
         for record in read_avro_dir(path):
@@ -218,6 +221,75 @@ def _part_files(paths: Sequence[str]) -> List[str]:
     return files
 
 
+def _decode_columnar_files(
+    files: Sequence[str],
+    numeric_fields: Sequence[str],
+    string_fields: Sequence[str],
+    bags: Sequence[str],
+    tags: Sequence[str],
+):
+    """Decode every part file through the native path with one file read
+    each; None -> caller falls back to the Python codec."""
+    from photon_ml_tpu.io import native_reader as nr
+    from photon_ml_tpu.io.avro import MAGIC, AvroSchema, _Reader, _decode
+
+    columnar = []
+    for path in files:
+        with open(path, "rb") as f:
+            raw = f.read()
+        r = _Reader(raw)
+        if r.read(4) != MAGIC:
+            return None
+        meta = _decode(r, {"type": "map", "values": "bytes"})
+        root = AvroSchema(meta["avro.schema"].decode("utf-8")).root
+        plan = nr.compile_program(
+            root,
+            numeric_fields=numeric_fields,
+            string_fields=string_fields,
+            bags=bags,
+            tags=tags,
+        )
+        if plan is None:
+            return None
+        cf = nr.read_columnar_file(path, plan, data=raw)
+        if cf is None:
+            return None
+        columnar.append((plan, cf))
+    return columnar
+
+
+def _all_bags_of(shard_configs: Dict[str, FeatureShardConfiguration]) -> List[str]:
+    bags: List[str] = []
+    for cfg in shard_configs.values():
+        for bag in cfg.feature_bags:
+            if bag not in bags:
+                bags.append(bag)
+    return bags
+
+
+def _concat_bag_streams(columnar, feature_bags: Sequence[str]):
+    """Concatenate one shard's bag streams over all files: global row ids,
+    values, and key (offset, len) into the joined arena."""
+    recs, vals, koffs, klens, arenas = [], [], [], [], []
+    arena_base = 0
+    row_base = 0
+    for _, cf in columnar:
+        for bag in feature_bags:
+            rec, val, koff, klen = cf.bags[bag]
+            recs.append(rec + row_base)
+            vals.append(val)
+            koffs.append(koff + arena_base)
+            klens.append(klen)
+        arenas.append(cf.key_arena)
+        arena_base += len(cf.key_arena)
+        row_base += cf.n_rows
+    rows = np.concatenate(recs) if recs else np.zeros(0, np.int64)
+    values = np.concatenate(vals) if vals else np.zeros(0, np.float32)
+    key_off = np.concatenate(koffs) if koffs else np.zeros(0, np.int64)
+    key_len = np.concatenate(klens) if klens else np.zeros(0, np.int32)
+    return rows, values, key_off, key_len, b"".join(arenas)
+
+
 def _read_game_data_native(
     paths: Sequence[str],
     shard_configs: Dict[str, FeatureShardConfiguration],
@@ -245,37 +317,15 @@ def _read_game_data_native(
     files = _part_files(paths)
     if not files:
         return None
-
-    all_bags: List[str] = []
-    for cfg in shard_configs.values():
-        for bag in cfg.feature_bags:
-            if bag not in all_bags:
-                all_bags.append(bag)
-
-    from photon_ml_tpu.io.avro import MAGIC, AvroSchema, _Reader, _decode
-
-    columnar = []
-    for path in files:
-        with open(path, "rb") as f:
-            raw = f.read()  # one read serves header sniff + native decode
-        r = _Reader(raw)
-        if r.read(4) != MAGIC:
-            return None
-        meta = _decode(r, {"type": "map", "values": "bytes"})
-        root = AvroSchema(meta["avro.schema"].decode("utf-8")).root
-        plan = nr.compile_program(
-            root,
-            numeric_fields=[response_field, offset_field, weight_field],
-            string_fields=[uid_field, *id_tags],
-            bags=all_bags,
-            tags=id_tags,
-        )
-        if plan is None:
-            return None
-        cf = nr.read_columnar_file(path, plan, data=raw)
-        if cf is None:
-            return None
-        columnar.append((plan, cf))
+    columnar = _decode_columnar_files(
+        files,
+        numeric_fields=[response_field, offset_field, weight_field],
+        string_fields=[uid_field, *id_tags],
+        bags=_all_bags_of(shard_configs),
+        tags=id_tags,
+    )
+    if columnar is None:
+        return None
 
     n = sum(cf.n_rows for _, cf in columnar)
 
@@ -326,31 +376,13 @@ def _read_game_data_native(
     shards: Dict[str, FeatureShard] = {}
     out_maps: Dict[str, IndexMap] = {}
     for sid, cfg in shard_configs.items():
-        recs, vals, koffs, klens, arenas = [], [], [], [], []
-        arena_base = 0
-        row_base = 0
-        for plan, cf in columnar:
-            for bag in cfg.feature_bags:
-                rec, val, koff, klen = cf.bags[bag]
-                recs.append(rec + row_base)
-                vals.append(val)
-                koffs.append(koff + arena_base)
-                klens.append(klen)
-            arenas.append(cf.key_arena)
-            arena_base += len(cf.key_arena)
-            row_base += cf.n_rows
-        rows = np.concatenate(recs) if recs else np.zeros(0, np.int64)
-        values = np.concatenate(vals) if vals else np.zeros(0, np.float32)
-        key_off = np.concatenate(koffs) if koffs else np.zeros(0, np.int64)
-        key_len = np.concatenate(klens) if klens else np.zeros(0, np.int32)
-        arena = b"".join(arenas)
-
+        rows, values, key_off, key_len, arena = _concat_bag_streams(
+            columnar, cfg.feature_bags
+        )
         ids, uniques = nr.dedup_keys(arena, key_off, key_len)
         if index_maps is not None:
             imap = index_maps[sid]
-            lut = np.asarray(
-                [imap.get_index(k) for k in uniques], dtype=np.int64
-            )
+            lut = np.asarray(imap.get_indices(uniques), dtype=np.int64)
             cols = lut[ids] if len(ids) else np.zeros(0, np.int64)
             keep = cols >= 0  # unmapped features drop (scoring semantics)
             rows, cols, values = rows[keep], cols[keep], values[keep]
@@ -386,3 +418,39 @@ def _read_game_data_native(
         weights=weights,
     )
     return data, out_maps, uids
+
+
+def _build_index_maps_native(
+    paths: Sequence[str],
+    shard_configs: Dict[str, FeatureShardConfiguration],
+) -> Optional[Dict[str, IndexMap]]:
+    """Columnar scan for the standalone index-build (one native decode of
+    the bag streams + native key dedup); None -> Python fallback.
+
+    Key-id assignment order differs from the Python scan (per bag stream,
+    not per record) — ids are run-internal, artifacts are name-keyed.
+    """
+    from photon_ml_tpu.io import native_reader as nr
+
+    if not nr.native_available():
+        return None
+    files = _part_files(paths)
+    if not files:
+        return None
+    columnar = _decode_columnar_files(
+        files, [], [], _all_bags_of(shard_configs), []
+    )
+    if columnar is None:
+        return None
+
+    out: Dict[str, IndexMap] = {}
+    for sid, cfg in shard_configs.items():
+        _, _, key_off, key_len, arena = _concat_bag_streams(
+            columnar, cfg.feature_bags
+        )
+        _, uniques = nr.dedup_keys(arena, key_off, key_len)
+        key_to_id = {k: i for i, k in enumerate(uniques)}
+        if cfg.add_intercept and INTERCEPT_KEY not in key_to_id:
+            key_to_id[INTERCEPT_KEY] = len(key_to_id)
+        out[sid] = DefaultIndexMap(key_to_id)
+    return out

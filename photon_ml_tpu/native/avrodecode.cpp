@@ -149,11 +149,16 @@ extern "C" {
 //            tag_col_base + i). -1 = skip.
 // tags: concatenated tag key bytes with lengths; matched map entries are
 // captured into string columns tag_col_base..tag_col_base+n_tags-1.
-void* avro_decode(const uint8_t* buf, int64_t len, int64_t n_records,
-                  const int32_t* program, int32_t n_fields,
-                  int32_t n_num_cols, int32_t n_str_cols, int32_t n_bags,
-                  const uint8_t* tag_bytes, const int32_t* tag_lens,
-                  int32_t n_tags, int32_t tag_col_base) {
+static void* avro_decode_impl(const uint8_t* buf, int64_t len,
+                              int64_t n_records, const int32_t* program,
+                              int32_t n_fields, int32_t n_num_cols,
+                              int32_t n_str_cols, int32_t n_bags,
+                              const uint8_t* tag_bytes,
+                              const int32_t* tag_lens, int32_t n_tags,
+                              int32_t tag_col_base) {
+  // A record is at least one byte, so a count beyond the payload size is
+  // corrupt; rejecting here also bounds the reserve() below.
+  if (n_records < 0 || n_records > len) return nullptr;
   auto* res = new Result();
   res->num_cols.resize(n_num_cols);
   res->num_present.resize(n_num_cols);
@@ -314,6 +319,22 @@ void* avro_decode(const uint8_t* buf, int64_t len, int64_t n_records,
     return nullptr;
   }
   return res;
+}
+
+void* avro_decode(const uint8_t* buf, int64_t len, int64_t n_records,
+                  const int32_t* program, int32_t n_fields,
+                  int32_t n_num_cols, int32_t n_str_cols, int32_t n_bags,
+                  const uint8_t* tag_bytes, const int32_t* tag_lens,
+                  int32_t n_tags, int32_t tag_col_base) {
+  // No exception may cross the C ABI: corrupt counts can still drive
+  // allocations past memory; surface that as a null handle, not terminate.
+  try {
+    return avro_decode_impl(buf, len, n_records, program, n_fields,
+                            n_num_cols, n_str_cols, n_bags, tag_bytes,
+                            tag_lens, n_tags, tag_col_base);
+  } catch (...) {
+    return nullptr;
+  }
 }
 
 int64_t res_n_rows(void* h) { return static_cast<Result*>(h)->n_rows; }

@@ -191,3 +191,42 @@ class TestNativeReader:
         data, maps, _ = read_game_data([path], {"g": SHARDS["g"]})
         assert data.num_rows == 1  # python fallback handled it
         assert data.feature_shards["g"].vals.tolist().count(3.0) == 1
+
+    def test_corrupt_record_count_no_crash(self, tmp_path):
+        """A corrupted block record-count must surface as a fallback/skip,
+        never a process abort (the decoder's never-UB contract)."""
+        import photon_ml_tpu.io.native_reader as nrm
+        from photon_ml_tpu.io.avro import AvroSchema, _Reader, _decode, MAGIC
+
+        path = str(tmp_path / "c.avro")
+        write_training_examples(
+            path, [{"uid": "a", "label": 1.0, "features": [("f", "1", 2.0)]}]
+        )
+        with open(path, "rb") as f:
+            raw = f.read()
+        r = _Reader(raw)
+        r.read(4)
+        meta = _decode(r, {"type": "map", "values": "bytes"})
+        root = AvroSchema(meta["avro.schema"].decode()).root
+        plan = nr.compile_program(root, ["label"], [], ["features"])
+        assert plan is not None
+        # lie about the record count: the native decoder must reject, not die
+        import zlib
+
+        lib = nrm._load_native()
+        u8p = __import__("ctypes").POINTER(__import__("ctypes").c_uint8)
+        import ctypes
+
+        blob = b"\x00" * 4
+        h = lib.avro_decode(
+            ctypes.cast(ctypes.c_char_p(blob), u8p), len(blob), 1 << 55,
+            np.ascontiguousarray(plan.program).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)
+            ),
+            len(plan.program) // 3, len(plan.num_fields), plan.n_str_cols,
+            len(plan.bag_fields),
+            ctypes.cast(ctypes.c_char_p(b""), u8p),
+            np.zeros(0, np.int32).ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            0, plan.tag_col_base,
+        )
+        assert not h  # null handle, process alive
