@@ -127,6 +127,7 @@ class GameEstimatorEvaluationFunction:
             evaluator=self.estimator.evaluator,
             normalization=self.estimator.normalization,
             intercept_indices=self.estimator.intercept_indices,
+            parallel=self.estimator.parallel,
         )
         fit = estimator.fit(
             self.data,

@@ -20,6 +20,7 @@
 // malformed input yields a null handle, never UB.
 
 #include <cstdint>
+#include <memory>
 #include <cstring>
 #include <string>
 #include <string_view>
@@ -159,7 +160,10 @@ static void* avro_decode_impl(const uint8_t* buf, int64_t len,
   // A record is at least one byte, so a count beyond the payload size is
   // corrupt; rejecting here also bounds the reserve() below.
   if (n_records < 0 || n_records > len) return nullptr;
-  auto* res = new Result();
+  // unique_ptr so a mid-decode bad_alloc (huge corrupt payloads) unwinds
+  // the partially-built result instead of leaking it past the catch
+  auto res_owner = std::make_unique<Result>();
+  Result* res = res_owner.get();
   res->num_cols.resize(n_num_cols);
   res->num_present.resize(n_num_cols);
   for (auto& c : res->num_cols) c.reserve(n_records);
@@ -315,10 +319,9 @@ static void* avro_decode_impl(const uint8_t* buf, int64_t len,
     res->n_rows = rec + 1;
   }
   if (!c.ok || res->n_rows != n_records) {
-    delete res;
     return nullptr;
   }
-  return res;
+  return res_owner.release();
 }
 
 void* avro_decode(const uint8_t* buf, int64_t len, int64_t n_records,

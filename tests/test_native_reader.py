@@ -211,12 +211,10 @@ class TestNativeReader:
         plan = nr.compile_program(root, ["label"], [], ["features"])
         assert plan is not None
         # lie about the record count: the native decoder must reject, not die
-        import zlib
-
-        lib = nrm._load_native()
-        u8p = __import__("ctypes").POINTER(__import__("ctypes").c_uint8)
         import ctypes
 
+        lib = nrm._load_native()
+        u8p = ctypes.POINTER(ctypes.c_uint8)
         blob = b"\x00" * 4
         h = lib.avro_decode(
             ctypes.cast(ctypes.c_char_p(blob), u8p), len(blob), 1 << 55,

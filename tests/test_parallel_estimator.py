@@ -139,3 +139,23 @@ class TestParallelCheckpointResume:
         w = np.asarray(fit2.model.models["global"].coefficients.means)
         assert w.shape[0] == data.feature_shards["g"].dim
         assert np.all(np.isfinite(fit2.model.score(data)))
+
+
+class TestParallelTuning:
+    def test_tuning_trials_keep_parallel_layout(self, rng):
+        """Hyperparameter tuning refits fresh estimators per trial; they
+        must inherit the multi-chip layout of the base estimator."""
+        from photon_ml_tpu.estimators.tuning import GameEstimatorEvaluationFunction
+
+        data = _glmix_data(rng, n=240, n_users=8)
+        base = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinates=_coords(),
+            num_outer_iterations=1,
+            parallel=ParallelConfiguration(n_data=2, n_feat=4, engine="benes"),
+        )
+        fn = GameEstimatorEvaluationFunction(
+            base, data, data, warm_start=False
+        )
+        value, trial = fn(np.zeros(fn.num_params))
+        assert np.isfinite(value)
