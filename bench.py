@@ -71,24 +71,23 @@ def _build():
     return (ell_vals, ell_idx, y), fe_data, (re_x, re_y), re_data
 
 
-def _benes_fe_data(fe_np):
-    """The same fixed-effect problem through the permutation-routed sparse
-    engine (ops/sparse_perm.py) — vector-speed gather/scatter on TPU. The
-    one-time host routing prep is excluded from the timed region, like the
-    reference's RDD dataset build."""
+def _routed_fe_data(fe_np, engine: str):
+    """The same fixed-effect problem through a permutation-routed sparse
+    engine: ``"benes"`` = stage-by-stage (ops/sparse_perm.py), ``"fused"`` =
+    2m+1 fused kernels per linear map (ops/fused_perm.py). The one-time host
+    routing prep is excluded from the timed region, like the reference's RDD
+    dataset build; plans are pattern-keyed and cached across runs."""
+    import getpass
+    import os
+    import tempfile
+
     import jax.numpy as jnp
 
     from photon_ml_tpu.ops.data import LabeledData
-    from photon_ml_tpu.ops.sparse_perm import from_coo
-
-    import os
+    from photon_ml_tpu.ops import fused_perm, sparse_perm
 
     ell_vals, ell_idx, y = fe_np
     rows = np.repeat(np.arange(N_FE, dtype=np.int64), K_NNZ)
-    # routing plans are pattern-keyed; cache across runs on the same host
-    import getpass
-    import tempfile
-
     cache = os.environ.get(
         "BENCH_PLAN_CACHE",
         os.path.join(
@@ -97,8 +96,9 @@ def _benes_fe_data(fe_np):
         ),
     )
     os.makedirs(cache, exist_ok=True)
-    feats = from_coo(rows, ell_idx.ravel().astype(np.int64), ell_vals.ravel(),
-                     (N_FE, D_FE), plan_cache=cache)
+    builder = {"benes": sparse_perm.from_coo, "fused": fused_perm.from_coo}[engine]
+    feats = builder(rows, ell_idx.ravel().astype(np.int64), ell_vals.ravel(),
+                    (N_FE, D_FE), plan_cache=cache)
     return LabeledData.create(feats, jnp.asarray(y))
 
 
@@ -246,33 +246,35 @@ def main():
     fe_np, fe_data, re_np, re_data = _build()
     passes, tpu_time, fe_iters, re_iters = _tpu_run(fe_data, re_data)
 
-    # A/B the Benes permutation engine for the FE sparse hot path against
-    # XLA gather/scatter; keep the faster. Prep (host routing) is one-time
-    # and untimed; failures fall back silently to the ELL path.
+    # A/B the permutation-routed sparse engines for the FE hot path against
+    # XLA gather/scatter; keep the fastest. Prep (host routing) is one-time
+    # and untimed; failures fall back silently to the best path so far.
     import sys as _sys
 
-    try:
-        b_passes, b_time, b_fe, b_re = _tpu_run(
-            _benes_fe_data(fe_np), re_data
-        )
-        print(
-            f"benes A/B: ell={passes / tpu_time:.0f} "
-            f"benes={b_passes / b_time:.0f} passes/s",
-            file=_sys.stderr,
-        )
-        if b_passes / b_time > passes / tpu_time:
-            passes, tpu_time, fe_iters, re_iters = b_passes, b_time, b_fe, b_re
-    except Exception as e:  # pragma: no cover
-        print(f"benes path failed, using ELL: {e}", file=_sys.stderr)
+    best_fe_data = fe_data
+    for engine in ("benes", "fused"):
+        try:
+            e_data = _routed_fe_data(fe_np, engine)
+            e_passes, e_time, e_fe, e_re = _tpu_run(e_data, re_data)
+            print(
+                f"{engine} A/B: best={passes / tpu_time:.0f} "
+                f"{engine}={e_passes / e_time:.0f} passes/s",
+                file=_sys.stderr,
+            )
+            if e_passes / e_time > passes / tpu_time:
+                passes, tpu_time, fe_iters, re_iters = e_passes, e_time, e_fe, e_re
+                best_fe_data = e_data
+        except Exception as e:  # pragma: no cover
+            print(f"{engine} path failed: {e}", file=_sys.stderr)
 
-    # A/B the fused pallas kernels (dense RE inner loop) on real TPU; keep
-    # whichever path is faster. Any pallas failure falls back silently.
+    # A/B the fused pallas kernels (dense RE inner loop) on real TPU over the
+    # best FE engine; keep whichever is faster. Pallas failures fall back.
     from photon_ml_tpu.ops.pallas_kernels import pallas_available
 
     if pallas_available():
         try:
             p_passes, p_time, p_fe, p_re = _tpu_run(
-                fe_data, re_data, use_pallas=True
+                best_fe_data, re_data, use_pallas=True
             )
             print(
                 f"pallas A/B: xla={passes / tpu_time:.0f} "

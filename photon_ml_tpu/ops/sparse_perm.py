@@ -166,6 +166,39 @@ def from_coo(
     ``max_hot_cols=0`` to disable.
     """
     n, d = shape
+    rows, cols, vals, hot_matrix, hot_ids, row_counts, col_counts = (
+        prepare_cold_entries(
+            rows, cols, vals, shape, max_nnz_row, hot_col_threshold, max_hot_cols
+        )
+    )
+    nnz = rows.size
+    k_needed = int(row_counts.max()) if nnz else 1
+    # max_nnz_row doubles as a K floor so callers get shape-stable [n, K]
+    # ELL arrays across datasets (one jit compilation serves them all).
+    K = max(k_needed, int(max_nnz_row) if max_nnz_row is not None else 1, 1)
+    KP = max(int(col_counts.max()) if nnz else 1, 1)
+
+    return _assemble(
+        rows, cols, vals, n, d, K, KP, hot_matrix, hot_ids, plan_cache,
+        row_counts=row_counts, col_counts=col_counts,
+    )
+
+
+def prepare_cold_entries(
+    rows,
+    cols,
+    vals,
+    shape,
+    max_nnz_row: Optional[int],
+    hot_col_threshold: Optional[int],
+    max_hot_cols: int,
+):
+    """Shared builder prologue: coalesce, validate ``max_nnz_row``, split hot
+    columns, count degrees. Returns ``(rows, cols, vals, hot_matrix, hot_ids,
+    row_counts, col_counts)`` with rows/cols/vals reduced to cold entries.
+    Used by both permutation engines so their data prep stays in lockstep.
+    """
+    n, d = shape
     rows, cols, vals = coalesce_coo(rows, cols, vals, n, d)
 
     nnz = rows.size
@@ -188,16 +221,7 @@ def from_coo(
 
     row_counts = np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
     col_counts = np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
-    k_needed = int(row_counts.max()) if nnz else 1
-    # max_nnz_row doubles as a K floor so callers get shape-stable [n, K]
-    # ELL arrays across datasets (one jit compilation serves them all).
-    K = max(k_needed, int(max_nnz_row) if max_nnz_row is not None else 1, 1)
-    KP = max(int(col_counts.max()) if nnz else 1, 1)
-
-    return _assemble(
-        rows, cols, vals, n, d, K, KP, hot_matrix, hot_ids, plan_cache,
-        row_counts=row_counts, col_counts=col_counts,
-    )
+    return rows, cols, vals, hot_matrix, hot_ids, row_counts, col_counts
 
 
 def coalesce_coo(rows, cols, vals, n: int, d: int):
@@ -271,6 +295,48 @@ def split_hot_entries(rows, cols, vals, n: int, d: int, hot_ids: np.ndarray):
     return rows[~is_hot], cols[~is_hot], vals[~is_hot], hot_matrix
 
 
+def build_slot_perm(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    d: int,
+    K: int,
+    KP: int,
+    S: int,
+    row_counts: np.ndarray,
+    col_counts: np.ndarray,
+):
+    """(ell_pos, csc_pos, perm) for one routed layout.
+
+    ell_pos[e]: ELL slot of entry e (row-major position row*K + slot).
+    csc_pos[e]: CSC slot of entry e (column-major position col*KP + slot).
+    perm: bijection on [0, S) with perm[q] = p for real entries and pads
+    mapped to pads in ascending order. Shared by the stage-by-stage and
+    fused engines so both route identical networks for one pattern.
+    """
+    nnz = rows.size
+    row_starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(row_counts, out=row_starts[1:])
+    ell_slot = np.arange(nnz, dtype=np.int64) - row_starts[rows]
+    ell_pos = rows * K + ell_slot
+
+    corder = np.lexsort((rows, cols))
+    col_starts = np.zeros(d + 1, dtype=np.int64)
+    np.cumsum(col_counts, out=col_starts[1:])
+    csc_slot = np.arange(nnz, dtype=np.int64) - col_starts[cols[corder]]
+    csc_pos_sorted = cols[corder] * KP + csc_slot
+    csc_pos = np.empty(nnz, dtype=np.int64)
+    csc_pos[corder] = csc_pos_sorted
+
+    perm = np.full(S, -1, dtype=np.int64)
+    perm[csc_pos] = ell_pos
+    free_dst = np.flatnonzero(perm < 0)
+    used_src = np.zeros(S, dtype=bool)
+    used_src[ell_pos] = True
+    perm[free_dst] = np.flatnonzero(~used_src)
+    return ell_pos, csc_pos, perm
+
+
 def _assemble(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -307,29 +373,9 @@ def _assemble(
     ), "pinned paddings smaller than actual degrees"
     S = routing.valid_size(max(n * K, d * KP, size_floor, 1))
 
-    # ELL slot of each entry: row-major position row*K + slot.
-    row_starts = np.zeros(n + 1, dtype=np.int64)
-    np.cumsum(row_counts, out=row_starts[1:])
-    ell_slot = np.arange(nnz, dtype=np.int64) - row_starts[rows]
-    ell_pos = rows * K + ell_slot
-
-    # CSC slot: column-major position col*KP + slot (entries resorted).
-    corder = np.lexsort((rows, cols))
-    col_starts = np.zeros(d + 1, dtype=np.int64)
-    np.cumsum(col_counts, out=col_starts[1:])
-    csc_slot = np.arange(nnz, dtype=np.int64) - col_starts[cols[corder]]
-    csc_pos_sorted = cols[corder] * KP + csc_slot
-    csc_pos = np.empty(nnz, dtype=np.int64)
-    csc_pos[corder] = csc_pos_sorted
-
-    # Bijection on [0, S): perm[q] = p for real entries; pads map to pads in
-    # ascending order.
-    perm = np.full(S, -1, dtype=np.int64)
-    perm[csc_pos] = ell_pos
-    free_dst = np.flatnonzero(perm < 0)
-    used_src = np.zeros(S, dtype=bool)
-    used_src[ell_pos] = True
-    perm[free_dst] = np.flatnonzero(~used_src)
+    ell_pos, csc_pos, perm = build_slot_perm(
+        rows, cols, n, d, K, KP, S, row_counts, col_counts
+    )
 
     plan = _build_plan_cached(perm, plan_cache)
     plan_inv = plan.invert()

@@ -1,0 +1,241 @@
+"""Fused Benes execution (ops/fused_perm.py) vs the stage-by-stage engine.
+
+Ground truth is dense numpy algebra on the same COO triplets; the fused
+Pallas kernels run through the interpreter on CPU (the same 8-virtual-device
+harness as everything else), exercising descend/base/ascend tiles and all
+four prologue/epilogue fusions.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops import fused_perm
+from photon_ml_tpu.ops.fused_perm import (
+    Broadcast,
+    FusedBenesFeatures,
+    MulBroadcast,
+    MulReduce,
+    Reduce,
+    from_coo,
+    fused_execute,
+    parse_plan,
+    unfused_execute,
+)
+
+
+@pytest.fixture
+def interpret_kernels():
+    old = fused_perm._INTERPRET
+    fused_perm._INTERPRET = True
+    yield
+    fused_perm._INTERPRET = old
+
+
+def _random_coo(rng, n, d, nnz):
+    rows = rng.integers(0, n, nnz)
+    cols = rng.integers(0, d, nnz)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    dense = np.zeros((n, d), dtype=np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    return rows, cols, vals, dense
+
+
+def _check_against_dense(feats, dense, rng, atol=1e-4):
+    n, d = dense.shape
+    w = rng.standard_normal(d).astype(np.float32)
+    c = rng.standard_normal(n).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(feats.matvec(jnp.asarray(w))), dense @ w, atol=atol
+    )
+    np.testing.assert_allclose(
+        np.asarray(feats.rmatvec(jnp.asarray(c))), dense.T @ c, atol=atol
+    )
+    np.testing.assert_allclose(
+        np.asarray(feats.rmatvec_sq(jnp.asarray(c))), (dense * dense).T @ c,
+        atol=atol,
+    )
+    np.testing.assert_allclose(
+        np.asarray(feats.row_norms_sq()), (dense * dense).sum(1), atol=atol
+    )
+
+
+class TestUnfusedFallback:
+    """CPU default path (pallas unavailable): unfused XLA execution."""
+
+    def test_matches_dense(self, rng):
+        rows, cols, vals, dense = _random_coo(rng, n=64, d=40, nnz=500)
+        feats = from_coo(rows, cols, vals, (64, 40), max_hot_cols=0)
+        assert not feats._fused_ok() or fused_perm._INTERPRET is False
+        _check_against_dense(feats, dense, rng)
+
+    def test_hot_split(self, rng):
+        rows, cols, vals, dense = _random_coo(rng, n=128, d=30, nnz=600)
+        # every row touches column 0: a hot (intercept-like) column
+        rows = np.concatenate([rows, np.arange(128)])
+        cols = np.concatenate([cols, np.zeros(128, dtype=cols.dtype)])
+        ones = np.ones(128, dtype=np.float32)
+        vals = np.concatenate([vals, ones])
+        np.add.at(dense, (np.arange(128), 0), ones)
+        feats = from_coo(rows, cols, vals, (128, 30), hot_col_threshold=100)
+        assert feats.hot_matrix is not None
+        _check_against_dense(feats, dense, rng)
+
+    def test_kp_above_128(self, rng):
+        # one column with degree > 128 and the hot split disabled: KP = 256
+        n, d = 300, 12
+        rows = np.arange(n)
+        cols = np.full(n, 3)
+        vals = rng.standard_normal(n).astype(np.float32)
+        dense = np.zeros((n, d), dtype=np.float32)
+        dense[rows, cols] = vals
+        feats = from_coo(rows, cols, vals, (n, d), max_hot_cols=0)
+        assert feats.csc_k == 512
+        _check_against_dense(feats, dense, rng)
+
+    def test_empty(self):
+        feats = from_coo([], [], [], (8, 8), max_hot_cols=0)
+        z = np.asarray(feats.matvec(jnp.ones(8, jnp.float32)))
+        np.testing.assert_allclose(z, np.zeros(8))
+
+    def test_powers_of_two_groups(self, rng):
+        rows, cols, vals, _ = _random_coo(rng, n=64, d=40, nnz=500)
+        feats = from_coo(rows, cols, vals, (64, 40), max_hot_cols=0)
+        assert feats.ell_k & (feats.ell_k - 1) == 0
+        assert feats.csc_k & (feats.csc_k - 1) == 0
+
+
+class TestFusedKernels:
+    """Pallas kernels through the interpreter; sizes force >=1 recursion."""
+
+    def test_single_level_all_maps(self, rng, interpret_kernels):
+        # S >= 128^2 so the plan has exactly one descend/ascend level
+        n, d = 1024, 600
+        rows, cols, vals, dense = _random_coo(rng, n, d, 6000)
+        feats = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0, size_floor=128 * 128
+        )
+        assert len(parse_plan(feats.plan).descents) >= 1
+        assert feats._fused_ok()
+        _check_against_dense(feats, dense, rng)
+
+    def test_single_level_hot_split(self, rng, interpret_kernels):
+        n, d = 2048, 300
+        rows, cols, vals, dense = _random_coo(rng, n, d, 8000)
+        rows = np.concatenate([rows, np.arange(n)])
+        cols = np.concatenate([cols, np.zeros(n, dtype=cols.dtype)])
+        ones = np.ones(n, dtype=np.float32)
+        vals = np.concatenate([vals, ones])
+        np.add.at(dense, (np.arange(n), 0), ones)
+        feats = from_coo(rows, cols, vals, (n, d), hot_col_threshold=n // 2)
+        assert feats.hot_matrix is not None
+        _check_against_dense(feats, dense, rng)
+
+    def test_two_level_plan(self, rng, interpret_kernels):
+        # size_floor pushes S to 128^3: two descents, sublane base, two ascents
+        n, d = 512, 256
+        rows, cols, vals, dense = _random_coo(rng, n, d, 3000)
+        feats = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0, size_floor=128 ** 3
+        )
+        assert len(parse_plan(feats.plan).descents) == 2
+        _check_against_dense(feats, dense, rng)
+
+    def test_kp_above_128_fused(self, rng, interpret_kernels):
+        n, d = 200, 64
+        extra_rows = np.arange(n)
+        extra_cols = np.full(n, 5)
+        rows, cols, vals, dense = _random_coo(rng, n, d, 1500)
+        ev = rng.standard_normal(n).astype(np.float32)
+        np.add.at(dense, (extra_rows, extra_cols), ev)
+        rows = np.concatenate([rows, extra_rows])
+        cols = np.concatenate([cols, extra_cols])
+        vals = np.concatenate([vals, ev])
+        feats = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0, size_floor=128 * 128
+        )
+        assert feats.csc_k >= 256
+        _check_against_dense(feats, dense, rng)
+
+    def test_k_above_128_fused(self, rng, interpret_kernels):
+        # one row with >128 nnz and no hot split: K = 256 exercises the
+        # group>LANES branches of MulBroadcast (rmatvec prologue) and
+        # MulReduce (matvec epilogue)
+        n, d = 64, 256
+        rows, cols, vals, dense = _random_coo(rng, n, d, 800)
+        extra_cols = rng.permutation(d)[:200]
+        extra_rows = np.full(200, 7)
+        ev = rng.standard_normal(200).astype(np.float32)
+        np.add.at(dense, (extra_rows, extra_cols), ev)
+        rows = np.concatenate([rows, extra_rows])
+        cols = np.concatenate([cols, extra_cols])
+        vals = np.concatenate([vals, ev])
+        feats = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0, size_floor=128 * 128
+        )
+        assert feats.ell_k >= 256
+        _check_against_dense(feats, dense, rng)
+
+    def test_fused_equals_unfused_execute(self, rng, interpret_kernels):
+        n, d = 512, 512
+        rows, cols, vals, _ = _random_coo(rng, n, d, 4000)
+        feats = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0, size_floor=128 * 128
+        )
+        S, K, KP = feats.size, feats.ell_k, feats.csc_k
+        w = jnp.asarray(rng.standard_normal(S // KP).astype(np.float32))
+        c = jnp.asarray(rng.standard_normal(S // K).astype(np.float32))
+        for dplan, pro, epi in [
+            (feats.plan_inv, Broadcast(w, KP), MulReduce(feats.ell_flat, K)),
+            (feats.plan, MulBroadcast(feats.ell_flat, c, K), Reduce(KP)),
+            (feats.plan, MulBroadcast(feats.ell_flat, c, K, square=True), Reduce(KP)),
+        ]:
+            got = np.asarray(fused_execute(dplan, pro, epi, interpret=True))
+            want = np.asarray(unfused_execute(dplan, pro, epi))
+            np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestInSolver:
+    """The fused engine as a drop-in FeatureMatrix in an actual GLM solve."""
+
+    def test_lbfgs_matches_ell(self, rng, interpret_kernels):
+        from photon_ml_tpu.losses.objective import make_glm_objective
+        from photon_ml_tpu.losses.pointwise import LogisticLoss
+        from photon_ml_tpu.ops.data import LabeledData
+        from photon_ml_tpu.ops.features import from_scipy_like
+        from photon_ml_tpu.opt.config import (
+            GlmOptimizationConfiguration,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.opt.solve import solve
+
+        n, d = 512, 200
+        rows, cols, vals, dense = _random_coo(rng, n, d, 4000)
+        w_true = rng.standard_normal(d).astype(np.float32) * 0.5
+        z = dense @ w_true
+        y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+
+        objective = make_glm_objective(LogisticLoss)
+        cfg = GlmOptimizationConfiguration(
+            optimizer_config=OptimizerConfig.lbfgs(max_iterations=30),
+            regularization_weight=1.0,
+        )
+        l2 = jnp.float32(1.0)
+
+        ell = from_scipy_like(rows, cols, vals, (n, d))
+        res_ell = solve(
+            objective, jnp.zeros(d, jnp.float32),
+            LabeledData.create(ell, jnp.asarray(y)), cfg, l2_weight=l2,
+        )
+        fused = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0, size_floor=128 * 128
+        )
+        res_fused = solve(
+            objective, jnp.zeros(d, jnp.float32),
+            LabeledData.create(fused, jnp.asarray(y)), cfg, l2_weight=l2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res_fused.w), np.asarray(res_ell.w), atol=5e-3
+        )

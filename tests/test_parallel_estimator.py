@@ -142,7 +142,7 @@ class TestParallelCheckpointResume:
 
 
 class TestParallelTuning:
-    def test_tuning_trials_keep_parallel_layout(self, rng):
+    def test_tuning_trials_keep_parallel_layout(self, rng, monkeypatch):
         """Hyperparameter tuning refits fresh estimators per trial; they
         must inherit the multi-chip layout of the base estimator."""
         from photon_ml_tpu.estimators.tuning import GameEstimatorEvaluationFunction
@@ -157,5 +157,20 @@ class TestParallelTuning:
         fn = GameEstimatorEvaluationFunction(
             base, data, data, warm_start=False
         )
+        # spy on the trial estimator's construction: the trial must be
+        # handed the base estimator's parallel layout (reverting the
+        # `parallel=` pass-through in tuning.py must fail this test, not
+        # just train single-device and still look finite)
+        import photon_ml_tpu.estimators.tuning as tuning_mod
+
+        captured = {}
+        real_cls = tuning_mod.GameEstimator
+
+        def spy(**kwargs):
+            captured.update(kwargs)
+            return real_cls(**kwargs)
+
+        monkeypatch.setattr(tuning_mod, "GameEstimator", spy)
         value, trial = fn(np.zeros(fn.num_params))
         assert np.isfinite(value)
+        assert captured["parallel"] is base.parallel
