@@ -277,7 +277,7 @@ def main():
                 best_fe_data, re_data, use_pallas=True
             )
             print(
-                f"pallas A/B: xla={passes / tpu_time:.0f} "
+                f"pallas A/B: best={passes / tpu_time:.0f} "
                 f"pallas={p_passes / p_time:.0f} passes/s",
                 file=sys.stderr,
             )

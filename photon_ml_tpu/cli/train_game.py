@@ -96,7 +96,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="devices on the coefficient axis (shards w / grad / "
                         "optimizer history for huge feature spaces)")
     p.add_argument("--parallel-engine", default="benes",
-                   choices=["benes", "ell"],
+                   choices=["benes", "ell", "fused"],
                    help="sparse engine per grid tile")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax profiler trace of the fit phase here "

@@ -133,12 +133,14 @@ class GameData:
         - "ell"   — padded row-sparse gather/scatter layout (XLA).
         - "benes" — permutation-routed engine (ops/sparse_perm.py): vector-
           speed matvec/rmatvec on TPU, with a one-time host routing cost.
-        - "auto"  — "benes" on a TPU backend when the shard is large enough
+        - "fused" — same routing executed as fused Pallas kernels
+          (ops/fused_perm.py): ~3x less HBM traffic per linear map on TPU.
+        - "auto"  — "fused" on a TPU backend when the shard is large enough
           for the routing prep to pay for itself, else "ell".
         """
-        if engine not in ("auto", "ell", "benes"):
+        if engine not in ("auto", "ell", "benes", "fused"):
             raise ValueError(
-                f"unknown sparse engine {engine!r}; expected auto/ell/benes"
+                f"unknown sparse engine {engine!r}; expected auto/ell/benes/fused"
             )
         cache = getattr(self, "_feat_cache", None)
         if cache is None:
@@ -149,11 +151,14 @@ class GameData:
             import jax
 
             on_tpu = jax.default_backend() == "tpu"
-            engine = "benes" if on_tpu and shard.rows.size >= (1 << 20) else "ell"
+            engine = "fused" if on_tpu and shard.rows.size >= (1 << 20) else "ell"
         key = (shard_name, engine)
         if key not in cache:
-            if engine == "benes":
-                from photon_ml_tpu.ops.sparse_perm import from_coo
+            if engine in ("benes", "fused"):
+                if engine == "benes":
+                    from photon_ml_tpu.ops.sparse_perm import from_coo
+                else:
+                    from photon_ml_tpu.ops.fused_perm import from_coo
 
                 cache[key] = from_coo(
                     shard.rows, shard.cols, shard.vals, (self.num_rows, shard.dim)

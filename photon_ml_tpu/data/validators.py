@@ -59,6 +59,14 @@ def _feature_values(data: LabeledData) -> np.ndarray:
         if feats.hot_matrix is None:
             return cold
         return np.concatenate([cold, np.asarray(feats.hot_matrix)], axis=1)
+    from photon_ml_tpu.ops.fused_perm import FusedBenesFeatures
+
+    if isinstance(feats, FusedBenesFeatures):
+        cold = np.asarray(feats.ell_flat).reshape(-1, feats.ell_k)
+        cold = cold[: feats.num_rows_]
+        if feats.hot_matrix is None:
+            return cold
+        return np.concatenate([cold, np.asarray(feats.hot_matrix)], axis=1)
     raise TypeError(f"unknown feature matrix type {type(feats)!r}")
 
 

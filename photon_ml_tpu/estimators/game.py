@@ -65,7 +65,7 @@ class ParallelConfiguration:
 
     n_data: int
     n_feat: int = 1
-    engine: str = "benes"  # grid tile engine: "benes" | "ell"
+    engine: str = "benes"  # grid tile engine: "benes" | "ell" | "fused"
 
     def build_mesh(self):
         from photon_ml_tpu.parallel.grid_features import grid_mesh
@@ -80,7 +80,7 @@ class FixedEffectCoordinateConfiguration:
 
     feature_shard: str
     optimizer: GlmOptimizationConfiguration = GlmOptimizationConfiguration()
-    # sparse engine for the global problem: "auto" | "ell" | "benes"
+    # sparse engine for the global problem: "auto" | "ell" | "benes" | "fused"
     # (GameData.sparse_features; "auto" routes large TPU problems through
     # the permutation engine)
     sparse_engine: str = "auto"

@@ -121,12 +121,14 @@ class Broadcast(NamedTuple):
 
 
 class MulBroadcast(NamedTuple):
-    """input[row*K + k] = values[row*K + k] * vec[row] — rmatvec's c expansion."""
+    """input[row*K + k] = t(values[row*K + k]) * vec[row] — rmatvec's c
+    expansion. ``transform`` applies elementwise to the stored values in the
+    kernel: "id", "sq" (Hessian diagonal), "abs" / "nnz" (summary stats)."""
 
     values: jax.Array  # [S] flat slot values (ELL layout)
     vec: jax.Array     # [S // group]
     group: int         # K
-    square: bool = False
+    transform: str = "id"
 
 
 class MulReduce(NamedTuple):
@@ -150,6 +152,18 @@ def _group_mats(group: int, dtype=jnp.float32):
     slot = jax.lax.broadcasted_iota(jnp.int32, (g2, LANES), 0)
     expand = (lane == slot).astype(dtype)
     return expand, expand.T
+
+
+def _apply_transform(vals: jax.Array, transform: str) -> jax.Array:
+    if transform == "id":
+        return vals
+    if transform == "sq":
+        return vals * vals
+    if transform == "abs":
+        return jnp.abs(vals)
+    if transform == "nnz":
+        return (vals != 0).astype(vals.dtype)
+    raise ValueError(f"unknown value transform {transform!r}")
 
 
 def _build_input_block(pro, w_ref, v_ref, rows: int):
@@ -180,10 +194,7 @@ def _build_input_block(pro, w_ref, v_ref, rows: int):
         )  # [rows, 1]
         x = jnp.broadcast_to(col, (rows, LANES))
     if isinstance(pro, MulBroadcast):
-        vals = v_ref[...]
-        if pro.square:
-            vals = vals * vals
-        x = vals * x
+        x = _apply_transform(v_ref[...], pro.transform) * x
     return x
 
 
@@ -421,9 +432,7 @@ def unfused_execute(dplan: DevicePlan, pro, epi) -> jax.Array:
             pro.vec[:, None], (pro.vec.shape[0], pro.group)
         ).reshape(-1)
     else:
-        vals = pro.values
-        if pro.square:
-            vals = vals * vals
+        vals = _apply_transform(pro.values, pro.transform)
         x = vals * jnp.repeat(pro.vec, pro.group, total_repeat_length=S)
     y = apply_plan(dplan, x)
     if isinstance(epi, MulReduce):
@@ -493,25 +502,38 @@ class FusedBenesFeatures:
         return z
 
     def rmatvec(self, c: jax.Array) -> jax.Array:
-        return self._rmatvec_impl(c, squared=False)
+        return self._rmatvec_impl(c, transform="id")
 
     def rmatvec_sq(self, c: jax.Array) -> jax.Array:
-        return self._rmatvec_impl(c, squared=True)
+        return self._rmatvec_impl(c, transform="sq")
 
-    def _rmatvec_impl(self, c: jax.Array, squared: bool) -> jax.Array:
+    def _rmatvec_impl(self, c: jax.Array, transform: str) -> jax.Array:
+        """X^T c with the stored values elementwise-transformed first
+        ("id" / "sq" / "abs" / "nnz" — the latter two feed summary stats)."""
         S, KP, K = self.size, self.csc_k, self.ell_k
         cp = jnp.zeros((S // K,), c.dtype).at[: self.num_rows_].set(c)
         g = self._run(
             self.plan,
-            MulBroadcast(self.ell_flat, cp, K, square=squared),
+            MulBroadcast(self.ell_flat, cp, K, transform=transform),
             Reduce(KP),
         )[: self.num_cols_]
         if self.hot_matrix is not None:
-            hot = self.hot_matrix
-            if squared:
-                hot = hot * hot
+            hot = _apply_transform(self.hot_matrix, transform)
             g = g.at[self.hot_cols].add(hot.T @ c)
         return g
+
+    def csc_view(self, flat_ell: jax.Array) -> jax.Array:
+        """Route an [S] ELL-slot array to the column-grouped side and return
+        it as [d, KP] (one row per column). Stats-path utility — executes
+        the plain stage-by-stage permutation, not the fused kernels."""
+        d, KP = self.num_cols_, self.csc_k
+        return apply_plan(self.plan, flat_ell)[: d * KP].reshape(d, KP)
+
+    def weights_to_slots(self, weights: jax.Array) -> jax.Array:
+        """Broadcast per-row weights [n] to ELL slot order [S]."""
+        S, K = self.size, self.ell_k
+        wp = jnp.zeros((S // K,), weights.dtype).at[: self.num_rows_].set(weights)
+        return jnp.repeat(wp, K, total_repeat_length=S)
 
     def row_norms_sq(self) -> jax.Array:
         sq = (self.ell_flat * self.ell_flat).reshape(-1, self.ell_k).sum(axis=1)
@@ -547,11 +569,7 @@ def from_coo(
     degree (a too-small pin raises rather than silently diverging from the
     sibling shards).
     """
-    from photon_ml_tpu.ops.sparse_perm import (
-        _build_plan_cached,
-        build_slot_perm,
-        prepare_cold_entries,
-    )
+    from photon_ml_tpu.ops.sparse_perm import prepare_cold_entries
 
     n, d = shape
     rows, cols, vals, hot_matrix, hot_ids, row_counts, col_counts = (
@@ -575,8 +593,43 @@ def from_coo(
             raise ValueError(f"{name}={pin} below required group size {needed}")
     K = max(K, pin_k)
     KP = max(KP, pin_kp)
-    S = routing.valid_size(max(n * K, d * KP, size_floor, 1))
+    return assemble(
+        rows, cols, vals, n, d, K, KP, hot_matrix, hot_ids, plan_cache,
+        size_floor=size_floor, row_counts=row_counts, col_counts=col_counts,
+    )
 
+
+def assemble(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n: int,
+    d: int,
+    K: int,
+    KP: int,
+    hot_matrix: Optional[np.ndarray],
+    hot_ids: Optional[np.ndarray],
+    plan_cache: Optional[str],
+    size_floor: int = 0,
+    row_counts: Optional[np.ndarray] = None,
+    col_counts: Optional[np.ndarray] = None,
+) -> FusedBenesFeatures:
+    """Route + lay out prepared cold entries with pinned power-of-two
+    paddings — the fused twin of ``sparse_perm._assemble`` (the grid builder
+    stacks identically-shaped tiles built through this)."""
+    nnz = rows.size
+    if row_counts is None:
+        row_counts = np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
+    if col_counts is None:
+        col_counts = np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
+    assert K & (K - 1) == 0 and KP & (KP - 1) == 0, "group sizes must be pow2"
+    assert not nnz or (
+        row_counts.max() <= K and col_counts.max() <= KP
+    ), "pinned paddings smaller than actual degrees"
+
+    from photon_ml_tpu.ops.sparse_perm import _build_plan_cached, build_slot_perm
+
+    S = routing.valid_size(max(n * K, d * KP, size_floor, 1))
     ell_pos, _, perm = build_slot_perm(
         rows, cols, n, d, K, KP, S, row_counts, col_counts
     )

@@ -170,8 +170,8 @@ def grid_from_coo(
     the feat-axis size; callers padding labels/weights must give padding
     rows weight 0 (padded columns are simply never touched).
     """
-    if engine not in ("benes", "ell"):
-        raise ValueError(f"unknown engine {engine!r}; expected benes/ell")
+    if engine not in ("benes", "ell", "fused"):
+        raise ValueError(f"unknown engine {engine!r}; expected benes/ell/fused")
     n, d = shape
     n_dd = mesh.shape[DATA_AXIS]
     n_df = mesh.shape[FEAT_AXIS]
@@ -237,16 +237,26 @@ def grid_from_coo(
             K = max(K, int(np.bincount(tr).max()))
             KP = max(KP, int(np.bincount(tc).max()))
 
+    if engine == "fused":
+        # fused kernels need power-of-two slot groups
+        K = 1 << max(K - 1, 0).bit_length()
+        KP = 1 << max(KP - 1, 0).bit_length()
+
     structs = []
     for dd in range(n_dd):
         row_structs = []
         for df in range(n_df):
             tr, tc, tv, hm = tiles_cold[dd, df]
             hot_ids = tile_hot[dd, df] if h_common else None
-            if engine == "benes":
+            if engine in ("benes", "fused"):
                 S = routing.valid_size(max(n_loc * K, d_loc * KP, 1))
+                assembler = _assemble
+                if engine == "fused":
+                    from photon_ml_tpu.ops import fused_perm
+
+                    assembler = fused_perm.assemble
                 row_structs.append(
-                    _assemble(
+                    assembler(
                         tr, tc, tv, n_loc, d_loc, K, KP, hm, hot_ids,
                         plan_cache, size_floor=S,
                     )

@@ -106,7 +106,37 @@ def _benes_stats(feats, weights):
     return s1, s2, sabs, nnz, mn, mx, wsum
 
 
+def _fused_stats(feats, weights):
+    """Stats through the fused engine's transformed linear maps; min/max
+    route the live-masked values to the column-grouped side once (plain
+    permutation — stats run once, not per optimizer step)."""
+    wsum = jnp.sum(weights)
+    s1 = feats.rmatvec(weights)
+    s2 = feats.rmatvec_sq(weights)
+    sabs = feats._rmatvec_impl(weights, transform="abs")
+    nnz = feats._rmatvec_impl(weights, transform="nnz")
+
+    w_slots = feats.weights_to_slots(weights)
+    live = (feats.ell_flat != 0) & (w_slots > 0)
+    big = jnp.asarray(jnp.inf, feats.ell_flat.dtype)
+    mx = jnp.max(
+        feats.csc_view(jnp.where(live, feats.ell_flat, -big)), axis=1
+    )
+    mn = jnp.min(
+        feats.csc_view(jnp.where(live, feats.ell_flat, big)), axis=1
+    )
+    hot = feats.hot_matrix
+    if hot is not None:
+        hlive = (hot != 0) & (weights > 0)[:, None]
+        hmx = jnp.max(jnp.where(hlive, hot, -jnp.inf), axis=0)
+        hmn = jnp.min(jnp.where(hlive, hot, jnp.inf), axis=0)
+        mx = mx.at[feats.hot_cols].max(hmx)
+        mn = mn.at[feats.hot_cols].min(hmn)
+    return s1, s2, sabs, nnz, mn, mx, wsum
+
+
 def summarize(data: LabeledData) -> BasicStatisticalSummary:
+    from photon_ml_tpu.ops.fused_perm import FusedBenesFeatures
     from photon_ml_tpu.ops.sparse_perm import BenesSparseFeatures
 
     feats = data.features
@@ -115,6 +145,9 @@ def summarize(data: LabeledData) -> BasicStatisticalSummary:
         sparse = False
     elif isinstance(feats, BenesSparseFeatures):
         s1, s2, sabs, nnz, mn, mx, wsum = _benes_stats(feats, data.weights)
+        sparse = True
+    elif isinstance(feats, FusedBenesFeatures):
+        s1, s2, sabs, nnz, mn, mx, wsum = _fused_stats(feats, data.weights)
         sparse = True
     else:
         s1, s2, sabs, nnz, mn, mx, wsum = _ell_stats(feats, data.weights)

@@ -190,11 +190,172 @@ class TestFusedKernels:
         for dplan, pro, epi in [
             (feats.plan_inv, Broadcast(w, KP), MulReduce(feats.ell_flat, K)),
             (feats.plan, MulBroadcast(feats.ell_flat, c, K), Reduce(KP)),
-            (feats.plan, MulBroadcast(feats.ell_flat, c, K, square=True), Reduce(KP)),
+            (feats.plan, MulBroadcast(feats.ell_flat, c, K, transform="sq"), Reduce(KP)),
+            (feats.plan, MulBroadcast(feats.ell_flat, c, K, transform="abs"), Reduce(KP)),
+            (feats.plan, MulBroadcast(feats.ell_flat, c, K, transform="nnz"), Reduce(KP)),
         ]:
             got = np.asarray(fused_execute(dplan, pro, epi, interpret=True))
             want = np.asarray(unfused_execute(dplan, pro, epi))
             np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestSummaryStats:
+    def test_matches_ell_engine(self, rng, interpret_kernels):
+        from photon_ml_tpu.ops.data import LabeledData
+        from photon_ml_tpu.ops.features import from_scipy_like
+        from photon_ml_tpu.stat.summary import summarize
+
+        n, d = 512, 300
+        rows, cols, vals, dense = _random_coo(rng, n, d, 4000)
+        # hot column so the hot-side min/max fold is exercised too
+        rows = np.concatenate([rows, np.arange(n)])
+        cols = np.concatenate([cols, np.zeros(n, dtype=cols.dtype)])
+        ones = np.ones(n, dtype=np.float32)
+        vals = np.concatenate([vals, ones])
+        np.add.at(dense, (np.arange(n), 0), ones)
+        weights = rng.random(n).astype(np.float32) + 0.5
+
+        fused = from_coo(
+            rows, cols, vals, (n, d), hot_col_threshold=n // 2,
+            size_floor=128 * 128,
+        )
+        ell = from_scipy_like(rows, cols, vals, (n, d))
+        y = jnp.zeros(n, jnp.float32)
+        w = jnp.asarray(weights)
+        s_f = summarize(LabeledData.create(fused, y, weights=w))
+        s_e = summarize(LabeledData.create(ell, y, weights=w))
+        for field in ("mean", "variance", "num_nonzeros", "max_abs",
+                      "min_val", "max_val", "mean_abs"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(s_f, field)),
+                np.asarray(getattr(s_e, field)),
+                rtol=1e-5, atol=1e-3, err_msg=field,
+            )
+
+
+class TestValidators:
+    def test_validate_labeled_data_fused_engine(self, rng, interpret_kernels):
+        from photon_ml_tpu.data.validators import (
+            DataValidationError,
+            validate_labeled_data,
+        )
+        from photon_ml_tpu.ops.data import LabeledData
+        from photon_ml_tpu.types import TaskType
+
+        n, d = 256, 128
+        rows, cols, vals, _ = _random_coo(rng, n, d, 1500)
+        # hot column so the concatenated hot side is validated too
+        rows = np.concatenate([rows, np.arange(n)])
+        cols = np.concatenate([cols, np.zeros(n, dtype=cols.dtype)])
+        vals = np.concatenate([vals, np.ones(n, dtype=np.float32)])
+        feats = from_coo(rows, cols, vals, (n, d), hot_col_threshold=n // 2)
+        y = jnp.asarray((rng.random(n) > 0.5).astype(np.float32))
+        validate_labeled_data(
+            LabeledData.create(feats, y), TaskType.LOGISTIC_REGRESSION
+        )  # clean data passes
+
+        bad = np.array(vals)
+        bad[7] = np.nan
+        feats_bad = from_coo(rows, cols, bad, (n, d), hot_col_threshold=n // 2)
+        with pytest.raises(DataValidationError):
+            validate_labeled_data(
+                LabeledData.create(feats_bad, y), TaskType.LOGISTIC_REGRESSION
+            )
+
+
+class TestGridFused:
+    def test_grid_fused_matches_ell_grid(self, rng, interpret_kernels):
+        import jax
+        from photon_ml_tpu.parallel.grid_features import (
+            grid_from_coo,
+            grid_mesh,
+            shard_vector_data,
+            shard_vector_feat,
+        )
+
+        n, d = 256, 192
+        rows, cols, vals, dense = _random_coo(rng, n, d, 2000)
+        mesh = grid_mesh(2, 4)
+        w = rng.standard_normal(d).astype(np.float32)
+        c = rng.standard_normal(n).astype(np.float32)
+
+        outs = {}
+        for engine in ("ell", "fused"):
+            gf = grid_from_coo(rows, cols, vals, (n, d), mesh, engine=engine)
+            wp = np.zeros(gf.dim, np.float32)
+            wp[:d] = w
+            cp = np.zeros(gf.num_rows, np.float32)
+            cp[:n] = c
+            z = np.asarray(gf.matvec(shard_vector_feat(jnp.asarray(wp), mesh)))
+            g = np.asarray(gf.rmatvec(shard_vector_data(jnp.asarray(cp), mesh)))
+            outs[engine] = (z[:n], g[:d])
+
+        np.testing.assert_allclose(outs["fused"][0], dense @ w, atol=1e-4)
+        np.testing.assert_allclose(outs["fused"][1], dense.T @ c, atol=1e-4)
+        np.testing.assert_allclose(outs["fused"][0], outs["ell"][0], atol=1e-4)
+        np.testing.assert_allclose(outs["fused"][1], outs["ell"][1], atol=1e-4)
+
+
+class TestEstimatorFused:
+    def test_game_estimator_fused_engine(self, rng):
+        from photon_ml_tpu.data.game_data import FeatureShard, GameData
+        from photon_ml_tpu.data.random_effect import RandomEffectDataConfiguration
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+            RandomEffectCoordinateConfiguration,
+        )
+        from photon_ml_tpu.opt.config import (
+            GlmOptimizationConfiguration,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        n, d, k = 400, 64, 4
+        rows = np.repeat(np.arange(n), k)
+        cols = rng.integers(0, d, n * k)
+        vals = rng.standard_normal(n * k).astype(np.float32)
+        dense = np.zeros((n, d), np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        w_true = (rng.standard_normal(d) * 0.5).astype(np.float32)
+        y = (rng.random(n) < 1.0 / (1.0 + np.exp(-dense @ w_true))).astype(
+            np.float32
+        )
+        users = [f"u{i % 10}" for i in range(n)]
+        data = GameData(
+            labels=y,
+            feature_shards={"g": FeatureShard(rows=rows, cols=cols, vals=vals, dim=d)},
+            id_tags={"userId": users},
+            offsets=np.zeros(n, np.float32),
+            weights=np.ones(n, np.float32),
+        )
+        opt = GlmOptimizationConfiguration(
+            optimizer_config=OptimizerConfig.lbfgs(max_iterations=25),
+            regularization_weight=1.0,
+        )
+
+        fits = {}
+        for engine in ("ell", "fused"):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinates={
+                    "global": FixedEffectCoordinateConfiguration(
+                        feature_shard="g", optimizer=opt, sparse_engine=engine
+                    ),
+                    "per-user": RandomEffectCoordinateConfiguration(
+                        feature_shard="g",
+                        data=RandomEffectDataConfiguration(
+                            random_effect_type="userId"
+                        ),
+                        optimizer=opt,
+                    ),
+                },
+                num_outer_iterations=1,
+            )
+            fits[engine] = est.fit(data)
+        w_e = np.asarray(fits["ell"].model.models["global"].coefficients.means)
+        w_f = np.asarray(fits["fused"].model.models["global"].coefficients.means)
+        np.testing.assert_allclose(w_f, w_e, atol=5e-3)
 
 
 class TestInSolver:
