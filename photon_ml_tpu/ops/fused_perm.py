@@ -617,25 +617,13 @@ def assemble(
     """Route + lay out prepared cold entries with pinned power-of-two
     paddings — the fused twin of ``sparse_perm._assemble`` (the grid builder
     stacks identically-shaped tiles built through this)."""
-    nnz = rows.size
-    if row_counts is None:
-        row_counts = np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
-    if col_counts is None:
-        col_counts = np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
     assert K & (K - 1) == 0 and KP & (KP - 1) == 0, "group sizes must be pow2"
-    assert not nnz or (
-        row_counts.max() <= K and col_counts.max() <= KP
-    ), "pinned paddings smaller than actual degrees"
 
-    from photon_ml_tpu.ops.sparse_perm import _build_plan_cached, build_slot_perm
+    from photon_ml_tpu.ops.sparse_perm import route_layout
 
-    S = routing.valid_size(max(n * K, d * KP, size_floor, 1))
-    ell_pos, _, perm = build_slot_perm(
-        rows, cols, n, d, K, KP, S, row_counts, col_counts
+    ell_pos, _, plan, plan_inv, S = route_layout(
+        rows, cols, n, d, K, KP, plan_cache, size_floor, row_counts, col_counts
     )
-
-    plan = _build_plan_cached(perm, plan_cache)
-    plan_inv = plan.invert()
 
     ell_flat = np.zeros(S, dtype=np.float32)
     ell_flat[ell_pos] = vals

@@ -337,6 +337,42 @@ def build_slot_perm(
     return ell_pos, csc_pos, perm
 
 
+def route_layout(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    n: int,
+    d: int,
+    K: int,
+    KP: int,
+    plan_cache: Optional[str],
+    size_floor: int = 0,
+    row_counts: Optional[np.ndarray] = None,
+    col_counts: Optional[np.ndarray] = None,
+):
+    """Shared routing core for both permutation engines: validate pinned
+    paddings, size the network, build slot positions and the (plan,
+    plan_inv) pair. Returns ``(ell_pos, csc_pos, plan, plan_inv, S)``."""
+    nnz = rows.size
+    if row_counts is None:
+        row_counts = (
+            np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
+        )
+    if col_counts is None:
+        col_counts = (
+            np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
+        )
+    assert not nnz or (
+        row_counts.max() <= K and col_counts.max() <= KP
+    ), "pinned paddings smaller than actual degrees"
+    S = routing.valid_size(max(n * K, d * KP, size_floor, 1))
+
+    ell_pos, csc_pos, perm = build_slot_perm(
+        rows, cols, n, d, K, KP, S, row_counts, col_counts
+    )
+    plan = _build_plan_cached(perm, plan_cache)
+    return ell_pos, csc_pos, plan, plan.invert(), S
+
+
 def _assemble(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -359,26 +395,9 @@ def _assemble(
     them under one compiled program). Callers that already hold the degree
     bincounts pass them to skip a recount.
     """
-    nnz = rows.size
-    if row_counts is None:
-        row_counts = (
-            np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
-        )
-    if col_counts is None:
-        col_counts = (
-            np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
-        )
-    assert not nnz or (
-        row_counts.max() <= K and col_counts.max() <= KP
-    ), "pinned paddings smaller than actual degrees"
-    S = routing.valid_size(max(n * K, d * KP, size_floor, 1))
-
-    ell_pos, csc_pos, perm = build_slot_perm(
-        rows, cols, n, d, K, KP, S, row_counts, col_counts
+    ell_pos, csc_pos, plan, plan_inv, S = route_layout(
+        rows, cols, n, d, K, KP, plan_cache, size_floor, row_counts, col_counts
     )
-
-    plan = _build_plan_cached(perm, plan_cache)
-    plan_inv = plan.invert()
 
     ell_values = np.zeros((n, K), dtype=np.float32)
     ell_values.reshape(-1)[ell_pos] = vals

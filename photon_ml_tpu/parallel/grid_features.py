@@ -239,8 +239,10 @@ def grid_from_coo(
 
     if engine == "fused":
         # fused kernels need power-of-two slot groups
-        K = 1 << max(K - 1, 0).bit_length()
-        KP = 1 << max(KP - 1, 0).bit_length()
+        from photon_ml_tpu.ops.fused_perm import _next_pow2
+
+        K = _next_pow2(K)
+        KP = _next_pow2(KP)
 
     structs = []
     for dd in range(n_dd):

@@ -97,12 +97,7 @@ def _benes_stats(feats, weights):
     mn = jnp.min(
         jnp.where(live, feats.csc_values, jnp.inf), axis=1
     )
-    if hot is not None:
-        hlive = (hot != 0) & (weights > 0)[:, None]
-        hmx = jnp.max(jnp.where(hlive, hot, -jnp.inf), axis=0)
-        hmn = jnp.min(jnp.where(hlive, hot, jnp.inf), axis=0)
-        mx = mx.at[feats.hot_cols].max(hmx)
-        mn = mn.at[feats.hot_cols].min(hmn)
+    mn, mx = _fold_hot_minmax(mn, mx, hot, feats.hot_cols, weights)
     return s1, s2, sabs, nnz, mn, mx, wsum
 
 
@@ -126,13 +121,19 @@ def _fused_stats(feats, weights):
         feats.csc_view(jnp.where(live, feats.ell_flat, big)), axis=1
     )
     hot = feats.hot_matrix
-    if hot is not None:
-        hlive = (hot != 0) & (weights > 0)[:, None]
-        hmx = jnp.max(jnp.where(hlive, hot, -jnp.inf), axis=0)
-        hmn = jnp.min(jnp.where(hlive, hot, jnp.inf), axis=0)
-        mx = mx.at[feats.hot_cols].max(hmx)
-        mn = mn.at[feats.hot_cols].min(hmn)
+    mn, mx = _fold_hot_minmax(mn, mx, hot, feats.hot_cols, weights)
     return s1, s2, sabs, nnz, mn, mx, wsum
+
+
+def _fold_hot_minmax(mn, mx, hot, hot_cols, weights):
+    """Fold a hot-column dense side's per-column min/max into (mn, mx) —
+    shared by both permutation engines' stats paths."""
+    if hot is None:
+        return mn, mx
+    hlive = (hot != 0) & (weights > 0)[:, None]
+    hmx = jnp.max(jnp.where(hlive, hot, -jnp.inf), axis=0)
+    hmn = jnp.min(jnp.where(hlive, hot, jnp.inf), axis=0)
+    return mn.at[hot_cols].min(hmn), mx.at[hot_cols].max(hmx)
 
 
 def summarize(data: LabeledData) -> BasicStatisticalSummary:
