@@ -19,9 +19,14 @@ shape must divide the device count; routing prep is one-time host work).
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+# runnable from a fresh checkout without installing the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -37,6 +42,11 @@ def main():
     args = ap.parse_args()
 
     import jax
+
+    # some TPU plugins override JAX_PLATFORMS at import time; an explicit
+    # CPU request must win (same workaround as tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from photon_ml_tpu.evaluation.evaluators import area_under_roc_curve

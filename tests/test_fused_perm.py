@@ -400,3 +400,60 @@ class TestInSolver:
         np.testing.assert_allclose(
             np.asarray(res_fused.w), np.asarray(res_ell.w), atol=5e-3
         )
+
+    @pytest.mark.parametrize("optimizer", ["tron", "owlqn"])
+    def test_tron_owlqn_match_ell(self, rng, interpret_kernels, optimizer):
+        """TRON drives Hessian-vector products (matvec + rmatvec on the
+        direction) and OWL-QN the L1 pseudo-gradient through the fused maps."""
+        from photon_ml_tpu.losses.objective import make_glm_objective
+        from photon_ml_tpu.losses.pointwise import LogisticLoss
+        from photon_ml_tpu.ops.data import LabeledData
+        from photon_ml_tpu.ops.features import from_scipy_like
+        from photon_ml_tpu.opt.config import (
+            GlmOptimizationConfiguration,
+            OptimizerConfig,
+        )
+        from photon_ml_tpu.opt.solve import solve
+
+        n, d = 512, 160
+        rows, cols, vals, dense = _random_coo(rng, n, d, 3500)
+        w_true = rng.standard_normal(d).astype(np.float32) * 0.5
+        y = (rng.random(n) < 1.0 / (1.0 + np.exp(-(dense @ w_true)))).astype(
+            np.float32
+        )
+        if optimizer == "tron":
+            cfg = GlmOptimizationConfiguration(
+                optimizer_config=OptimizerConfig.tron(max_iterations=12),
+                regularization_weight=1.0,
+            )
+            l1 = 0.0
+        else:
+            cfg = GlmOptimizationConfiguration(
+                optimizer_config=OptimizerConfig.lbfgs(max_iterations=30),
+                regularization_weight=1.0,
+            )
+            l1 = 0.5
+        objective = make_glm_objective(LogisticLoss)
+        l2 = jnp.float32(1.0)
+        l1_arg = jnp.float32(l1) if l1 else None
+
+        ell = from_scipy_like(rows, cols, vals, (n, d))
+        res_ell = solve(
+            objective, jnp.zeros(d, jnp.float32),
+            LabeledData.create(ell, jnp.asarray(y)), cfg,
+            l2_weight=l2, l1_weight=l1_arg,
+        )
+        fused = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0, size_floor=128 * 128
+        )
+        res_fused = solve(
+            objective, jnp.zeros(d, jnp.float32),
+            LabeledData.create(fused, jnp.asarray(y)), cfg,
+            l2_weight=l2, l1_weight=l1_arg,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res_fused.w), np.asarray(res_ell.w), atol=5e-3
+        )
+        if l1:
+            # OWL-QN must produce an actually-sparse solution on both engines
+            assert (np.abs(np.asarray(res_fused.w)) < 1e-8).any()
