@@ -435,32 +435,23 @@ def from_ell(ell, plan_cache: Optional[str] = None) -> BenesSparseFeatures:
 
 def _build_plan_cached(perm: np.ndarray, cache_dir: Optional[str]):
     if cache_dir is None:
+        cache_dir = default_plan_cache()
+    if not cache_dir:  # None or "" — disabled
         return routing.build_plan(perm)
     import hashlib
     from pathlib import Path
 
     h = hashlib.sha1(perm.tobytes()).hexdigest()[:16]
-    path = Path(cache_dir) / f"benesplan_{perm.shape[0]}_{h}.npz"
+    # v2: int8 stage indices. Bump on any plan-format or routing change so
+    # stale entries from older code can never be served.
+    path = Path(cache_dir) / f"benesplan_v2_{perm.shape[0]}_{h}.npz"
     if path.exists():
-        data = np.load(path)
-        stages = []
-        i = 0
-        for kind in data["kinds"]:
-            kind = kind.decode() if isinstance(kind, bytes) else str(kind)
-            parts = kind.split(":")
-            if parts[0] == "lane":
-                stages.append(routing.LaneShuffle(idx=data[f"idx{i}"]))
-                i += 1
-            elif parts[0] == "sublane":
-                stages.append(
-                    routing.SublaneShuffle(idx=data[f"idx{i}"], rows=int(parts[1]))
-                )
-                i += 1
-            elif parts[0] == "enter":
-                stages.append(routing.Enter(int(parts[1]), int(parts[2])))
-            else:
-                stages.append(routing.Leave(int(parts[1]), int(parts[2])))
-        return routing.PermPlan(size=int(data["size"]), stages=stages)
+        try:
+            plan = _load_plan_file(path)
+        except Exception:
+            plan = None  # unreadable/foreign entry: rebuild and overwrite
+        if plan is not None:
+            return plan
 
     plan = routing.build_plan(perm)
     arrays = {"size": np.int64(plan.size)}
@@ -469,11 +460,13 @@ def _build_plan_cached(perm: np.ndarray, cache_dir: Optional[str]):
     for st in plan.stages:
         if isinstance(st, routing.LaneShuffle):
             kinds.append("lane")
-            arrays[f"idx{i}"] = st.idx
+            # lane/sublane indices are < 128/8: int8 storage quarters the
+            # on-disk plan (the device uses int8 anyway, permute_net.py)
+            arrays[f"idx{i}"] = st.idx.astype(np.int8)
             i += 1
         elif isinstance(st, routing.SublaneShuffle):
             kinds.append(f"sublane:{st.rows}")
-            arrays[f"idx{i}"] = st.idx
+            arrays[f"idx{i}"] = st.idx.astype(np.int8)
             i += 1
         elif isinstance(st, routing.Enter):
             kinds.append(f"enter:{st.blocks}:{st.rows}")
@@ -481,5 +474,71 @@ def _build_plan_cached(perm: np.ndarray, cache_dir: Optional[str]):
             kinds.append(f"leave:{st.blocks}:{st.rows}")
     arrays["kinds"] = np.array(kinds)
     path.parent.mkdir(parents=True, exist_ok=True)
-    np.savez(path, **arrays)
+    # atomic publish: concurrent builders of the same pattern must never
+    # read a half-written file
+    import os
+    import tempfile as _tf
+
+    fd, tmp = _tf.mkstemp(dir=str(path.parent), suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return plan
+
+
+def _load_plan_file(path) -> routing.PermPlan:
+    data = np.load(path)
+    stages: list = []
+    i = 0
+    for kind in data["kinds"]:
+        kind = kind.decode() if isinstance(kind, bytes) else str(kind)
+        parts = kind.split(":")
+        if parts[0] == "lane":
+            stages.append(routing.LaneShuffle(idx=data[f"idx{i}"]))
+            i += 1
+        elif parts[0] == "sublane":
+            stages.append(
+                routing.SublaneShuffle(idx=data[f"idx{i}"], rows=int(parts[1]))
+            )
+            i += 1
+        elif parts[0] == "enter":
+            stages.append(routing.Enter(int(parts[1]), int(parts[2])))
+        elif parts[0] == "leave":
+            stages.append(routing.Leave(int(parts[1]), int(parts[2])))
+        else:
+            raise ValueError(f"unknown cached stage kind {kind!r}")
+    return routing.PermPlan(size=int(data["size"]), stages=stages)
+
+
+def default_plan_cache() -> Optional[str]:
+    """Default routing-plan cache directory: $PHOTON_ML_TPU_PLAN_CACHE, or a
+    per-uid 0700 tempdir. Set the env var to "" to disable caching. Plans
+    are keyed by the sha1 of the permutation plus a format version; entries
+    that fail to load are rebuilt, so only disk space is at stake (~0.1 GB
+    per distinct large pattern)."""
+    import os
+    import stat
+    import tempfile
+
+    env = os.environ.get("PHOTON_ML_TPU_PLAN_CACHE")
+    if env is not None:
+        return env or None
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    path = os.path.join(tempfile.gettempdir(), f"photon_ml_tpu_plan_cache_{uid}")
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.stat(path)
+        # refuse a directory we don't own or that others can write (a
+        # pre-planted dir in the sticky shared tempdir must not be trusted)
+        if st.st_uid != uid or (st.st_mode & (stat.S_IWGRP | stat.S_IWOTH)):
+            return None
+    except OSError:
+        return None
+    return path

@@ -13,7 +13,10 @@ objective). Algorithm follows Andrew & Gao (2007):
   backtracking sufficient-decrease condition on F = f + l1*||w||_1
   (Breeze's OWLQN uses the same backtracking scheme)
 
-Box constraints are not supported with L1 (same restriction as the reference).
+Box constraints compose with L1 exactly as in the reference: OWLQN.scala:46
+passes the constraint map up to LBFGS.scala:72, which projects the iterate
+into the box after each accepted step; here the projected point's value and
+gradient are recomputed so the curvature pairs stay consistent.
 """
 
 from __future__ import annotations
@@ -25,7 +28,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import OptimizerConfig
-from photon_ml_tpu.opt.lbfgs import two_loop_direction
+from photon_ml_tpu.opt.lbfgs import _project_box, two_loop_direction
 from photon_ml_tpu.opt.state import (
     SolveResult,
     absolute_tolerances,
@@ -72,8 +75,9 @@ def owlqn_solve(
     l1_weight: jax.Array,
     config: OptimizerConfig = OptimizerConfig(),
 ) -> SolveResult:
-    if config.constraint_lower is not None or config.constraint_upper is not None:
-        raise ValueError("box constraints are not supported with L1 (OWL-QN)")
+    has_box = (
+        config.constraint_lower is not None or config.constraint_upper is not None
+    )
     m = config.history_length
     max_iter = config.max_iterations
     dim = w0.shape[-1]
@@ -161,6 +165,15 @@ def owlqn_solve(
         f_new = jnp.where(ls.ok, ls.f_t, s.f)
         g_new = jnp.where(ls.ok, ls.g_t, s.g)
         F_new = jnp.where(ls.ok, ls.F_t, s.F)
+        if has_box:
+            # post-step projection (reference LBFGS.scala:72, inherited by
+            # OWLQN); recompute at the projected point so curvature pairs
+            # and convergence checks see the true state
+            w_new = _project_box(
+                w_new, config.constraint_lower, config.constraint_upper
+            )
+            f_new, g_new = objective.value_and_grad(w_new, data, l2_weight)
+            F_new = f_new + l1 * jnp.sum(jnp.abs(w_new))
 
         s_vec = w_new - s.w
         y_vec = g_new - s.g

@@ -160,6 +160,25 @@ class TestBenesSparseFeatures:
         w = jnp.asarray(rng.standard_normal(shape[1]).astype(np.float32))
         assert np.allclose(b1.matvec(w), b2.matvec(w), atol=1e-6)
 
+    def test_default_plan_cache_env(self, rng, tmp_path, monkeypatch):
+        """plan_cache=None uses $PHOTON_ML_TPU_PLAN_CACHE; "" disables."""
+        rows, cols, vals, shape = self._random_problem(rng, n=128, d=96, k=4)
+        monkeypatch.setenv("PHOTON_ML_TPU_PLAN_CACHE", str(tmp_path))
+        b1 = from_coo(rows, cols, vals, shape)
+        files = list(tmp_path.glob("benesplan_*.npz"))
+        assert len(files) == 1
+        # int8 on-disk stage indices (quartered footprint)
+        data = np.load(files[0])
+        assert data["idx0"].dtype == np.int8
+        b2 = from_coo(rows, cols, vals, shape)  # second build loads the cache
+        w = jnp.asarray(rng.standard_normal(shape[1]).astype(np.float32))
+        assert np.allclose(b1.matvec(w), b2.matvec(w), atol=1e-6)
+
+        monkeypatch.setenv("PHOTON_ML_TPU_PLAN_CACHE", "")
+        from photon_ml_tpu.ops.sparse_perm import default_plan_cache
+
+        assert default_plan_cache() is None
+
     def test_solver_equivalence(self, rng):
         """A full L-BFGS logistic solve must reach the same optimum through
         either sparse engine (reference-parity: same math as

@@ -116,6 +116,27 @@ def test_box_constraints_respected(rng):
     assert float(jnp.max(jnp.abs(res.w))) > 0.1 - 1e-4
 
 
+def test_owlqn_box_constraints(rng):
+    """L1 + box compose (reference OWLQN.scala:46 passes the constraint map
+    to LBFGS.scala:72's post-step projection): iterates stay in the box,
+    some constraint binds, and an inactive box changes nothing."""
+    data, _ = _linreg_problem(rng)
+    obj = make_glm_objective(SquaredLoss)
+    l1 = jnp.float32(0.05)
+    cfg = OptimizerConfig.lbfgs(constraint_lower=-0.1, constraint_upper=0.1)
+    res = owlqn_solve(obj, jnp.zeros(8), data, jnp.float32(0.0), l1, cfg)
+    assert float(jnp.max(res.w)) <= 0.1 + 1e-6
+    assert float(jnp.min(res.w)) >= -0.1 - 1e-6
+    assert float(jnp.max(jnp.abs(res.w))) > 0.1 - 1e-4  # a bound binds
+
+    wide = OptimizerConfig.lbfgs(constraint_lower=-100.0, constraint_upper=100.0)
+    r_wide = owlqn_solve(obj, jnp.zeros(8), data, jnp.float32(0.0), l1, wide)
+    r_free = owlqn_solve(obj, jnp.zeros(8), data, jnp.float32(0.0), l1)
+    np.testing.assert_allclose(
+        np.asarray(r_wide.w), np.asarray(r_free.w), atol=1e-5
+    )
+
+
 def test_vmap_batched_solves(rng):
     """vmap over independent problems == solving each separately — the
     random-effect execution mode (reference RandomEffectCoordinate's
