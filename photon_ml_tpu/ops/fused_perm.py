@@ -561,7 +561,8 @@ def from_coo(
     pin_k: int = 0,
     pin_kp: int = 0,
 ) -> FusedBenesFeatures:
-    """Build from COO triplets; same contract as ``sparse_perm.from_coo``.
+    """Build from COO triplets; same contract as ``sparse_perm.from_coo``
+    (including the default per-uid routing-plan cache — see that docstring).
 
     ``pin_k`` / ``pin_kp`` / ``size_floor`` force common paddings across
     shards of one dataset (the grid builder stacks tiles under one compiled

@@ -156,8 +156,11 @@ def from_coo(
 
     Duplicates are coalesced by summation (scipy COO semantics). The routing
     is the expensive one-time prep step (seconds to ~a minute at 1e7 nnz —
-    the analog of the reference's one-time RDD dataset build); pass
-    ``plan_cache`` (a directory) to memoize it keyed on the sparsity pattern.
+    the analog of the reference's one-time RDD dataset build). It is
+    memoized keyed on the sparsity pattern: by default in a per-uid tempdir
+    (~25 MB-1 GB of .npz per distinct large pattern; set
+    ``PHOTON_ML_TPU_PLAN_CACHE`` to another directory, or to "" to disable),
+    or pass ``plan_cache`` (a directory) explicitly.
 
     Columns with degree > ``hot_col_threshold`` (default: auto — 4x the mean
     column degree, at least 8) are split into a dense MXU side matrix, capped
@@ -490,6 +493,11 @@ def _build_plan_cached(perm: np.ndarray, cache_dir: Optional[str]):
         except OSError:
             pass
         raise
+    # retire the pre-versioning (v1, int32) entry for this pattern, if any
+    try:
+        os.unlink(str(Path(cache_dir) / f"benesplan_{perm.shape[0]}_{h}.npz"))
+    except OSError:
+        pass
     return plan
 
 
@@ -524,21 +532,10 @@ def default_plan_cache() -> Optional[str]:
     that fail to load are rebuilt, so only disk space is at stake (~0.1 GB
     per distinct large pattern)."""
     import os
-    import stat
-    import tempfile
+
+    from photon_ml_tpu.utils.cachedir import per_uid_cache_dir
 
     env = os.environ.get("PHOTON_ML_TPU_PLAN_CACHE")
     if env is not None:
         return env or None
-    uid = os.getuid() if hasattr(os, "getuid") else 0
-    path = os.path.join(tempfile.gettempdir(), f"photon_ml_tpu_plan_cache_{uid}")
-    try:
-        os.makedirs(path, mode=0o700, exist_ok=True)
-        st = os.stat(path)
-        # refuse a directory we don't own or that others can write (a
-        # pre-planted dir in the sticky shared tempdir must not be trusted)
-        if st.st_uid != uid or (st.st_mode & (stat.S_IWGRP | stat.S_IWOTH)):
-            return None
-    except OSError:
-        return None
-    return path
+    return per_uid_cache_dir("photon_ml_tpu_plan_cache")

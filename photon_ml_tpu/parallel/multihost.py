@@ -37,7 +37,14 @@ def initialize_distributed(
     ``jax.devices()``, …) — the CLIs call it first thing. With no arguments
     jax auto-detects cluster environments (TPU pod metadata, Slurm, MPI); a
     plain single machine is not a cluster and stays single-process.
+
+    Also points JAX's persistent compilation cache at the per-uid cache dir
+    (every CLI funnels through here, so repeat runs skip first-compile cost;
+    PHOTON_ML_TPU_COMPILE_CACHE overrides, "" disables).
     """
+    from photon_ml_tpu.utils.cachedir import enable_compilation_cache
+
+    enable_compilation_cache()
     try:
         if jax.distributed.is_initialized():
             return jax.process_count() > 1

@@ -9,6 +9,11 @@ exercised without real hardware.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests must exercise the real routing/compile paths, never a persistent
+# per-uid cache left by an earlier run (a stale-but-correct cached plan
+# would mask routing regressions).
+os.environ["PHOTON_ML_TPU_PLAN_CACHE"] = ""
+os.environ["PHOTON_ML_TPU_COMPILE_CACHE"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

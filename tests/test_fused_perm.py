@@ -133,6 +133,21 @@ class TestFusedKernels:
         assert feats.hot_matrix is not None
         _check_against_dense(feats, dense, rng)
 
+    @pytest.mark.parametrize("c", [2, 4, 8])
+    def test_sublane_base_rows(self, rng, interpret_kernels, c):
+        # S = c*128^2 makes the innermost base kernel's sublane stage use
+        # rows=c (the vectorized per-lane row movement), not the rows=1
+        # identity the other sizes hit
+        n, d = 512, 300
+        rows, cols, vals, dense = _random_coo(rng, n, d, 4000)
+        feats = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0,
+            size_floor=c * 128 * 128,
+        )
+        parsed = parse_plan(feats.plan)
+        assert parsed.base[2] == c
+        _check_against_dense(feats, dense, rng)
+
     def test_two_level_plan(self, rng, interpret_kernels):
         # size_floor pushes S to 128^3: two descents, sublane base, two ascents
         n, d = 512, 256
