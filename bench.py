@@ -218,22 +218,41 @@ def _arm_watchdog(seconds: int = 2700) -> None:
     t.start()
 
 
-def _backend_preflight(timeout_s: int = 300) -> None:
+def _backend_preflight(timeout_s: int = 300, watchdog_s: int = 2700) -> None:
     """Prove the accelerator backend answers at all before building the
-    workload: a wedged device tunnel hangs on first use, and failing in 5
-    minutes beats burning the full watchdog budget."""
-    import os
+    workload: a wedged device tunnel hangs on first use, and failing in
+    minutes beats burning the full watchdog budget. Timeouts (a flapping
+    tunnel) retry while they fit in 40% of the watchdog budget; a child
+    that exits with an error (deterministic breakage) fails immediately
+    with its stderr tail."""
     import subprocess
     import sys
+    import time as _time
 
     code = "import jax, jax.numpy as jnp; jax.block_until_ready(jnp.arange(4).sum())"
-    try:
-        subprocess.run(
-            [sys.executable, "-c", code], timeout=timeout_s,
-            check=True, capture_output=True,
-        )
-    except Exception as e:
-        _emit_failure(f"backend preflight failed: {type(e).__name__}")
+    budget = max(int(0.4 * watchdog_s), timeout_s)
+    attempts = max(1, min(3, (budget + 60) // (timeout_s + 60)))
+    last = "unknown"
+    for attempt in range(attempts):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", code], timeout=timeout_s,
+                check=True, capture_output=True,
+            )
+            return
+        except subprocess.CalledProcessError as e:
+            tail = (e.stderr or b"")[-300:].decode("utf-8", "replace").strip()
+            _emit_failure(f"backend preflight child failed: {tail or e}")
+        except Exception as e:
+            last = type(e).__name__
+            print(
+                f"backend preflight attempt {attempt + 1}/{attempts} "
+                f"failed: {last}",
+                file=sys.stderr,
+            )
+            if attempt + 1 < attempts:
+                _time.sleep(60)
+    _emit_failure(f"backend preflight failed after {attempts} attempts: {last}")
 
 
 def main():
@@ -241,8 +260,11 @@ def main():
 
     import os
 
-    _arm_watchdog(int(os.environ.get("BENCH_WATCHDOG_S", "2700")))
-    _backend_preflight(int(os.environ.get("BENCH_PREFLIGHT_S", "300")))
+    watchdog_s = int(os.environ.get("BENCH_WATCHDOG_S", "2700"))
+    _arm_watchdog(watchdog_s)
+    _backend_preflight(
+        int(os.environ.get("BENCH_PREFLIGHT_S", "300")), watchdog_s
+    )
     fe_np, fe_data, re_np, re_data = _build()
     passes, tpu_time, fe_iters, re_iters = _tpu_run(fe_data, re_data)
 
