@@ -238,7 +238,10 @@ class _Reader:
 
 def _decode(r: _Reader, schema: Any) -> Any:
     if isinstance(schema, list):
-        return _decode(r, schema[r.read_long()])
+        i = r.read_long()
+        if not 0 <= i < len(schema):
+            raise ValueError(f"union branch index {i} out of range")
+        return _decode(r, schema[i])
     t = schema if isinstance(schema, str) else schema["type"]
     if t == "null":
         return None
@@ -257,33 +260,230 @@ def _decode(r: _Reader, schema: Any) -> Any:
     if t == "record":
         return {f["name"]: _decode(r, f["type"]) for f in schema["fields"]}
     if t == "array":
-        out: List[Any] = []
-        while True:
-            n = r.read_long()
-            if n == 0:
-                return out
-            if n < 0:  # block with byte-size prefix
-                n = -n
-                r.read_long()
-            for _ in range(n):
-                out.append(_decode(r, schema["items"]))
+        return _read_blocks(r, lambda rr: _decode(rr, schema["items"]))
     if t == "map":
-        m: Dict[str, Any] = {}
-        while True:
-            n = r.read_long()
-            if n == 0:
-                return m
-            if n < 0:
-                n = -n
-                r.read_long()
-            for _ in range(n):
-                k = _decode(r, "string")
-                m[k] = _decode(r, schema["values"])
+        return dict(
+            _read_blocks(
+                r, lambda rr: (_decode(rr, "string"), _decode(rr, schema["values"]))
+            )
+        )
     if t == "enum":
         return schema["symbols"][r.read_long()]
     if t == "fixed":
         return r.read(schema["size"])
     raise ValueError(f"cannot decode type {t}")
+
+
+# ------------------------------------------------- schema resolution (read)
+
+_PROMOTIONS = {
+    "int": ("long", "float", "double"),
+    "long": ("float", "double"),
+    "float": ("double",),
+    "string": ("bytes",),
+    "bytes": ("string",),
+}
+
+
+def _type_kind(s: Any) -> str:
+    return s if isinstance(s, str) else s["type"]
+
+
+def _names_compatible(w: Any, r: Any) -> bool:
+    wn = w.get("name") if isinstance(w, dict) else None
+    rn = r.get("name") if isinstance(r, dict) else None
+    # unqualified comparison; aliases are not supported
+    if wn is None or rn is None:
+        return True
+    return wn.split(".")[-1] == rn.split(".")[-1]
+
+
+def canonical_form(s: Any) -> Any:
+    """Structural normal form for schema equivalence: strips doc/order/
+    namespace decoration so two spellings of one schema compare equal (and
+    take the fast non-resolving decode path)."""
+    if isinstance(s, list):
+        return [canonical_form(b) for b in s]
+    if isinstance(s, str):
+        return s
+    t = s["type"]
+    out: Dict[str, Any] = {"type": t}
+    if "name" in s:
+        out["name"] = s["name"].split(".")[-1]
+    if t == "record":
+        out["fields"] = [
+            {"name": f["name"], "type": canonical_form(f["type"])}
+            for f in s["fields"]
+        ]
+    elif t == "array":
+        out["items"] = canonical_form(s["items"])
+    elif t == "map":
+        out["values"] = canonical_form(s["values"])
+    elif t == "enum":
+        out["symbols"] = list(s["symbols"])
+    elif t == "fixed":
+        out["size"] = s["size"]
+    return out
+
+
+def _match_reader_branch(writer: Any, reader_union: List[Any]) -> Optional[Any]:
+    wk = _type_kind(writer)
+    for branch in reader_union:
+        rk = _type_kind(branch)
+        if rk == wk and _names_compatible(writer, branch):
+            return branch
+    for branch in reader_union:
+        if _type_kind(branch) in _PROMOTIONS.get(wk, ()):
+            return branch
+    return None
+
+
+def _default_value(schema: Any, default: Any) -> Any:
+    """JSON default -> runtime value (Avro spec: bytes/fixed defaults are
+    codepoint-latin-1 strings; union defaults use the first branch).
+    Containers are copied fresh per call so records never share state."""
+    if isinstance(schema, list):
+        return _default_value(schema[0], default)
+    t = _type_kind(schema)
+    if t in ("bytes", "fixed") and isinstance(default, str):
+        return default.encode("latin-1")
+    if t == "record":
+        out = {}
+        for f in schema["fields"]:
+            if isinstance(default, dict) and f["name"] in default:
+                out[f["name"]] = _default_value(f["type"], default[f["name"]])
+            elif "default" in f:
+                out[f["name"]] = _default_value(f["type"], f["default"])
+            else:
+                raise ValueError(f"record default missing field {f['name']}")
+        return out
+    if t == "array":
+        return [_default_value(schema["items"], v) for v in default]
+    if t == "map":
+        return {k: _default_value(schema["values"], v) for k, v in default.items()}
+    return default
+
+
+def _read_blocks(r: _Reader, item_fn) -> List[Any]:
+    """Shared array block framing: count-prefixed blocks, 0 terminates,
+    negative count carries a discarded byte-size prefix."""
+    out: List[Any] = []
+    while True:
+        n = r.read_long()
+        if n == 0:
+            return out
+        if n < 0:
+            n = -n
+            r.read_long()
+        for _ in range(n):
+            out.append(item_fn(r))
+
+
+def compile_resolver(writer: Any, reader: Any):
+    """Compile (writer schema -> reader schema) resolution into a decode
+    closure ``fn(_Reader) -> value`` (Avro spec 'Schema Resolution': fields
+    matched by name, defaults for reader-only fields, writer-only fields
+    skipped, numeric and string<->bytes promotions, union re-matching).
+    All schema walking happens here, once — not per record."""
+    if isinstance(writer, list):
+        branch_fns = [compile_resolver(b, reader) for b in writer]
+
+        def union_fn(r: _Reader, fns=branch_fns):
+            i = r.read_long()
+            if not 0 <= i < len(fns):
+                raise ValueError(f"union branch index {i} out of range")
+            return fns[i](r)
+
+        return union_fn
+    if isinstance(reader, list):
+        target = _match_reader_branch(writer, reader)
+        if target is None:
+            raise ValueError(
+                f"writer type {_type_kind(writer)!r} matches no reader union branch"
+            )
+        return compile_resolver(writer, target)
+
+    wk, rk = _type_kind(writer), _type_kind(reader)
+    if wk != rk:
+        if rk not in _PROMOTIONS.get(wk, ()):
+            raise ValueError(f"cannot resolve writer {wk!r} to reader {rk!r}")
+        if rk in ("float", "double"):
+            return lambda r: float(_decode(r, writer))
+        if rk == "bytes":
+            return lambda r: _decode(r, writer).encode("utf-8")
+        if rk == "string":
+            return lambda r: _decode(r, writer).decode("utf-8")
+        return lambda r: _decode(r, writer)  # int -> long
+
+    if wk == "record":
+        if not _names_compatible(writer, reader):
+            raise ValueError(
+                f"record name mismatch: {writer.get('name')} vs {reader.get('name')}"
+            )
+        reader_fields = {f["name"]: f for f in reader["fields"]}
+        # ops: (field name to set | None for skip, decode fn)
+        ops = []
+        for wf in writer["fields"]:
+            rf = reader_fields.get(wf["name"])
+            if rf is None:
+                ops.append((None, lambda r, s=wf["type"]: _decode(r, s)))
+            else:
+                ops.append((wf["name"], compile_resolver(wf["type"], rf["type"])))
+        written = {f["name"] for f in writer["fields"]}
+        defaulted = []
+        for rf in reader["fields"]:
+            if rf["name"] not in written:
+                if "default" not in rf:
+                    raise ValueError(
+                        f"reader field {rf['name']!r} absent from writer and "
+                        "has no default"
+                    )
+                defaulted.append((rf["name"], rf["type"], rf["default"]))
+
+        def record_fn(r: _Reader):
+            out: Dict[str, Any] = {}
+            for name, fn in ops:
+                v = fn(r)
+                if name is not None:
+                    out[name] = v
+            for name, ftype, dflt in defaulted:
+                out[name] = _default_value(ftype, dflt)
+            return out
+
+        return record_fn
+    if wk == "array":
+        item = compile_resolver(writer["items"], reader["items"])
+        return lambda r: _read_blocks(r, item)
+    if wk == "map":
+        value = compile_resolver(writer["values"], reader["values"])
+
+        def map_fn(r: _Reader):
+            pairs = _read_blocks(
+                r, lambda rr: (_decode(rr, "string"), value(rr))
+            )
+            return dict(pairs)
+
+        return map_fn
+    if wk == "enum":
+        symbols = list(writer["symbols"])
+        known = set(reader["symbols"])
+
+        def enum_fn(r: _Reader):
+            i = r.read_long()
+            if not 0 <= i < len(symbols):
+                raise ValueError(f"enum index {i} out of range")
+            sym = symbols[i]
+            if sym not in known:
+                raise ValueError(f"enum symbol {sym!r} unknown to reader")
+            return sym
+
+        return enum_fn
+    if wk == "fixed":
+        if writer["size"] != reader["size"]:
+            raise ValueError("fixed size mismatch between writer and reader")
+        size = writer["size"]
+        return lambda r: r.read(size)
+    return lambda r: _decode(r, writer)  # identical primitive
 
 
 # ----------------------------------------------------- object container file
@@ -344,10 +544,11 @@ def read_avro_file(
 ) -> Iterator[Dict[str, Any]]:
     """Iterate records of an Avro object container file.
 
-    Decoding always uses the writer schema embedded in the file (full
-    reader/writer schema resolution is not implemented). A ``schema``
-    argument acts only as an assertion that the file holds the expected
-    record type — a root-name mismatch raises.
+    Decoding uses the writer schema embedded in the file. When a reader
+    ``schema`` is given and differs, records are resolved to it per the
+    Avro spec (fields matched by name, reader-only fields take their
+    defaults, writer-only fields are skipped, numeric and string<->bytes
+    promotions applied); a root-record-name mismatch raises.
     """
     with open(path, "rb") as f:
         data = f.read()
@@ -358,6 +559,7 @@ def read_avro_file(
     writer_schema = AvroSchema(meta["avro.schema"].decode("utf-8"))
     codec = meta.get("avro.codec", b"null").decode("utf-8")
     sync = r.read(SYNC_SIZE)
+    resolve = False
     if schema is not None:
         want = schema.root.get("name") if isinstance(schema.root, dict) else None
         got = (
@@ -365,11 +567,18 @@ def read_avro_file(
             if isinstance(writer_schema.root, dict)
             else None
         )
-        if want is not None and got is not None and want != got:
+        if want is not None and got is not None and want.split(".")[-1] != got.split(".")[-1]:
             raise ValueError(
                 f"{path}: contains {got!r} records, expected {want!r}"
             )
-    use = writer_schema
+        # structural comparison: doc/order/namespace spelling differences
+        # must not force the (slower) resolving path
+        if canonical_form(writer_schema.root) != canonical_form(schema.root):
+            decode_fn = compile_resolver(writer_schema.root, schema.root)
+        else:
+            decode_fn = None
+    else:
+        decode_fn = None
     while r.pos < len(r.buf):
         n = r.read_long()
         size = r.read_long()
@@ -380,7 +589,10 @@ def read_avro_file(
             raise ValueError(f"unsupported codec: {codec}")
         br = _Reader(payload)
         for _ in range(n):
-            yield _decode(br, use.root)
+            if decode_fn is not None:
+                yield decode_fn(br)
+            else:
+                yield _decode(br, writer_schema.root)
         if r.read(SYNC_SIZE) != sync:
             raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
 

@@ -134,6 +134,74 @@ class TestAvroCodec:
         assert data.weights[0] == 0.0
         assert data.weights[1] == 1.0
 
+    def test_schema_resolution_evolved_reader(self, tmp_path):
+        """Avro spec schema resolution: reader with added (defaulted),
+        removed, reordered, and promoted fields reads old files."""
+        writer = AvroSchema({
+            "type": "record", "name": "Rec", "fields": [
+                {"name": "a", "type": "int"},
+                {"name": "gone", "type": "string"},
+                {"name": "b", "type": ["null", "string"], "default": None},
+            ],
+        })
+        reader = AvroSchema({
+            "type": "record", "name": "Rec", "fields": [
+                {"name": "b", "type": ["null", "string"], "default": None},
+                {"name": "a", "type": "double"},              # int -> double
+                {"name": "added", "type": "long", "default": 7},
+                {"name": "tags", "type": {"type": "array", "items": "string"},
+                 "default": []},
+            ],
+        })
+        path = str(tmp_path / "old.avro")
+        write_avro_file(path, writer, [
+            {"a": 3, "gone": "x", "b": "hello"},
+            {"a": -1, "gone": "y", "b": None},
+        ])
+        got = list(read_avro_file(path, reader))
+        assert got[0] == {"b": "hello", "a": 3.0, "added": 7, "tags": []}
+        assert got[1] == {"b": None, "a": -1.0, "added": 7, "tags": []}
+        assert isinstance(got[0]["a"], float)
+        # container defaults must be fresh per record (mutating one record
+        # must not leak into siblings or the schema)
+        got[0]["tags"].append("oops")
+        assert got[1]["tags"] == []
+        assert list(read_avro_file(path, reader))[0]["tags"] == []
+
+        # same schema -> fast path (no resolution), identical result
+        same = list(read_avro_file(path, writer))
+        assert same[0]["gone"] == "x"
+
+    def test_schema_resolution_missing_default_raises(self, tmp_path):
+        writer = AvroSchema({
+            "type": "record", "name": "Rec",
+            "fields": [{"name": "a", "type": "int"}],
+        })
+        reader = AvroSchema({
+            "type": "record", "name": "Rec", "fields": [
+                {"name": "a", "type": "int"},
+                {"name": "required_new", "type": "string"},  # no default
+            ],
+        })
+        path = str(tmp_path / "old.avro")
+        write_avro_file(path, writer, [{"a": 1}])
+        with pytest.raises(ValueError, match="required_new"):
+            list(read_avro_file(path, reader))
+
+    def test_schema_resolution_name_mismatch_raises(self, tmp_path):
+        writer = AvroSchema({
+            "type": "record", "name": "Rec",
+            "fields": [{"name": "a", "type": "int"}],
+        })
+        other = AvroSchema({
+            "type": "record", "name": "Other",
+            "fields": [{"name": "a", "type": "int"}],
+        })
+        path = str(tmp_path / "old.avro")
+        write_avro_file(path, writer, [{"a": 1}])
+        with pytest.raises(ValueError, match="Rec"):
+            list(read_avro_file(path, other))
+
     def test_corrupt_sync_marker_detected(self, tmp_path):
         path = str(tmp_path / "d.avro")
         write_avro_file(path, schemas.scoring_result_schema(),
