@@ -268,7 +268,10 @@ def _decode(r: _Reader, schema: Any) -> Any:
             )
         )
     if t == "enum":
-        return schema["symbols"][r.read_long()]
+        i = r.read_long()
+        if not 0 <= i < len(schema["symbols"]):
+            raise ValueError(f"enum index {i} out of range")
+        return schema["symbols"][i]
     if t == "fixed":
         return r.read(schema["size"])
     raise ValueError(f"cannot decode type {t}")
@@ -361,7 +364,21 @@ def _default_value(schema: Any, default: Any) -> Any:
         return [_default_value(schema["items"], v) for v in default]
     if t == "map":
         return {k: _default_value(schema["values"], v) for k, v in default.items()}
+    if t in ("float", "double"):
+        return float(default)  # int JSON default -> float value
     return default
+
+
+def _default_factory(schema: Any, default: Any):
+    """Compile a zero-arg factory for a reader default: the JSON->runtime
+    conversion happens once here; per record only containers are copied
+    (records must never share mutable state)."""
+    value = _default_value(schema, default)
+    if isinstance(value, (dict, list)):
+        import copy
+
+        return lambda value=value: copy.deepcopy(value)
+    return lambda value=value: value
 
 
 def _read_blocks(r: _Reader, item_fn) -> List[Any]:
@@ -386,7 +403,20 @@ def compile_resolver(writer: Any, reader: Any):
     skipped, numeric and string<->bytes promotions, union re-matching).
     All schema walking happens here, once — not per record."""
     if isinstance(writer, list):
-        branch_fns = [compile_resolver(b, reader) for b in writer]
+        # an unresolvable branch only errors if a datum actually uses it
+        # (the spec errors per-datum; union narrowing is legal evolution)
+        def _branch_fn(b):
+            try:
+                return compile_resolver(b, reader)
+            except ValueError as e:
+                msg = str(e)
+
+                def fail(r: _Reader, msg=msg):
+                    raise ValueError(msg)
+
+                return fail
+
+        branch_fns = [_branch_fn(b) for b in writer]
 
         def union_fn(r: _Reader, fns=branch_fns):
             i = r.read_long()
@@ -438,7 +468,9 @@ def compile_resolver(writer: Any, reader: Any):
                         f"reader field {rf['name']!r} absent from writer and "
                         "has no default"
                     )
-                defaulted.append((rf["name"], rf["type"], rf["default"]))
+                defaulted.append(
+                    (rf["name"], _default_factory(rf["type"], rf["default"]))
+                )
 
         def record_fn(r: _Reader):
             out: Dict[str, Any] = {}
@@ -446,8 +478,8 @@ def compile_resolver(writer: Any, reader: Any):
                 v = fn(r)
                 if name is not None:
                     out[name] = v
-            for name, ftype, dflt in defaulted:
-                out[name] = _default_value(ftype, dflt)
+            for name, make in defaulted:
+                out[name] = make()
             return out
 
         return record_fn
@@ -559,7 +591,6 @@ def read_avro_file(
     writer_schema = AvroSchema(meta["avro.schema"].decode("utf-8"))
     codec = meta.get("avro.codec", b"null").decode("utf-8")
     sync = r.read(SYNC_SIZE)
-    resolve = False
     if schema is not None:
         want = schema.root.get("name") if isinstance(schema.root, dict) else None
         got = (

@@ -247,8 +247,10 @@ def _descend_call(v, idx, B: int, R: int, pro, interpret: bool) -> jax.Array:
             x = _build_input_block(pro, refs[0], None, LANES * u)
         sel = i_ref[...].astype(jnp.int32)
         y = jnp.take_along_axis(x, sel, axis=1)
-        # y row (t*128 + j) lane c -> out[c, t, j]
-        o_ref[...] = y.reshape(u, LANES, LANES).transpose(2, 0, 1)
+        # y row (t*128 + j) lane c -> out[c, t, j]: a single 2-D transpose
+        # ([128u,128] -> [128,128u]) then a minor-dim split — the rank-3
+        # transpose equivalent, expressed in ops Mosaic lowers well
+        o_ref[...] = y.T.reshape(LANES, u, LANES)
 
     if pro is None:
         inputs = [v.reshape(B * R, LANES)]
@@ -281,7 +283,8 @@ def _ascend_call(v3, idx, B: int, R: int, epi, interpret: bool):
 
     def _shuffled(x_ref, i_ref):
         t = x_ref[...]  # [128, u, 128]: t[c, t_, j] = row (g*u+t_)*128+j lane c
-        y = t.transpose(1, 2, 0).reshape(LANES * u, LANES)
+        # minor-dim merge then one 2-D transpose: y[t_*128+j, c] = t[c, t_, j]
+        y = t.reshape(LANES, u * LANES).T
         sel = i_ref[...].astype(jnp.int32)
         return jnp.take_along_axis(y, sel, axis=1)
 

@@ -172,6 +172,27 @@ class TestAvroCodec:
         same = list(read_avro_file(path, writer))
         assert same[0]["gone"] == "x"
 
+    def test_schema_resolution_union_narrowing(self, tmp_path):
+        """Narrowing ['null','string'] -> 'string' reads files whose data
+        never used the removed branch; a datum that does use it raises."""
+        writer = AvroSchema({
+            "type": "record", "name": "Rec", "fields": [
+                {"name": "s", "type": ["null", "string"], "default": None},
+            ],
+        })
+        reader = AvroSchema({
+            "type": "record", "name": "Rec",
+            "fields": [{"name": "s", "type": "string"}],
+        })
+        ok_path = str(tmp_path / "ok.avro")
+        write_avro_file(ok_path, writer, [{"s": "x"}, {"s": "y"}])
+        assert [r["s"] for r in read_avro_file(ok_path, reader)] == ["x", "y"]
+
+        bad_path = str(tmp_path / "bad.avro")
+        write_avro_file(bad_path, writer, [{"s": None}])
+        with pytest.raises(ValueError, match="null"):
+            list(read_avro_file(bad_path, reader))
+
     def test_schema_resolution_missing_default_raises(self, tmp_path):
         writer = AvroSchema({
             "type": "record", "name": "Rec",
