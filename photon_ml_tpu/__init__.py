@@ -23,4 +23,42 @@ from photon_ml_tpu import types
 from photon_ml_tpu.types import TaskType
 
 __version__ = "0.1.0"
-__all__ = ["types", "TaskType", "__version__"]
+
+# The user-facing API re-exported lazily (PEP 562): `from photon_ml_tpu
+# import GameEstimator` works without paying jax-import cost for tools that
+# only want the package version or types.
+_LAZY = {
+    "GameEstimator": "photon_ml_tpu.estimators.game",
+    "FixedEffectCoordinateConfiguration": "photon_ml_tpu.estimators.game",
+    "RandomEffectCoordinateConfiguration": "photon_ml_tpu.estimators.game",
+    "FactoredRandomEffectCoordinateConfiguration": "photon_ml_tpu.estimators.game",
+    "ParallelConfiguration": "photon_ml_tpu.estimators.game",
+    "train_glm": "photon_ml_tpu.estimators.model_training",
+    "GameData": "photon_ml_tpu.data.game_data",
+    "FeatureShard": "photon_ml_tpu.data.game_data",
+    "RandomEffectDataConfiguration": "photon_ml_tpu.data.random_effect",
+    "GlmOptimizationConfiguration": "photon_ml_tpu.opt.config",
+    "OptimizerConfig": "photon_ml_tpu.opt.config",
+    "RegularizationContext": "photon_ml_tpu.opt.config",
+    "RegularizationType": "photon_ml_tpu.opt.config",
+    "NormalizationContext": "photon_ml_tpu.normalization",
+    "NormalizationType": "photon_ml_tpu.normalization",
+    "summarize": "photon_ml_tpu.stat.summary",
+}
+
+__all__ = ["types", "TaskType", "__version__", *sorted(_LAZY)]
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value  # subsequent accesses are plain dict hits
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

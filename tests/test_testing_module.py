@@ -105,3 +105,16 @@ class TestFactories:
         assert scores.shape == (32,)
         assert np.isfinite(scores).all()
         assert np.abs(scores).sum() > 0
+
+
+class TestPublicApi:
+    def test_root_exports_resolve(self):
+        """Every lazily re-exported name on the package root must resolve
+        (a reference user's one-stop import surface)."""
+        import photon_ml_tpu as p
+
+        for name in p._LAZY:
+            assert getattr(p, name) is not None, name
+        assert "GameEstimator" in dir(p)
+        with pytest.raises(AttributeError):
+            p.definitely_not_a_symbol
