@@ -1,0 +1,133 @@
+"""One-shot TPU validation of the fused permutation engine.
+
+Run on a machine with a reachable TPU backend:
+
+    python dev-scripts/tpu_validate_fused.py
+
+Phases:
+1. correctness — fused kernels (real Mosaic lowering, NOT the interpreter)
+   vs the ELL engine on a small problem: matvec / rmatvec / rmatvec_sq and
+   a full L-BFGS solve must agree.
+2. timing — benes vs fused FE solve + per-linear-map timings at bench scale
+   (same shapes as bench.py), so the engine choice in
+   data/game_data.py:sparse_features ("auto") can be confirmed or flipped.
+
+Exit code 0 = fused correct on hardware (timings are informational).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.devices()[0].platform != "cpu", (
+        "this script validates real-TPU lowering; run it on a TPU backend"
+    )
+
+    from photon_ml_tpu.losses.objective import make_glm_objective
+    from photon_ml_tpu.losses.pointwise import LogisticLoss
+    from photon_ml_tpu.ops import fused_perm, sparse_perm
+    from photon_ml_tpu.ops.data import LabeledData
+    from photon_ml_tpu.ops.features import from_scipy_like
+    from photon_ml_tpu.opt.config import (
+        GlmOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.opt.solve import solve
+
+    rng = np.random.default_rng(0)
+
+    # ---- phase 1: correctness on hardware --------------------------------
+    n, d, nnz = 4096, 3000, 60000
+    rows = rng.integers(0, n, nnz)
+    cols = rng.integers(0, d, nnz)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    dense = np.zeros((n, d), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+
+    fused = fused_perm.from_coo(rows, cols, vals, (n, d))
+    assert fused._fused_ok(), "fused path not active on this backend"
+    w = rng.standard_normal(d).astype(np.float32)
+    c = rng.standard_normal(n).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(fused.matvec(jnp.asarray(w))), dense @ w, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(fused.rmatvec(jnp.asarray(c))), dense.T @ c, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(fused.rmatvec_sq(jnp.asarray(c))), (dense * dense).T @ c,
+        atol=2e-3,
+    )
+    print("phase 1a: fused linear maps match dense reference", flush=True)
+
+    objective = make_glm_objective(LogisticLoss)
+    cfg = GlmOptimizationConfiguration(
+        optimizer_config=OptimizerConfig.lbfgs(max_iterations=30),
+        regularization_weight=1.0,
+    )
+    l2 = jnp.float32(1.0)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    ell = from_scipy_like(rows, cols, vals, (n, d))
+    r_ell = solve(objective, jnp.zeros(d, jnp.float32),
+                  LabeledData.create(ell, jnp.asarray(y)), cfg, l2_weight=l2)
+    r_fused = solve(objective, jnp.zeros(d, jnp.float32),
+                    LabeledData.create(fused, jnp.asarray(y)), cfg, l2_weight=l2)
+    dw = float(jnp.max(jnp.abs(r_fused.w - r_ell.w)))
+    print(f"phase 1b: L-BFGS solves agree, max|dw| = {dw:.2e}", flush=True)
+    assert dw < 5e-3
+
+    # ---- phase 2: timings at bench scale ---------------------------------
+    import bench as B
+
+    fe_np, _, re_np, re_data = B._build()
+
+    def t(f, reps=3):
+        r = f()
+        jax.block_until_ready(jax.tree.leaves(r))
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            r = f()
+            jax.block_until_ready(jax.tree.leaves(r))
+            best = min(best, time.perf_counter() - t0)
+        return best, r
+
+    solver = jax.jit(
+        lambda w0, dd: solve(objective, w0, dd,
+                             GlmOptimizationConfiguration(
+                                 optimizer_config=OptimizerConfig.lbfgs(
+                                     max_iterations=50),
+                                 regularization_weight=1.0),
+                             l2_weight=l2)
+    )
+    w0 = jnp.zeros((B.D_FE,), dtype=jnp.float32)
+    for engine in ("benes", "fused"):
+        print(f"building {engine} bench data...", flush=True)
+        dd = B._routed_fe_data(fe_np, engine)
+        st, res = t(lambda dd=dd: solver(w0, dd))
+        it = int(res.iterations)
+        print(f"FE {engine}: {st * 1e3:.0f} ms, {it} iters, "
+              f"{B.N_FE * it / st / 1e6:.1f}M passes/s", flush=True)
+        feats = dd.features
+        mv = jax.jit(feats.matvec)
+        mt, z = t(lambda: mv(w0), reps=5)
+        rmv = jax.jit(feats.rmatvec)
+        rt, _ = t(lambda: rmv(z), reps=5)
+        print(f"   matvec {mt * 1e3:.2f} ms   rmatvec {rt * 1e3:.2f} ms",
+              flush=True)
+    print("VALIDATION OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,7 @@ by block coordinate descent — re-designed for TPU:
 """
 
 from photon_ml_tpu import types
-from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.types import NormalizationType, RegularizationType, TaskType
 
 __version__ = "0.1.0"
 
@@ -40,25 +40,31 @@ _LAZY = {
     "GlmOptimizationConfiguration": "photon_ml_tpu.opt.config",
     "OptimizerConfig": "photon_ml_tpu.opt.config",
     "RegularizationContext": "photon_ml_tpu.opt.config",
-    "RegularizationType": "photon_ml_tpu.opt.config",
     "NormalizationContext": "photon_ml_tpu.normalization",
-    "NormalizationType": "photon_ml_tpu.normalization",
     "summarize": "photon_ml_tpu.stat.summary",
 }
+# lazy submodules (the module object itself is the attribute)
+_LAZY_MODULES = ("testing",)
 
-__all__ = ["types", "TaskType", "__version__", *sorted(_LAZY)]
+__all__ = [
+    "types", "TaskType", "NormalizationType", "RegularizationType",
+    "__version__", *sorted(_LAZY), *_LAZY_MODULES,
+]
 
 
 def __getattr__(name: str):
-    target = _LAZY.get(name)
-    if target is None:
-        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
     import importlib
 
-    value = getattr(importlib.import_module(target), name)
+    if name in _LAZY_MODULES:
+        value = importlib.import_module(f"{__name__}.{name}")
+    else:
+        target = _LAZY.get(name)
+        if target is None:
+            raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+        value = getattr(importlib.import_module(target), name)
     globals()[name] = value  # subsequent accesses are plain dict hits
     return value
 
 
 def __dir__():
-    return sorted(set(globals()) | set(_LAZY))
+    return sorted(set(globals()) | set(_LAZY) | set(_LAZY_MODULES))
