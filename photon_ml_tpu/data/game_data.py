@@ -135,8 +135,9 @@ class GameData:
           speed matvec/rmatvec on TPU, with a one-time host routing cost.
         - "fused" — same routing executed as fused Pallas kernels
           (ops/fused_perm.py): ~3x less HBM traffic per linear map on TPU.
-        - "auto"  — "fused" on a TPU backend when the shard is large enough
-          for the routing prep to pay for itself, else "ell".
+        - "auto"  — on a TPU backend with a shard large enough for the
+          routing prep to pay for itself: "fused" when the one-time
+          lowering probe passes, else "benes"; everywhere else "ell".
         """
         if engine not in ("auto", "ell", "benes", "fused"):
             raise ValueError(
@@ -151,7 +152,12 @@ class GameData:
             import jax
 
             on_tpu = jax.default_backend() == "tpu"
-            engine = "fused" if on_tpu and shard.rows.size >= (1 << 20) else "ell"
+            if on_tpu and shard.rows.size >= (1 << 20):
+                from photon_ml_tpu.ops.fused_perm import fused_engine_works
+
+                engine = "fused" if fused_engine_works() else "benes"
+            else:
+                engine = "ell"
         key = (shard_name, engine)
         if key not in cache:
             if engine in ("benes", "fused"):

@@ -452,6 +452,60 @@ def _next_pow2(x: int) -> int:
     return 1 << max(int(x) - 1, 0).bit_length()
 
 
+def fused_engine_works() -> bool:
+    """One-time probe (cached per process): compile and run a tiny fused
+    matvec/rmatvec on the current backend and check it against dense math.
+    The estimator's "auto" engine choice consults this so a Mosaic lowering
+    regression degrades to the stage-by-stage engine instead of crashing."""
+    global _PROBE_RESULT
+    if _PROBE_RESULT is None:
+        _PROBE_RESULT = _run_probe()
+    return _PROBE_RESULT
+
+
+_PROBE_RESULT: Optional[bool] = None
+
+
+def _run_probe() -> bool:
+    if not pallas_available():
+        return False
+    try:
+        rng = np.random.default_rng(0)
+        n, d, nnz = 256, 200, 2000
+        rows = rng.integers(0, n, nnz)
+        cols = rng.integers(0, d, nnz)
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        dense = np.zeros((n, d), np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        feats = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0,
+            size_floor=LANES * LANES, plan_cache="",
+        )
+        w = rng.standard_normal(d).astype(np.float32)
+        z = np.asarray(jax.jit(feats.matvec)(jnp.asarray(w)))
+        c = rng.standard_normal(n).astype(np.float32)
+        g = np.asarray(jax.jit(feats.rmatvec)(jnp.asarray(c)))
+        ok = np.allclose(z, dense @ w, atol=2e-3) and np.allclose(
+            g, dense.T @ c, atol=2e-3
+        )
+        if not ok:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fused permutation engine probe produced wrong values; "
+                "falling back to the stage-by-stage engine"
+            )
+        return ok
+    except Exception as e:  # pragma: no cover - backend-specific lowering
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "fused permutation engine unavailable on this backend (%s); "
+            "falling back to the stage-by-stage engine", e
+        )
+        return False
+
+
 @struct.dataclass
 class FusedBenesFeatures:
     """Sparse [n, d] matrix with fused Benes-routed linear maps.

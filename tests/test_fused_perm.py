@@ -248,6 +248,49 @@ class TestSummaryStats:
             )
 
 
+class TestAutoEngineProbe:
+    def test_probe_false_without_pallas(self, monkeypatch):
+        from photon_ml_tpu.ops import fused_perm as fp
+
+        monkeypatch.setattr(fp, "pallas_available", lambda: False)
+        monkeypatch.setattr(fp, "_PROBE_RESULT", None)
+        assert fp.fused_engine_works() is False
+
+    @pytest.mark.parametrize("probe_ok,expect", [(True, "fused"), (False, "benes")])
+    def test_auto_falls_back_when_probe_fails(self, monkeypatch, probe_ok, expect):
+        """On a TPU backend, "auto" picks the fused engine only when the
+        lowering probe passes; otherwise the stage-by-stage engine."""
+        import jax
+
+        from photon_ml_tpu.data.game_data import FeatureShard, GameData
+        from photon_ml_tpu.ops import fused_perm as fp, sparse_perm as sp
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(fp, "fused_engine_works", lambda: probe_ok)
+        called = {}
+        monkeypatch.setattr(
+            fp, "from_coo", lambda *a, **k: called.setdefault("engine", "fused")
+        )
+        monkeypatch.setattr(
+            sp, "from_coo", lambda *a, **k: called.setdefault("engine", "benes")
+        )
+        n = 1 << 20
+        data = GameData(
+            labels=np.zeros(4, np.float32),
+            feature_shards={
+                "g": FeatureShard(
+                    rows=np.zeros(n, np.int64), cols=np.zeros(n, np.int64),
+                    vals=np.ones(n, np.float32), dim=8,
+                )
+            },
+            id_tags={},
+            offsets=np.zeros(4, np.float32),
+            weights=np.ones(4, np.float32),
+        )
+        data.sparse_features("g", engine="auto")
+        assert called["engine"] == expect
+
+
 class TestValidators:
     def test_validate_labeled_data_fused_engine(self, rng, interpret_kernels):
         from photon_ml_tpu.data.validators import (
