@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from photon_ml_tpu.utils.nativesort import lexsort_pairs
 from flax import struct
 
 from photon_ml_tpu.projector import ProjectorType, RandomProjectionMatrix
@@ -266,7 +267,7 @@ def build_random_effect_dataset(
     feature_rows = np.asarray(feature_rows, dtype=np.int64)
     feature_cols = np.asarray(feature_cols, dtype=np.int64)
     feature_vals = np.asarray(feature_vals, dtype=np.float32)
-    forder = np.argsort(feature_rows, kind="stable")
+    forder = lexsort_pairs(feature_rows)
     fr, fc, fv = feature_rows[forder], feature_cols[forder], feature_vals[forder]
     row_start = np.searchsorted(fr, np.arange(n))
     row_end = np.searchsorted(fr, np.arange(n) + 1)
@@ -280,7 +281,7 @@ def build_random_effect_dataset(
     if cap is not None:
         perm = np.lexsort((rng.random(n), codes))
     else:
-        perm = np.argsort(codes, kind="stable")
+        perm = lexsort_pairs(codes)
     codes_p = codes[perm]
     ent_start_p = np.searchsorted(codes_p, np.arange(n_ent))
     rank_p = np.arange(n, dtype=np.int64) - ent_start_p[codes_p]

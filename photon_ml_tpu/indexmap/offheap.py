@@ -57,13 +57,11 @@ def _load_native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
-                    check=True,
-                    capture_output=True,
-                )
-            lib = ctypes.CDLL(str(_LIB))
+            from photon_ml_tpu.utils.nativelib import build_and_load
+
+            lib = build_and_load(_SRC, _LIB)
+            if lib is None:
+                raise RuntimeError("native index store unavailable")
             lib.phix_build.restype = ctypes.c_int
             lib.phix_build.argtypes = [
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,

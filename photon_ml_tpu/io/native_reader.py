@@ -61,31 +61,11 @@ def _load_native():
         return _lib
     _lib_tried = True
     try:
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-            # build to a temp name + atomic rename: concurrent builders
-            # (multihost launches, pytest workers) must never CDLL or cache
-            # a half-written .so
-            import os
-            import tempfile
+        from photon_ml_tpu.utils.nativelib import build_and_load
 
-            fd, tmp = tempfile.mkstemp(
-                suffix=".so", dir=str(_NATIVE_DIR), prefix="._avrodecode_"
-            )
-            os.close(fd)
-            try:
-                subprocess.run(
-                    [
-                        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                        "-o", tmp, str(_SRC),
-                    ],
-                    check=True,
-                    capture_output=True,
-                )
-                os.replace(tmp, str(_LIB))
-            finally:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-        lib = ctypes.CDLL(str(_LIB))
+        lib = build_and_load(_SRC, _LIB)
+        if lib is None:
+            raise RuntimeError("native avro decoder unavailable")
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(_c_i32)
         i64p = ctypes.POINTER(_c_i64)

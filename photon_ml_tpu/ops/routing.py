@@ -52,17 +52,10 @@ def _load_native():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    try:
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-            subprocess.run(
-                [
-                    "g++", "-O3", "-shared", "-fPIC", "-pthread",
-                    "-o", str(_LIB), str(_SRC),
-                ],
-                check=True,
-                capture_output=True,
-            )
-        lib = ctypes.CDLL(str(_LIB))
+    from photon_ml_tpu.utils.nativelib import build_and_load
+
+    lib = build_and_load(_SRC, _LIB)
+    if lib is not None:
         lib.euler_color.restype = ctypes.c_int
         lib.euler_color.argtypes = [
             ctypes.c_int64,
@@ -73,10 +66,7 @@ def _load_native():
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32),
         ]
-        _lib = lib
-    except Exception as e:  # pragma: no cover - toolchain-dependent
-        logger.info("eulercolor native build unavailable (%s); numpy fallback", e)
-        _lib = None
+    _lib = lib
     return _lib
 
 

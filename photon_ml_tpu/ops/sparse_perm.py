@@ -40,6 +40,7 @@ import numpy as np
 from flax import struct
 
 from photon_ml_tpu.ops import routing
+from photon_ml_tpu.utils.nativesort import lexsort_pairs
 from photon_ml_tpu.ops.permute_net import DevicePlan, apply_plan, device_plan
 
 
@@ -238,7 +239,7 @@ def coalesce_coo(rows, cols, vals, n: int, d: int):
             raise ValueError(f"row index out of range [0, {n})")
         if cols.min() < 0 or cols.max() >= d:
             raise ValueError(f"column index out of range [0, {d})")
-        order = np.lexsort((cols, rows))
+        order = lexsort_pairs(rows, cols)
         rows, cols, vals = rows[order], cols[order], vals[order]
         boundary = np.empty(rows.size, dtype=bool)
         boundary[0] = True
@@ -323,7 +324,7 @@ def build_slot_perm(
     ell_slot = np.arange(nnz, dtype=np.int64) - row_starts[rows]
     ell_pos = rows * K + ell_slot
 
-    corder = np.lexsort((rows, cols))
+    corder = lexsort_pairs(cols, rows)
     col_starts = np.zeros(d + 1, dtype=np.int64)
     np.cumsum(col_counts, out=col_starts[1:])
     csc_slot = np.arange(nnz, dtype=np.int64) - col_starts[cols[corder]]

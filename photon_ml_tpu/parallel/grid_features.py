@@ -32,6 +32,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.ops import routing
+from photon_ml_tpu.utils.nativesort import lexsort_pairs
 from photon_ml_tpu.ops.features import EllFeatures
 from photon_ml_tpu.ops.sparse_perm import (
     _assemble,
@@ -185,7 +186,7 @@ def grid_from_coo(
     # One sort by (tile id) then slice: O(nnz log nnz) once instead of one
     # full boolean-mask pass per tile (matters at 1e8+ nnz on big grids).
     tile_id = dd_of * n_df + df_of
-    order = np.argsort(tile_id, kind="stable")
+    order = lexsort_pairs(tile_id)
     rows, cols, vals, tile_id = (
         rows[order], cols[order], vals[order], tile_id[order]
     )

@@ -1,0 +1,55 @@
+"""One hardened build-and-load path for the in-tree C++ components.
+
+Every native module (Euler-coloring router, off-heap index store, columnar
+Avro decoder, radix argsort) needs the same thing: compile ``<name>.cpp``
+next to it into ``_<name>.so`` when missing or stale, then ``CDLL`` it.
+Doing that safely requires building to a temp file and atomically renaming
+— concurrent builders (multihost launches, pytest workers) must never CDLL
+or cache a half-written .so. This helper is that pattern, once.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC", "-pthread")
+
+
+def build_and_load(
+    src: Path, lib_path: Path, flags: Sequence[str] = _DEFAULT_FLAGS
+) -> Optional[ctypes.CDLL]:
+    """Compile ``src`` to ``lib_path`` (if missing/stale) and CDLL it.
+
+    Returns None when the toolchain is unavailable or the build fails —
+    callers keep a pure-Python fallback. Never leaves a half-written .so
+    visible at ``lib_path``.
+    """
+    try:
+        if not lib_path.exists() or lib_path.stat().st_mtime < src.stat().st_mtime:
+            fd, tmp = tempfile.mkstemp(
+                suffix=".so", dir=str(lib_path.parent),
+                prefix=f"._{src.stem}_",
+            )
+            os.close(fd)
+            try:
+                subprocess.run(
+                    ["g++", *flags, "-o", tmp, str(src)],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, str(lib_path))
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        return ctypes.CDLL(str(lib_path))
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        logger.info("native build of %s unavailable (%s)", src.name, e)
+        return None

@@ -100,6 +100,24 @@ class TestGoldenRatings:
         fe_only = _train(tmp_path, {"fixed": FIXED}, ["fixed"])
         assert fit.validation_metric < fe_only.validation_metric - 0.3
 
+    def test_fused_engine_same_result(self, tmp_path):
+        """The full GLMix through the fused permutation engine (interpret-
+        mode kernels on CPU) must hit the same golden RMSE gate."""
+        from photon_ml_tpu.ops import fused_perm
+
+        old = fused_perm._INTERPRET
+        fused_perm._INTERPRET = True
+        try:
+            fused = dict(FIXED, sparse_engine="fused")
+            fit = _train(
+                tmp_path,
+                {"fixed": fused, "per_user": PER_USER, "per_movie": PER_MOVIE},
+                ["fixed", "per_user", "per_movie"],
+            )
+        finally:
+            fused_perm._INTERPRET = old
+        assert fit.validation_metric < 0.45  # captured 0.3885 (ELL engine)
+
     def test_standardization_matches_unnormalized(self, tmp_path):
         fit = _train(
             tmp_path,
