@@ -78,8 +78,15 @@ def parse_optimizer_config(cfg: dict) -> GlmOptimizationConfiguration:
     if "constraint_upper" in cfg:
         kw["constraint_upper"] = cfg["constraint_upper"]
     if opt_type is OptimizerType.TRON:
+        for key in ("history_length", "history_dtype"):
+            if key in cfg:
+                raise ValueError(f"{key} applies to LBFGS/OWL-QN, not TRON")
         opt = OptimizerConfig.tron(**kw)
     else:
+        if "history_length" in cfg:
+            kw["history_length"] = int(cfg["history_length"])
+        if "history_dtype" in cfg:
+            kw["history_dtype"] = cfg["history_dtype"]
         opt = OptimizerConfig.lbfgs(**kw)
     reg_type = RegularizationType[cfg.get("regularization", "NONE").upper()]
     reg = RegularizationContext(reg_type, alpha=cfg.get("alpha"))

@@ -73,6 +73,11 @@ class OptimizerConfig:
     # LBFGS
     history_length: int = 10
     max_line_search_iterations: int = 25
+    # Storage dtype for the [m, d] s/y history ring buffers — "bfloat16"
+    # halves the dominant memory term of huge-d solves (SCALING.md: at 1e9
+    # coefficients the m=10 history is 10 GB/chip in f32); all dot products
+    # still accumulate in the working dtype. None = same dtype as w.
+    history_dtype: Optional[str] = None
     # TRON
     max_cg_iterations: int = 20
     cg_tolerance: float = 0.1
@@ -82,6 +87,13 @@ class OptimizerConfig:
     # constraint map; see estimators).
     constraint_lower: Optional[float] = None
     constraint_upper: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.history_dtype not in (None, "float32", "bfloat16"):
+            raise ValueError(
+                f"history_dtype must be None/float32/bfloat16, "
+                f"got {self.history_dtype!r}"
+            )
 
     @classmethod
     def lbfgs(cls, **kw) -> "OptimizerConfig":

@@ -55,13 +55,17 @@ def two_loop_direction(
     """
     m = rho.shape[0]
 
+    # History may be stored bf16 (config.history_dtype); rows are cast to
+    # the working dtype on read so every dot/axpy accumulates full precision.
+    wd = g.dtype
+
     def bwd(i, carry):
         q, alphas = carry
         idx = jnp.mod(count - 1 - i, m)  # newest first
         valid = i < count
         r = jnp.where(valid, rho[idx], 0.0)
-        a = r * jnp.dot(s_hist[idx], q)
-        q = q - a * y_hist[idx]
+        a = r * jnp.dot(s_hist[idx].astype(wd), q)
+        q = q - a * y_hist[idx].astype(wd)
         alphas = alphas.at[idx].set(a)
         return q, alphas
 
@@ -70,8 +74,10 @@ def two_loop_direction(
     # initial Hessian scaling gamma = (s.y)/(y.y) of the newest valid pair
     newest = jnp.mod(count - 1, m)
     have = count > 0
-    sy = jnp.dot(s_hist[newest], y_hist[newest])
-    yy = jnp.dot(y_hist[newest], y_hist[newest])
+    s_new = s_hist[newest].astype(wd)
+    y_new = y_hist[newest].astype(wd)
+    sy = jnp.dot(s_new, y_new)
+    yy = jnp.dot(y_new, y_new)
     gamma = jnp.where(have & (yy > 0), sy / jnp.maximum(yy, 1e-30), 1.0)
     r_vec = gamma * q
 
@@ -79,11 +85,41 @@ def two_loop_direction(
         idx = jnp.mod(count - m + i, m)  # oldest first among the last m
         valid = i >= (m - jnp.minimum(count, m))
         r = jnp.where(valid, rho[idx], 0.0)
-        beta = r * jnp.dot(y_hist[idx], r_vec)
-        return r_vec + jnp.where(valid, (alphas[idx] - beta), 0.0) * s_hist[idx]
+        beta = r * jnp.dot(y_hist[idx].astype(wd), r_vec)
+        return r_vec + jnp.where(valid, (alphas[idx] - beta), 0.0) * s_hist[idx].astype(wd)
 
     r_vec = jax.lax.fori_loop(0, m, fwd, r_vec)
     return -r_vec
+
+
+def resolve_history_dtype(config: OptimizerConfig, working_dtype) -> jnp.dtype:
+    """The storage dtype for s/y ring buffers (config.history_dtype or the
+    working dtype) — shared by L-BFGS and OWL-QN."""
+    return jnp.dtype(config.history_dtype) if config.history_dtype else working_dtype
+
+
+def update_history(
+    s_hist, y_hist, rho, count, s_vec, y_vec
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Curvature-guarded ring-buffer insert (skip when s.y too small),
+    casting the pair to the buffers' storage dtype — shared by L-BFGS and
+    OWL-QN so their history handling cannot diverge."""
+    m = rho.shape[0]
+    sy = jnp.dot(s_vec, y_vec)
+    good_pair = sy > 1e-10 * jnp.maximum(jnp.dot(y_vec, y_vec), 1e-30)
+    slot = jnp.mod(count, m)
+    hdtype = s_hist.dtype
+    s_hist = jnp.where(
+        good_pair, s_hist.at[slot].set(s_vec.astype(hdtype)), s_hist
+    )
+    y_hist = jnp.where(
+        good_pair, y_hist.at[slot].set(y_vec.astype(hdtype)), y_hist
+    )
+    rho = jnp.where(
+        good_pair, rho.at[slot].set(1.0 / jnp.maximum(sy, 1e-30)), rho
+    )
+    count = jnp.where(good_pair, count + 1, count)
+    return s_hist, y_hist, rho, count
 
 
 def _project_box(w: jax.Array, lower, upper) -> jax.Array:
@@ -112,13 +148,14 @@ def lbfgs_solve(
     g0_norm = jnp.linalg.norm(g0)
     abs_f_tol, abs_g_tol = absolute_tolerances(f0, g0_norm, config.tolerance)
 
+    hdtype = resolve_history_dtype(config, dtype)
     history0 = jnp.full((max_iter + 1,), jnp.nan, dtype=dtype).at[0].set(f0)
     init = _LbfgsState(
         w=w0,
         f=f0,
         g=g0,
-        s_hist=jnp.zeros((m, dim), dtype=dtype),
-        y_hist=jnp.zeros((m, dim), dtype=dtype),
+        s_hist=jnp.zeros((m, dim), dtype=hdtype),
+        y_hist=jnp.zeros((m, dim), dtype=hdtype),
         rho=jnp.zeros((m,), dtype=dtype),
         count=jnp.int32(0),
         it=jnp.int32(0),
@@ -161,16 +198,11 @@ def lbfgs_solve(
         else:
             f_new, g_new = ls.f, ls.g
 
-        # History update with curvature guard (skip when s.y too small).
         s_vec = w_new - s.w
         y_vec = g_new - s.g
-        sy = jnp.dot(s_vec, y_vec)
-        good_pair = sy > 1e-10 * jnp.maximum(jnp.dot(y_vec, y_vec), 1e-30)
-        slot = jnp.mod(s.count, m)
-        s_hist = jnp.where(good_pair, s.s_hist.at[slot].set(s_vec), s.s_hist)
-        y_hist = jnp.where(good_pair, s.y_hist.at[slot].set(y_vec), s.y_hist)
-        rho = jnp.where(good_pair, s.rho.at[slot].set(1.0 / jnp.maximum(sy, 1e-30)), s.rho)
-        count = jnp.where(good_pair, s.count + 1, s.count)
+        s_hist, y_hist, rho, count = update_history(
+            s.s_hist, s.y_hist, s.rho, s.count, s_vec, y_vec
+        )
 
         it = s.it + 1
         # Convergence checks (reference Optimizer.scala:131-145). A failed

@@ -28,7 +28,12 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import OptimizerConfig
-from photon_ml_tpu.opt.lbfgs import _project_box, two_loop_direction
+from photon_ml_tpu.opt.lbfgs import (
+    _project_box,
+    resolve_history_dtype,
+    two_loop_direction,
+    update_history,
+)
 from photon_ml_tpu.opt.state import (
     SolveResult,
     absolute_tolerances,
@@ -90,14 +95,15 @@ def owlqn_solve(
     pg0_norm = jnp.linalg.norm(pg0)
     abs_f_tol, abs_g_tol = absolute_tolerances(F0, pg0_norm, config.tolerance)
 
+    hdtype = resolve_history_dtype(config, dtype)
     history0 = jnp.full((max_iter + 1,), jnp.nan, dtype=dtype).at[0].set(F0)
     init = _OwlqnState(
         w=w0,
         f=f0,
         g=g0,
         F=F0,
-        s_hist=jnp.zeros((m, dim), dtype=dtype),
-        y_hist=jnp.zeros((m, dim), dtype=dtype),
+        s_hist=jnp.zeros((m, dim), dtype=hdtype),
+        y_hist=jnp.zeros((m, dim), dtype=hdtype),
         rho=jnp.zeros((m,), dtype=dtype),
         count=jnp.int32(0),
         it=jnp.int32(0),
@@ -177,13 +183,9 @@ def owlqn_solve(
 
         s_vec = w_new - s.w
         y_vec = g_new - s.g
-        sy = jnp.dot(s_vec, y_vec)
-        good_pair = sy > 1e-10 * jnp.maximum(jnp.dot(y_vec, y_vec), 1e-30)
-        slot = jnp.mod(s.count, m)
-        s_hist = jnp.where(good_pair, s.s_hist.at[slot].set(s_vec), s.s_hist)
-        y_hist = jnp.where(good_pair, s.y_hist.at[slot].set(y_vec), s.y_hist)
-        rho = jnp.where(good_pair, s.rho.at[slot].set(1.0 / jnp.maximum(sy, 1e-30)), s.rho)
-        count = jnp.where(good_pair, s.count + 1, s.count)
+        s_hist, y_hist, rho, count = update_history(
+            s.s_hist, s.y_hist, s.rho, s.count, s_vec, y_vec
+        )
 
         it = s.it + 1
         pg_new = pseudo_gradient(w_new, g_new, l1)

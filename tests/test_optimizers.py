@@ -116,6 +116,25 @@ def test_box_constraints_respected(rng):
     assert float(jnp.max(jnp.abs(res.w))) > 0.1 - 1e-4
 
 
+def test_bf16_history_reaches_same_optimum(rng):
+    """bfloat16 s/y history (half the dominant memory term of huge-d
+    solves, SCALING.md) must land on the same optimum within bf16 noise."""
+    data, _ = _logreg_problem(rng)
+    obj = make_glm_objective(LogisticLoss)
+    l2 = jnp.float32(0.5)
+    f32 = lbfgs_solve(obj, jnp.zeros(6), data, l2)
+    cfg = OptimizerConfig.lbfgs(history_dtype="bfloat16")
+    bf16 = lbfgs_solve(obj, jnp.zeros(6), data, l2, cfg)
+    np.testing.assert_allclose(
+        np.asarray(bf16.w), np.asarray(f32.w), rtol=5e-3, atol=5e-3
+    )
+    owl = owlqn_solve(obj, jnp.zeros(6), data, l2, jnp.float32(0.01), cfg)
+    assert np.all(np.isfinite(np.asarray(owl.w)))
+
+    with pytest.raises(ValueError, match="history_dtype"):
+        OptimizerConfig.lbfgs(history_dtype="float64")
+
+
 def test_owlqn_box_constraints(rng):
     """L1 + box compose (reference OWLQN.scala:46 passes the constraint map
     to LBFGS.scala:72's post-step projection): iterates stay in the box,
