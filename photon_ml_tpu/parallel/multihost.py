@@ -97,11 +97,16 @@ def global_batch_from_host_rows(
     """Assemble a globally-sharded batch array from this host's row block.
 
     ``rows`` is the process-local data; ``spec`` a PartitionSpec placing the
-    global batch over ``mesh``. Pass ``global_rows`` (the summed row count
-    over all hosts) whenever hosts may hold unequal counts — round-robin
-    file sharding (:func:`host_shard_files`) generally produces unequal
-    blocks, and without the explicit global shape each process would infer
-    a different one. On one process this is a plain device_put.
+    global batch over ``mesh``. Each process's block must be exactly the
+    slice its own devices address — ``global_rows * local_devices /
+    global_devices`` rows (devices cannot hold rows another host has, and
+    this helper never moves data between hosts). File sharding
+    (:func:`host_shard_files`) generally produces unequal row counts, so
+    input pipelines equalize first: fixed-size per-host batches, with
+    zero-weight padding rows for the remainder (weight-0 rows are exact
+    no-ops in every objective). A too-small/too-large block raises with
+    that instruction rather than tripping deep inside jax. On one process
+    this is a plain device_put.
     """
     from jax.sharding import NamedSharding
 
@@ -111,6 +116,18 @@ def global_batch_from_host_rows(
     global_shape = None
     if global_rows is not None:
         global_shape = (int(global_rows),) + tuple(rows.shape[1:])
-    return jax.make_array_from_process_local_data(
-        sharding, rows, global_shape=global_shape
-    )
+    try:
+        return jax.make_array_from_process_local_data(
+            sharding, rows, global_shape=global_shape
+        )
+    except ValueError as e:
+        # jax's shard-shape validation covers every spec (sharded over any
+        # axis subset, partially sharded, replicated); we add the remedy
+        raise ValueError(
+            f"{e}\nEach host must supply exactly the rows its own devices "
+            "address under the given spec (or the full global batch when "
+            "the batch dimension is replicated); this helper never moves "
+            "rows between hosts. Equalize per-host batches first — pad "
+            "with zero-weight rows (exact no-ops in every objective) or "
+            "trim to the share."
+        ) from None
