@@ -141,16 +141,29 @@ class GridShardedFeatures:
         return out.reshape(-1)
 
 
+def place_global(x, mesh: Mesh, spec: P) -> jax.Array:
+    """Place a host array onto a mesh sharding, working in BOTH runtime
+    models: plain device_put under a single controller, and per-process
+    addressable-shard placement (``make_array_from_callback``) in a
+    multi-process cluster, where device_put cannot reach other hosts'
+    devices. The host array holds the GLOBAL value on every process."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() <= 1:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
 def shard_vector_feat(x: jax.Array, mesh: Mesh) -> jax.Array:
     """Place a [d_pad] vector sharded over the feat axis (replicated over
     data) — the layout for w, grad, and optimizer history rows."""
-    return jax.device_put(x, NamedSharding(mesh, P(FEAT_AXIS)))
+    return place_global(x, mesh, P(FEAT_AXIS))
 
 
 def shard_vector_data(x: jax.Array, mesh: Mesh) -> jax.Array:
     """Place an [n_pad] vector sharded over the data axis (labels, offsets,
     weights, margins)."""
-    return jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS)))
+    return place_global(x, mesh, P(DATA_AXIS))
 
 
 def grid_from_coo(
@@ -245,49 +258,76 @@ def grid_from_coo(
         K = _next_pow2(K)
         KP = _next_pow2(KP)
 
+    # In a multi-process cluster, only build (route!) the tiles whose device
+    # belongs to this process — the expensive per-tile routing is O(local
+    # share), not O(global). Non-addressable grid positions reuse one built
+    # tile as a shape template: their content never reaches any device (the
+    # placement callback only reads addressable blocks). K/KP/h_common come
+    # from the GLOBAL degree loop above, so all processes agree on shapes.
+    multiproc = jax.process_count() > 1
+    if multiproc:
+        pidx = jax.process_index()
+        addressable = {
+            (dd, df)
+            for dd in range(n_dd)
+            for df in range(n_df)
+            if mesh.devices[dd, df].process_index == pidx
+        }
+        if not addressable:
+            addressable = {(0, 0)}  # off-mesh process: one template tile
+    else:
+        addressable = None  # build everything
+
+    def _build_tile(dd, df):
+        tr, tc, tv, hm = tiles_cold[dd, df]
+        hot_ids = tile_hot[dd, df] if h_common else None
+        if engine in ("benes", "fused"):
+            S = routing.valid_size(max(n_loc * K, d_loc * KP, 1))
+            assembler = _assemble
+            if engine == "fused":
+                from photon_ml_tpu.ops import fused_perm
+
+                assembler = fused_perm.assemble
+            return assembler(
+                tr, tc, tv, n_loc, d_loc, K, KP, hm, hot_ids,
+                plan_cache, size_floor=S,
+            )
+        ell = _ell_tile(tr, tc, tv, n_loc, d_loc, K)
+        if h_common:
+            return _EllWithHot(
+                ell=ell,
+                hot_matrix=jnp.asarray(hm),
+                hot_cols=jnp.asarray(hot_ids, dtype=jnp.int32),
+            )
+        return ell
+
+    built = {}
+    if addressable is not None:
+        for pos in sorted(addressable):
+            built[pos] = _build_tile(*pos)
+        template = built[min(built)]
     structs = []
     for dd in range(n_dd):
         row_structs = []
         for df in range(n_df):
-            tr, tc, tv, hm = tiles_cold[dd, df]
-            hot_ids = tile_hot[dd, df] if h_common else None
-            if engine in ("benes", "fused"):
-                S = routing.valid_size(max(n_loc * K, d_loc * KP, 1))
-                assembler = _assemble
-                if engine == "fused":
-                    from photon_ml_tpu.ops import fused_perm
-
-                    assembler = fused_perm.assemble
-                row_structs.append(
-                    assembler(
-                        tr, tc, tv, n_loc, d_loc, K, KP, hm, hot_ids,
-                        plan_cache, size_floor=S,
-                    )
-                )
+            if addressable is None:
+                row_structs.append(_build_tile(dd, df))
             else:
-                ell = _ell_tile(tr, tc, tv, n_loc, d_loc, K)
-                if h_common:
-                    row_structs.append(
-                        _EllWithHot(
-                            ell=ell,
-                            hot_matrix=jnp.asarray(hm),
-                            hot_cols=jnp.asarray(hot_ids, dtype=jnp.int32),
-                        )
-                    )
-                else:
-                    row_structs.append(ell)
+                row_structs.append(built.get((dd, df), template))
         structs.append(row_structs)
 
+    # Stack on HOST (np) so the full global array never materializes on any
+    # device; placement uploads only each process's addressable shards.
     stacked = jax.tree.map(
-        lambda *xs: jnp.stack(xs),
-        *[jax.tree.map(lambda *ys: jnp.stack(ys), *row) for row in structs],
+        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+        *[
+            jax.tree.map(lambda *ys: np.stack([np.asarray(y) for y in ys]), *row)
+            for row in structs
+        ],
     )
     stacked = jax.tree.map(
-        lambda a: jax.device_put(
-            a,
-            NamedSharding(
-                mesh, P(DATA_AXIS, FEAT_AXIS, *([None] * (a.ndim - 2)))
-            ),
+        lambda a: place_global(
+            a, mesh, P(DATA_AXIS, FEAT_AXIS, *([None] * (a.ndim - 2)))
         ),
         stacked,
     )

@@ -24,7 +24,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_cluster_end_to_end():
+def test_two_process_cluster_end_to_end(tmp_path):
     port = _free_port()
     env = {
         k: v
@@ -35,27 +35,31 @@ def test_two_process_cluster_end_to_end():
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
         + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
     )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _WORKER, str(i), "2", str(port)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            env=env,
-            text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
+    # workers write to FILES, not pipes: an undrained pipe's backpressure
+    # would block one worker mid-collective and hang the whole cluster
+    logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    procs = []
+    for i in range(2):
+        with open(logs[i], "w") as fh:
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, _WORKER, str(i), "2", str(port)],
+                    stdout=fh,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                )
+            )
+    timed_out = False
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outs.append(out)
+            p.wait(timeout=240)
     except subprocess.TimeoutExpired:
+        timed_out = True
         for p in procs:
             p.kill()
-        for p in procs[len(outs):]:
-            out, _ = p.communicate()
-            outs.append(out)
+            p.wait()
+    outs = [log.read_text() for log in logs]
+    if timed_out:
         pytest.fail("multi-process cluster timed out:\n" + "\n".join(outs))
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
