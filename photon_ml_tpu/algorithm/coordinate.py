@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.parallel.mesh import fetch_global
+
 from photon_ml_tpu.data.random_effect import RandomEffectDataset
 from photon_ml_tpu.estimators.model_training import train_glm
 from photon_ml_tpu.estimators.random_effect import (
@@ -105,7 +107,7 @@ class FixedEffectCoordinate(Coordinate):
             # the objective stays unbiased.
             sampler = down_sampler_for(self.task, rate)
             weights = sampler.sample_weights(
-                np.asarray(data.labels), np.asarray(data.weights),
+                fetch_global(data.labels), fetch_global(data.weights),
                 seed=self.down_sampling_seed,
             )
             data = data.replace(weights=jnp.asarray(weights))
@@ -170,7 +172,7 @@ class FixedEffectCoordinate(Coordinate):
             w = jnp.asarray(model.coefficients.means)
             if self.num_real_cols is not None and w.shape[0] < self.data.dim:
                 w = jnp.pad(w, (0, self.data.dim - w.shape[0]))
-        scores = np.asarray(self.data.features.matvec(w))
+        scores = fetch_global(self.data.features.matvec(w))
         if self.num_real_rows is not None:
             scores = scores[: self.num_real_rows]
         return scores

@@ -131,11 +131,13 @@ class RandomEffectDataset:
     def update_offsets(self, offsets: np.ndarray) -> "RandomEffectDataset":
         """Rebuild the per-bucket offset blocks from a full-data offset vector
         (the residual trick: Coordinate.updateModel / addScoresToOffsets)."""
+        from photon_ml_tpu.parallel.mesh import fetch_global
+
         offsets = np.asarray(offsets, dtype=np.float32)
         new_buckets = []
         for b in self.buckets:
-            pos = np.asarray(b.sample_pos)
-            wt = np.asarray(b.weights)
+            pos = fetch_global(b.sample_pos)
+            wt = fetch_global(b.weights)
             off = np.where(wt > 0, offsets[pos], 0.0).astype(np.float32)
             new_buckets.append(b.replace(offsets=jnp.asarray(off)))
         return dataclasses.replace(self, buckets=new_buckets)
@@ -555,11 +557,12 @@ def place_dataset(dataset: RandomEffectDataset, mesh, axis_names) -> "RandomEffe
     """Shard every bucket's entity axis over the given mesh axes (replicated
     otherwise). Entity solves are independent, so this is pure data
     parallelism with zero collectives inside the vmap'd solver."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
-    def place(a):
-        spec = P(axis_names, *([None] * (a.ndim - 1)))
-        return jax.device_put(a, NamedSharding(mesh, spec))
+    from photon_ml_tpu.parallel.mesh import place
 
-    new_buckets = [jax.tree.map(place, b) for b in dataset.buckets]
+    def put(a):
+        return place(a, mesh, P(axis_names, *([None] * (a.ndim - 1))))
+
+    new_buckets = [jax.tree.map(put, b) for b in dataset.buckets]
     return dataclasses.replace(dataset, buckets=new_buckets)

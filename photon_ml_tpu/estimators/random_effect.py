@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.parallel.mesh import fetch_global
+
 from photon_ml_tpu.data.random_effect import RandomEffectDataset, ReBucket
 from photon_ml_tpu.losses.objective import make_glm_objective
 from photon_ml_tpu.losses.pointwise import loss_for_task
@@ -144,14 +146,14 @@ def score_random_effects(
     out = np.zeros(dataset.num_rows, dtype=np.float32)
     for b, bucket in enumerate(dataset.buckets):
         w_b = _fit_entity_axis(model.coefficients[b], bucket.num_entities)
-        z = np.asarray(_score_bucket(w_b, bucket))
-        wt = np.asarray(bucket.weights)
-        pos = np.asarray(bucket.sample_pos)
+        z = fetch_global(_score_bucket(w_b, bucket))
+        wt = fetch_global(bucket.weights)
+        pos = fetch_global(bucket.sample_pos)
         mask = wt > 0
         out[pos[mask]] = z[mask]
         p = dataset.passive[b]
         if p is not None:
-            zp = np.asarray(
+            zp = fetch_global(
                 _score_passive(model.coefficients[b], p.X, p.entity_index)
             )
             out[np.asarray(p.sample_pos)] = zp

@@ -14,6 +14,8 @@ from typing import Dict, Optional, Union
 
 import numpy as np
 
+from photon_ml_tpu.parallel.mesh import fetch_global
+
 from photon_ml_tpu.data.game_data import GameData
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
@@ -86,11 +88,15 @@ def _score_factored_re_rows(
     (r, c, v), contrib = v * (B[c] . latent_{entity(r)}); unseen entities
     score 0 (reference FactoredRandomEffectModel scoring via the projected
     RandomEffectModel + projection matrix)."""
+    # model gathers run UNCONDITIONALLY: fetch_global is a cross-process
+    # collective in a multi-host run, and hosts may hold different (even
+    # empty) row shards — a data-dependent skip would deadlock the cluster
+    latent = model.latent
+    B = fetch_global(model.projection_matrix)
+    latents = [fetch_global(c) for c in latent.coefficients]
     out = np.zeros(num_rows, dtype=np.float32)
     if len(shard.rows) == 0:
         return out
-    latent = model.latent
-    B = np.asarray(model.projection_matrix)
     locs = [latent.entity_to_loc.get(str(e)) for e in entity_ids]
     bucket_of_row = np.array([l[0] if l is not None else -1 for l in locs], dtype=np.int64)
     erow_of_row = np.array([l[1] if l is not None else 0 for l in locs], dtype=np.int64)
@@ -102,7 +108,7 @@ def _score_factored_re_rows(
         sel = nz_bucket == b
         if not sel.any():
             continue
-        v_lat = np.asarray(latent.coefficients[b])  # [Eb, k]
+        v_lat = latents[b]  # [Eb, k]
         r = rows[sel]
         contrib = vals[sel] * np.einsum(
             "nk,nk->n", B[cols[sel]], v_lat[erow_of_row[r]]
@@ -122,6 +128,15 @@ def _score_re_rows(
     Features outside the entity's projected space are dropped (reference
     index-map projection semantics).
     """
+    # all model gathers hoisted above data-dependent control flow (see
+    # _score_factored_re_rows: collectives must run on every host)
+    if model.projector_type is ProjectorType.RANDOM:
+        ws = [fetch_global(c) for c in model.coefficients]
+        pidxs = pvals = None
+    else:
+        ws = [fetch_global(c) for c in model.coefficients]
+        pidxs = [fetch_global(p) for p in model.proj_indices]
+        pvals = [fetch_global(p) for p in model.proj_valid]
     out = np.zeros(num_rows, dtype=np.float32)
     if len(shard.rows) == 0:
         return out
@@ -139,13 +154,13 @@ def _score_re_rows(
         # nonzero as v * (B[c] . w_entity). One B regeneration serves every
         # bucket (all buckets share projected_dim).
         uniq_c, inv = np.unique(cols, return_inverse=True)
-        k = np.asarray(model.coefficients[0]).shape[1]
+        k = model.coefficients[0].shape[1]  # global metadata, no fetch
         b_rows = model._back_projection_matrix(k).rows(uniq_c)
         for b in range(len(model.coefficients)):
             sel = nz_bucket == b
             if not sel.any():
                 continue
-            w = np.asarray(model.coefficients[b])  # [Eb, k]
+            w = ws[b]  # [Eb, k]
             r = rows[sel]
             contrib = vals[sel] * np.einsum(
                 "nk,nk->n", b_rows[inv[sel]], w[erow_of_row[r]]
@@ -161,9 +176,9 @@ def _score_re_rows(
         c = cols[sel]
         v = vals[sel]
         e = erow_of_row[r]
-        pidx = np.asarray(model.proj_indices[b])   # [Eb, Db], valid prefix sorted
-        pval = np.asarray(model.proj_valid[b])
-        w = np.asarray(model.coefficients[b])
+        pidx = pidxs[b]  # [Eb, Db], valid prefix sorted
+        pval = pvals[b]
+        w = ws[b]
         Db = pidx.shape[1]
         pe = pidx[e]          # [nnz, Db]
         ve = pval[e]

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.parallel.mesh import fetch_global
+
 from photon_ml_tpu.projector import ProjectorType, RandomProjectionMatrix
 from photon_ml_tpu.types import TaskType
 
@@ -59,19 +61,19 @@ class RandomEffectModel:
         if loc is None:
             return None
         b, e = loc
-        w = np.asarray(self.coefficients[b][e])
+        w = fetch_global(self.coefficients[b][e])
         if self.projector_type is ProjectorType.RANDOM:
             cols, vals = self._back_projection_matrix(w.shape[0]).project_coefficients_back(w)
             return {int(i): float(v) for i, v in zip(cols, vals)}
-        idx = np.asarray(self.proj_indices[b][e])
-        valid = np.asarray(self.proj_valid[b][e])
+        idx = fetch_global(self.proj_indices[b][e])
+        valid = fetch_global(self.proj_valid[b][e])
         return {int(i): float(v) for i, v, ok in zip(idx, w, valid) if ok}
 
     def items(self) -> Iterator[Tuple[str, Dict[int, float]]]:
         """Iterate (entity_id, sparse global coefficients) — export order."""
         b_full = None  # shared across buckets (same seed/global_dim/k)
         for b, ids in enumerate(self.entity_ids):
-            w_b = np.asarray(self.coefficients[b])
+            w_b = fetch_global(self.coefficients[b])
             if self.projector_type is ProjectorType.RANDOM:
                 # regenerate B once per export; back-project the whole bucket
                 # with a single matmul (w_orig = B @ w_proj per entity)
@@ -82,8 +84,8 @@ class RandomEffectModel:
                 for e, eid in enumerate(ids):
                     yield eid, {int(i): float(v) for i, v in enumerate(vals_b[e])}
                 continue
-            idx_b = np.asarray(self.proj_indices[b])
-            val_b = np.asarray(self.proj_valid[b])
+            idx_b = fetch_global(self.proj_indices[b])
+            val_b = fetch_global(self.proj_valid[b])
             for e, eid in enumerate(ids):
                 yield eid, {
                     int(i): float(v)

@@ -89,16 +89,18 @@ class RandomEffectOptimizationTracker:
         """``results`` are vmap'd SolveResults (leading entity axis), one per
         bucket. ``real_counts`` (per bucket) excludes mesh-padding entity
         lanes from the telemetry; None means every lane is a real entity."""
+        from photon_ml_tpu.parallel.mesh import fetch_global
+
         if real_counts is None:
-            real_counts = [np.asarray(res.reason).shape[0] for res in results]
+            real_counts = [res.reason.shape[0] for res in results]
         reasons = [
-            np.asarray(res.reason)[:k] for res, k in zip(results, real_counts)
+            fetch_global(res.reason)[:k] for res, k in zip(results, real_counts)
         ]
         iters = [
-            np.asarray(res.iterations)[:k] for res, k in zip(results, real_counts)
+            fetch_global(res.iterations)[:k] for res, k in zip(results, real_counts)
         ]
         finals = [
-            np.asarray(res.value)[:k] for res, k in zip(results, real_counts)
+            fetch_global(res.value)[:k] for res, k in zip(results, real_counts)
         ]
         reason_all = np.concatenate(reasons) if reasons else np.zeros(0, np.int32)
         iter_all = np.concatenate(iters) if iters else np.zeros(0, np.int32)

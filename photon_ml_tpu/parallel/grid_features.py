@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from photon_ml_tpu.ops import routing
 from photon_ml_tpu.utils.nativesort import lexsort_pairs
@@ -40,7 +40,7 @@ from photon_ml_tpu.ops.sparse_perm import (
     select_hot_cols,
     split_hot_entries,
 )
-from photon_ml_tpu.parallel.mesh import shard_map
+from photon_ml_tpu.parallel.mesh import place as place_global, shard_map
 
 DATA_AXIS = "data"
 FEAT_AXIS = "feat"
@@ -139,19 +139,6 @@ class GridShardedFeatures:
             out_specs=P(DATA_AXIS),
         )(self.shards)
         return out.reshape(-1)
-
-
-def place_global(x, mesh: Mesh, spec: P) -> jax.Array:
-    """Place a host array onto a mesh sharding, working in BOTH runtime
-    models: plain device_put under a single controller, and per-process
-    addressable-shard placement (``make_array_from_callback``) in a
-    multi-process cluster, where device_put cannot reach other hosts'
-    devices. The host array holds the GLOBAL value on every process."""
-    sharding = NamedSharding(mesh, spec)
-    if jax.process_count() <= 1:
-        return jax.device_put(x, sharding)
-    x = np.asarray(x)
-    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
 
 def shard_vector_feat(x: jax.Array, mesh: Mesh) -> jax.Array:

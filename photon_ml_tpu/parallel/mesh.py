@@ -18,6 +18,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -91,24 +93,46 @@ def pad_batch_to_multiple(data: LabeledData, multiple: int) -> LabeledData:
     )
 
 
+def place(x, mesh: Mesh, spec: P):
+    """Place a host-global array onto a mesh sharding, working in BOTH
+    runtime models: plain device_put under a single controller, and
+    per-process addressable-shard placement in a multi-process cluster
+    (device_put cannot reach other hosts' devices there). Every process
+    must hold the same GLOBAL value of ``x``."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() <= 1:
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array):
+        try:
+            if x.sharding.is_equivalent_to(sharding, x.ndim):
+                return x  # already placed (re-placing buckets is common)
+        except Exception:
+            pass
+        x = fetch_global(x)  # may itself span processes
+    else:
+        x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
 def shard_batch(data: LabeledData, mesh: Mesh) -> LabeledData:
     """Place batch-axis arrays sharded over the mesh's data axis; the
     normalization context (feature-axis arrays) is replicated."""
     n_dev = mesh.shape[DATA_AXIS]
     data = pad_batch_to_multiple(data, n_dev)
-    row_sharding = NamedSharding(mesh, P(DATA_AXIS))
-    mat_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
 
     def put_rows(a):
-        return jax.device_put(a, row_sharding)
+        return place(a, mesh, P(DATA_AXIS))
+
+    def put_mat(a):
+        return place(a, mesh, P(DATA_AXIS, None))
 
     feats = data.features
     if isinstance(feats, DenseFeatures):
-        feats = DenseFeatures(matrix=jax.device_put(feats.matrix, mat_sharding))
+        feats = DenseFeatures(matrix=put_mat(feats.matrix))
     else:
         feats = EllFeatures(
-            values=jax.device_put(feats.values, mat_sharding),
-            indices=jax.device_put(feats.indices, mat_sharding),
+            values=put_mat(feats.values),
+            indices=put_mat(feats.indices),
             num_cols=feats.num_cols,
         )
     norm = data.norm
@@ -125,5 +149,30 @@ def shard_batch(data: LabeledData, mesh: Mesh) -> LabeledData:
 
 def replicate(x, mesh: Mesh):
     """Fully replicate a pytree over the mesh."""
-    repl = NamedSharding(mesh, P())
-    return jax.tree.map(lambda a: jax.device_put(a, repl), x)
+    return jax.tree.map(lambda a: place(a, mesh, P()), x)
+
+
+@functools.lru_cache(maxsize=64)
+def _gather_fn(sharding: NamedSharding):
+    """One cached all-gather program per target sharding (a fresh jit per
+    call would retrace + recompile on every fetch)."""
+    return jax.jit(lambda x: x, out_shardings=sharding)
+
+
+def fetch_global(a):
+    """``np.asarray`` for device arrays that may span processes: a sharded
+    global array is all-gathered to a replicated layout first (every shard
+    becomes addressable), then fetched. A plain no-op fetch everywhere else
+    — host numpy code (the coordinate-descent driver's residual algebra)
+    calls this instead of np.asarray.
+
+    In a multi-host run this is a cross-process COLLECTIVE: every process
+    must call it in the same order (never behind data-dependent branches).
+    """
+    if (
+        isinstance(a, jax.Array)
+        and jax.process_count() > 1
+        and not a.is_fully_addressable
+    ):
+        a = _gather_fn(NamedSharding(a.sharding.mesh, P()))(a)
+    return np.asarray(a)
