@@ -16,7 +16,8 @@ port = sys.argv[3]
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={8 // n_procs}"
 ).strip()
 os.environ["PHOTON_ML_TPU_PLAN_CACHE"] = ""
 os.environ["PHOTON_ML_TPU_COMPILE_CACHE"] = ""
@@ -43,7 +44,7 @@ assert jax.process_count() == n_procs
 assert jax.process_index() == proc_id
 n_global = len(jax.devices())
 n_local = len(jax.local_devices())
-assert n_global == 4 * n_procs and n_local == 4, (n_global, n_local)
+assert n_global == 8 and n_local == 8 // n_procs, (n_global, n_local)
 
 # deterministic, disjoint, complete file assignment
 files = [f"part-{i:05d}.avro" for i in range(7)]
@@ -57,19 +58,21 @@ from jax.sharding import PartitionSpec as P
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, data_parallel_mesh
 
 mesh = data_parallel_mesh()  # all global devices
-rows = np.full((12, 3), float(proc_id), dtype=np.float32)
+share = 24 * n_local // n_global  # this process's addressable rows
+rows = np.full((share, 3), float(proc_id), dtype=np.float32)
 garr = global_batch_from_host_rows(
     rows, mesh, P(DATA_AXIS, None), global_rows=24
 )
 assert garr.shape == (24, 3)
 total = float(jax.jit(jnp.sum)(garr))  # cross-process psum via GSPMD
-assert total == 12.0 * 3, total
+expected = 3.0 * share * sum(range(n_procs))  # sum over hosts of id*share
+assert total == expected, (total, expected)
 
 # an unequal block must fail fast with the pad/trim instruction, not trip
 # deep inside jax
 try:
     global_batch_from_host_rows(
-        rows[:8], mesh, P(DATA_AXIS, None), global_rows=24
+        rows[: share - 1], mesh, P(DATA_AXIS, None), global_rows=24
     )
 except ValueError as e:
     assert "zero-weight" in str(e)
@@ -92,12 +95,13 @@ w_true = (rng.standard_normal(d) * 0.7).astype(np.float32)
 y_all = (rng.random(n_procs * n) < 1.0 / (1.0 + np.exp(-(X_all @ w_true)))).astype(
     np.float32
 )
-lo = proc_id * n
+n_share = n_procs * n * n_local // n_global
+lo = proc_id * n_share
 X_g = global_batch_from_host_rows(
-    X_all[lo : lo + n], mesh, P(DATA_AXIS, None), global_rows=n_procs * n
+    X_all[lo : lo + n_share], mesh, P(DATA_AXIS, None), global_rows=n_procs * n
 )
 y_g = global_batch_from_host_rows(
-    y_all[lo : lo + n], mesh, P(DATA_AXIS), global_rows=n_procs * n
+    y_all[lo : lo + n_share], mesh, P(DATA_AXIS), global_rows=n_procs * n
 )
 data = LabeledData.create(DenseFeatures(matrix=X_g), y_g)
 cfg = GlmOptimizationConfiguration(
@@ -134,7 +138,7 @@ gw_true = (rng.standard_normal(dg) * 0.5).astype(np.float32)
 g_y = (rng.random(ng) < 1.0 / (1.0 + np.exp(-(g_dense @ gw_true)))).astype(
     np.float32
 )
-gmesh = grid_mesh(2, 4)  # spans both processes
+gmesh = grid_mesh(2, 4)  # spans every process in the cluster
 gf = grid_from_coo(g_rows, g_cols, g_vals, (ng, dg), gmesh, engine="benes")
 y_pad = np.zeros(gf.num_rows, np.float32)
 y_pad[:ng] = g_y

@@ -1,11 +1,12 @@
-"""REAL multi-process cluster test: two OS processes, one JAX cluster.
+"""REAL multi-process cluster tests: several OS processes, one JAX cluster.
 
 The reference validates distribution on in-process local[4] Spark; the
 virtual-device harness (conftest.py) is this framework's analog. This test
-goes one step further than either: it forms an actual 2-process
-jax.distributed cluster over a local coordinator (the same code path a
-TPU pod or Slurm launch takes, DCN contracts included) and runs the
-multi-host helpers plus a cross-process data-parallel solve end to end.
+goes one step further than either: it forms actual
+jax.distributed clusters over a local coordinator (2x4 and 4x2
+process-by-device layouts — the same code path a TPU pod or Slurm launch
+takes, DCN contracts included) and runs the multi-host helpers plus
+cross-process data-parallel, grid, and GAME-estimator solves end to end.
 """
 
 import os
@@ -24,7 +25,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_cluster_end_to_end(tmp_path):
+@pytest.mark.parametrize("n_procs", [2, 4])
+def test_cluster_end_to_end(tmp_path, n_procs):
     port = _free_port()
     env = {
         k: v
@@ -37,13 +39,13 @@ def test_two_process_cluster_end_to_end(tmp_path):
     )
     # workers write to FILES, not pipes: an undrained pipe's backpressure
     # would block one worker mid-collective and hang the whole cluster
-    logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    logs = [tmp_path / f"worker{i}.log" for i in range(n_procs)]
     procs = []
-    for i in range(2):
+    for i in range(n_procs):
         with open(logs[i], "w") as fh:
             procs.append(
                 subprocess.Popen(
-                    [sys.executable, _WORKER, str(i), "2", str(port)],
+                    [sys.executable, _WORKER, str(i), str(n_procs), str(port)],
                     stdout=fh,
                     stderr=subprocess.STDOUT,
                     env=env,
