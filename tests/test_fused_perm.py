@@ -43,22 +43,24 @@ def _random_coo(rng, n, d, nnz):
     return rows, cols, vals, dense
 
 
-def _check_against_dense(feats, dense, rng, atol=1e-4):
+def _check_against_dense(feats, dense, rng, atol=1e-4, rtol=1e-7):
     n, d = dense.shape
     w = rng.standard_normal(d).astype(np.float32)
     c = rng.standard_normal(n).astype(np.float32)
     np.testing.assert_allclose(
-        np.asarray(feats.matvec(jnp.asarray(w))), dense @ w, atol=atol
+        np.asarray(feats.matvec(jnp.asarray(w))), dense @ w, atol=atol, rtol=rtol
     )
     np.testing.assert_allclose(
-        np.asarray(feats.rmatvec(jnp.asarray(c))), dense.T @ c, atol=atol
+        np.asarray(feats.rmatvec(jnp.asarray(c))), dense.T @ c, atol=atol,
+        rtol=rtol,
     )
     np.testing.assert_allclose(
         np.asarray(feats.rmatvec_sq(jnp.asarray(c))), (dense * dense).T @ c,
-        atol=atol,
+        atol=atol, rtol=rtol,
     )
     np.testing.assert_allclose(
-        np.asarray(feats.row_norms_sq()), (dense * dense).sum(1), atol=atol
+        np.asarray(feats.row_norms_sq()), (dense * dense).sum(1), atol=atol,
+        rtol=rtol,
     )
 
 
@@ -212,6 +214,37 @@ class TestFusedKernels:
             got = np.asarray(fused_execute(dplan, pro, epi, interpret=True))
             want = np.asarray(unfused_execute(dplan, pro, epi))
             np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestPropertyBased:
+    def test_random_problem_shapes(self, interpret_kernels):
+        """Property test across random sparsity patterns, shapes, paddings,
+        and hot-split settings: the fused engine must match dense algebra
+        for every (matvec, rmatvec, rmatvec_sq, row_norms_sq)."""
+        hypothesis = pytest.importorskip("hypothesis")
+        from hypothesis import given, settings, strategies as st
+
+        @settings(max_examples=12, deadline=None)
+        @given(
+            n=st.integers(8, 600),
+            d=st.integers(4, 500),
+            nnz=st.integers(0, 3000),
+            floor_pow=st.sampled_from([0, 128 * 128, 2 * 128 * 128]),
+            hot=st.sampled_from([0, 64]),
+            seed=st.integers(0, 2**31 - 1),
+        )
+        def check(n, d, nnz, floor_pow, hot, seed):
+            rng = np.random.default_rng(seed)
+            rows, cols, vals, dense = _random_coo(rng, n, d, nnz)
+            feats = from_coo(
+                rows, cols, vals, (n, d),
+                max_hot_cols=hot, size_floor=floor_pow,
+            )
+            # high-degree draws accumulate hundreds of fp32 terms; rtol
+            # covers ordering differences that scale with the sums
+            _check_against_dense(feats, dense, rng, atol=5e-4, rtol=1e-4)
+
+        check()
 
 
 class TestSummaryStats:
