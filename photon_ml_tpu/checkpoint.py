@@ -25,6 +25,8 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.parallel.mesh import fetch_global
+
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
@@ -38,9 +40,9 @@ _FORMAT_VERSION = 1
 # ------------------------------------------------------------- serialization
 
 def _save_glm(d: str, m: GeneralizedLinearModel) -> dict:
-    arrays = {"means": np.asarray(m.coefficients.means)}
+    arrays = {"means": fetch_global(m.coefficients.means)}
     if m.coefficients.variances is not None:
-        arrays["variances"] = np.asarray(m.coefficients.variances)
+        arrays["variances"] = fetch_global(m.coefficients.variances)
     np.savez(os.path.join(d, "glm.npz"), **arrays)
     return {"kind": "glm", "task": m.task.name}
 
@@ -59,11 +61,11 @@ def _load_glm(d: str, meta: dict) -> GeneralizedLinearModel:
 def _save_re(d: str, m: RandomEffectModel) -> dict:
     arrays = {}
     for b in range(len(m.coefficients)):
-        arrays[f"coef_{b}"] = np.asarray(m.coefficients[b])
-        arrays[f"idx_{b}"] = np.asarray(m.proj_indices[b])
-        arrays[f"valid_{b}"] = np.asarray(m.proj_valid[b])
+        arrays[f"coef_{b}"] = fetch_global(m.coefficients[b])
+        arrays[f"idx_{b}"] = fetch_global(m.proj_indices[b])
+        arrays[f"valid_{b}"] = fetch_global(m.proj_valid[b])
         if m.variances[b] is not None:
-            arrays[f"var_{b}"] = np.asarray(m.variances[b])
+            arrays[f"var_{b}"] = fetch_global(m.variances[b])
     np.savez(os.path.join(d, "re.npz"), **arrays)
     return {
         "kind": "random_effect",
@@ -108,7 +110,7 @@ def _save_factored(d: str, m) -> dict:
     os.makedirs(latent_dir, exist_ok=True)
     latent_meta = _save_re(latent_dir, m.latent)
     np.savez(os.path.join(d, "projection.npz"),
-             projection_matrix=np.asarray(m.projection_matrix))
+             projection_matrix=fetch_global(m.projection_matrix))
     return {
         "kind": "factored_random_effect",
         "task": m.task.name,
@@ -166,12 +168,12 @@ def model_fingerprint(models: Dict[str, object]) -> Dict[str, list]:
         if isinstance(m, GeneralizedLinearModel):
             out[cid] = ["glm", int(m.dim)]
         elif isinstance(m, RandomEffectModel):
-            out[cid] = ["re"] + [list(np.asarray(c).shape) for c in m.coefficients]
+            out[cid] = ["re"] + [list(c.shape) for c in m.coefficients]
         else:
             out[cid] = [
                 "fre",
-                list(np.asarray(m.projection_matrix).shape),
-            ] + [list(np.asarray(c).shape) for c in m.latent.coefficients]
+                list(m.projection_matrix.shape),
+            ] + [list(c.shape) for c in m.latent.coefficients]
     return out
 
 

@@ -4,9 +4,9 @@ Reference parity: data/avro/ModelProcessingUtils.scala:58 —
 ``saveGameModelsToHDFS`` (:71) / ``loadGameModelFromHDFS`` (:136) with layout
 
     <dir>/model-metadata.json
-    <dir>/fixed-effect/<coordinate>/id-info            (text: featureShardId)
+    <dir>/fixed-effect/<coordinate>/id-info            (featureShardId [+ extra lines])
     <dir>/fixed-effect/<coordinate>/coefficients/part-00000.avro
-    <dir>/random-effect/<coordinate>/id-info           (reType, featureShardId)
+    <dir>/random-effect/<coordinate>/id-info           (reType, featureShardId [+ extra lines])
     <dir>/random-effect/<coordinate>/coefficients/part-*.avro
     <dir>/matrix-factorization/<coordinate>/{rowEffect,colEffect}/part-*.avro
 
@@ -15,6 +15,15 @@ name-term-value triples (nonzeros only), modelClass naming the reference's
 model class for cross-compat. Loading without index maps builds a compact
 index per shard from the scanned features, exactly like the reference
 (:128-133 doc).
+
+This writer appends extra whitespace-separated tokens to id-info beyond the
+reference's fields: ``dim=N`` (the dense dimension — sparse records drop
+zero coefficients, so the reloaded vectors would otherwise shrink) and,
+for no-index-map saves, ``names=positional`` (feature names are original
+integer indices; the loader restores them to those exact positions instead
+of encounter-order renumbering, which would permute coefficients whenever
+any zero was dropped). Readers of the reference format ignore trailing
+tokens; files written by the reference load here as before.
 """
 
 from __future__ import annotations
@@ -26,6 +35,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+from photon_ml_tpu.parallel.mesh import fetch_global
 
 from photon_ml_tpu.indexmap import (
     NAME_TERM_DELIMITER,
@@ -105,7 +116,7 @@ def _glm_record(
 
 
 def _dense_to_sparse(arr) -> Dict[int, float]:
-    a = np.asarray(arr)
+    a = fetch_global(arr)
     (nz,) = np.nonzero(a)
     return {int(i): float(a[i]) for i in nz}
 
@@ -135,7 +146,11 @@ def save_game_model(
             cdir = os.path.join(output_dir, FIXED_EFFECT, cid)
             os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
             with open(os.path.join(cdir, ID_INFO), "w") as f:
-                f.write(meta.feature_shard + "\n")
+                f.write(
+                    meta.feature_shard
+                    + f"\ndim={sub.coefficients.means.shape[0]}\n"
+                    + ("names=positional\n" if imap is None else "")
+                )
             means = _dense_to_sparse(sub.coefficients.means)
             variances = (
                 _dense_to_sparse(sub.coefficients.variances)
@@ -171,11 +186,11 @@ def save_game_model(
 
 
 def _factored_to_effective_re(sub, meta: CoordinateMeta) -> RandomEffectModel:
-    B = np.asarray(sub.projection_matrix)  # [d, k]
+    B = fetch_global(sub.projection_matrix)  # [d, k]
     latent = sub.latent
     entity_coefs: Dict[str, Dict[int, float]] = {}
     for b, ids in enumerate(latent.entity_ids):
-        w_b = np.asarray(latent.coefficients[b])  # [Eb, k]
+        w_b = fetch_global(latent.coefficients[b])  # [Eb, k]
         eff = w_b @ B.T  # [Eb, d]
         for e, eid in enumerate(ids):
             (nz,) = np.nonzero(eff[e])
@@ -194,7 +209,7 @@ def _save_factored_latents(sub, out_dir: str, meta: CoordinateMeta) -> None:
     os.makedirs(row_dir, exist_ok=True)
     records = []
     for b, ids in enumerate(latent.entity_ids):
-        w_b = np.asarray(latent.coefficients[b])
+        w_b = fetch_global(latent.coefficients[b])
         for e, eid in enumerate(ids):
             records.append(
                 {"effectId": str(eid), "latentFactor": [float(v) for v in w_b[e]]}
@@ -207,7 +222,7 @@ def _save_factored_latents(sub, out_dir: str, meta: CoordinateMeta) -> None:
     # The projection matrix B: one latent vector per feature column index.
     col_dir = os.path.join(out_dir, "projection")
     os.makedirs(col_dir, exist_ok=True)
-    B = np.asarray(sub.projection_matrix)
+    B = fetch_global(sub.projection_matrix)
     write_avro_file(
         os.path.join(col_dir, "part-00000.avro"),
         schemas.latent_factor_schema(),
@@ -228,7 +243,11 @@ def _save_random_effect(
 ) -> None:
     os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
     with open(os.path.join(cdir, ID_INFO), "w") as f:
-        f.write(f"{sub.random_effect_type}\n{meta.feature_shard}\n")
+        f.write(
+            f"{sub.random_effect_type}\n{meta.feature_shard}\n"
+            f"dim={sub.global_dim}\n"
+            + ("names=positional\n" if imap is None else "")
+        )
     items = list(sub.items())
     variances = _re_variances(sub)
     num_files = max(1, min(num_files, max(1, len(items))))
@@ -252,9 +271,11 @@ def _re_variances(sub: RandomEffectModel) -> Dict[str, Dict[int, float]]:
     for b, ids in enumerate(sub.entity_ids):
         if sub.variances[b] is None:
             continue
-        var_b = np.asarray(sub.variances[b])
-        idx_b = np.asarray(sub.proj_indices[b])
-        ok_b = np.asarray(sub.proj_valid[b])
+        # the None-check above is host metadata (process-uniform), so these
+        # collectives still run in lockstep on every host
+        var_b = fetch_global(sub.variances[b])
+        idx_b = fetch_global(sub.proj_indices[b])
+        ok_b = fetch_global(sub.proj_valid[b])
         for e, eid in enumerate(ids):
             out[eid] = {
                 int(i): float(v)
@@ -286,11 +307,22 @@ def load_game_model_metadata(models_dir: str) -> dict:
         return json.load(f)
 
 
+class _MapBuilder:
+    """Growing name->index map with an O(1) next-index counter."""
+
+    __slots__ = ("map", "next")
+
+    def __init__(self) -> None:
+        self.map: Dict[str, int] = {}
+        self.next = 0
+
+
 def _record_sparse(
     record: dict,
     field: str,
     imap: Optional[IndexMap],
-    builder: Optional[Dict[str, int]],
+    builder: Optional["_MapBuilder"],
+    positional: bool = False,
 ) -> Dict[int, float]:
     """NameTermValue list → {index: value}; builds a compact index on the
     fly when no map is given (reference load-without-index behavior)."""
@@ -308,9 +340,30 @@ def _record_sparse(
                 continue
         else:
             assert builder is not None
-            idx = builder.setdefault(key, len(builder))
+            if key not in builder.map:
+                if positional:
+                    # names=positional saves name features by original
+                    # index; honor it (encounter-order would permute
+                    # whenever a zero coefficient was dropped)
+                    if ntv["term"] or not key.isdigit():
+                        raise ValueError(
+                            f"positional model has non-numeric feature "
+                            f"name {key!r}"
+                        )
+                    idx_new = int(key)
+                else:
+                    idx_new = builder.next
+                builder.map[key] = idx_new
+                builder.next = max(builder.next, idx_new + 1)
+            idx = builder.map[key]
         out[idx] = float(ntv["value"])
     return out
+
+
+def _note_declared_dim(shard_dims: Dict[str, int], shard: str, tokens) -> None:
+    for t in tokens:
+        if t.startswith("dim="):
+            shard_dims[shard] = max(shard_dims.get(shard, 0), int(t[4:]))
 
 
 def load_game_model(
@@ -322,19 +375,23 @@ def load_game_model(
     task = TaskType[metadata["modelType"]]
     models: Dict[str, object] = {}
     meta: Dict[str, CoordinateMeta] = {}
-    builders: Dict[str, Dict[str, int]] = {}
+    builders: Dict[str, _MapBuilder] = {}
+    shard_dims: Dict[str, int] = {}  # declared dims from id-info files
 
-    def map_for(shard: str) -> Tuple[Optional[IndexMap], Optional[Dict[str, int]]]:
+    def map_for(shard: str) -> Tuple[Optional[IndexMap], Optional[_MapBuilder]]:
         if index_maps is not None and shard in index_maps:
             return index_maps[shard], None
-        return None, builders.setdefault(shard, {})
+        return None, builders.setdefault(shard, _MapBuilder())
 
     fe_dir = os.path.join(models_dir, FIXED_EFFECT)
     if os.path.isdir(fe_dir):
         for cid in sorted(os.listdir(fe_dir)):
             cdir = os.path.join(fe_dir, cid)
             with open(os.path.join(cdir, ID_INFO)) as f:
-                shard = f.read().split()[0]
+                tokens = f.read().split()
+            shard = tokens[0]
+            _note_declared_dim(shard_dims, shard, tokens)
+            positional = "names=positional" in tokens
             imap, builder = map_for(shard)
             records = list(
                 read_avro_dir(os.path.join(cdir, COEFFICIENTS))
@@ -344,8 +401,8 @@ def load_game_model(
                     f"{cid}: expected one fixed-effect GLM, got {len(records)}"
                 )
             rec = records[0]
-            means = _record_sparse(rec, "means", imap, builder)
-            variances = _record_sparse(rec, "variances", imap, builder)
+            means = _record_sparse(rec, "means", imap, builder, positional)
+            variances = _record_sparse(rec, "variances", imap, builder, positional)
             models[cid] = (rec, means, variances or None)
             meta[cid] = CoordinateMeta(feature_shard=shard)
 
@@ -355,14 +412,17 @@ def load_game_model(
         for cid in sorted(os.listdir(re_dir)):
             cdir = os.path.join(re_dir, cid)
             with open(os.path.join(cdir, ID_INFO)) as f:
-                re_type, shard = f.read().split()[:2]
+                tokens = f.read().split()
+            re_type, shard = tokens[:2]
+            _note_declared_dim(shard_dims, shard, tokens)
+            positional = "names=positional" in tokens
             imap, builder = map_for(shard)
             entity_coefs: Dict[str, Dict[int, float]] = {}
             entity_vars: Dict[str, Dict[int, float]] = {}
             for rec in read_avro_dir(os.path.join(cdir, COEFFICIENTS)):
                 eid = rec["modelId"]
-                entity_coefs[eid] = _record_sparse(rec, "means", imap, builder)
-                v = _record_sparse(rec, "variances", imap, builder)
+                entity_coefs[eid] = _record_sparse(rec, "means", imap, builder, positional)
+                v = _record_sparse(rec, "variances", imap, builder, positional)
                 if v:
                     entity_vars[eid] = v
             re_specs[cid] = (re_type, shard, entity_coefs, entity_vars)
@@ -377,13 +437,21 @@ def load_game_model(
     # sharing the shard has been scanned).
     out_maps: Dict[str, IndexMap] = dict(index_maps or {})
     for shard, builder in builders.items():
-        out_maps[shard] = DefaultIndexMap(builder)
+        out_maps[shard] = DefaultIndexMap(builder.map)
+
+    def _shard_dim(shard: str) -> int:
+        built = builders.get(shard)
+        return max(
+            len(out_maps[shard]),
+            built.next if built else 0,
+            shard_dims.get(shard, 0),
+        )
 
     final: Dict[str, object] = {}
     for cid, payload in models.items():
         rec, means, variances = payload
         shard = meta[cid].feature_shard
-        dim = len(out_maps[shard])
+        dim = _shard_dim(shard)
         w = np.zeros(dim, dtype=np.float32)
         for i, v in means.items():
             w[i] = v
@@ -404,7 +472,7 @@ def load_game_model(
             random_effect_type=re_type,
             task=task,
             entity_coefficients=entity_coefs,
-            global_dim=len(out_maps[shard]),
+            global_dim=_shard_dim(shard),
             entity_variances=entity_vars or None,
         )
 

@@ -211,6 +211,26 @@ game_fit = est.fit(game_data)
 g_scores = np.asarray(game_fit.model.score(game_data))
 assert np.all(np.isfinite(g_scores))
 
+# --- model persistence across processes: saving gathers sharded model
+# arrays (collectives); every host writes its own copy and reloads it
+import tempfile
+
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+
+mdir = tempfile.mkdtemp(prefix=f"mp_model_{proc_id}_")
+save_game_model(game_fit.model, mdir)
+reloaded, _ = load_game_model(mdir)
+from photon_ml_tpu.parallel.mesh import fetch_global
+
+fe0 = fetch_global(game_fit.model.models["global"].coefficients.means)
+fe1 = fetch_global(reloaded.models["global"].coefficients.means)
+assert fe0.shape == fe1.shape  # dim survives sparse storage (dim= in id-info)
+assert np.allclose(fe0, fe1, atol=1e-6)
+r_scores = np.asarray(reloaded.score(game_data))
+assert np.allclose(r_scores, g_scores, atol=1e-4), (
+    np.abs(r_scores - g_scores).max()
+)
+
 print(f"worker {proc_id}: cluster {n_procs} procs x {n_local} devices, "
       f"dp solve corr {corr:.3f}, grid solve matches local, "
       f"GAME estimator fit OK", flush=True)

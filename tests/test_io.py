@@ -351,6 +351,36 @@ class TestModelIO:
         )
         return est.fit(data).model, data
 
+    def test_save_load_with_zero_coefficients(self, tmp_path, rng):
+        """Sparse model storage drops zero coefficients; reload must keep
+        both the POSITIONS of the survivors (no-map loads previously
+        renumbered by encounter order, silently permuting whenever any
+        interior coefficient was zero) and the DIMENSION (a trailing zero
+        previously shrank the model)."""
+        from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.models.game import CoordinateMeta, GameModel
+        from photon_ml_tpu.models.glm import GeneralizedLinearModel
+        from photon_ml_tpu.types import TaskType
+        import jax.numpy as jnp
+
+        w = np.array([1.5, 0.0, -2.0, 0.0, 3.0, 0.0], dtype=np.float32)
+        model = GameModel(
+            models={
+                "fixed": GeneralizedLinearModel(
+                    coefficients=Coefficients(means=jnp.asarray(w)),
+                    task=TaskType.LINEAR_REGRESSION,
+                )
+            },
+            meta={"fixed": CoordinateMeta(feature_shard="g")},
+            task=TaskType.LINEAR_REGRESSION,
+        )
+        out = str(tmp_path / "model")
+        save_game_model(model, out)
+        loaded, _ = load_game_model(out)
+        got = np.asarray(loaded.models["fixed"].coefficients.means)
+        np.testing.assert_array_equal(got, w)  # positions AND dim preserved
+
     def test_save_load_scoring_equivalence(self, tmp_path, rng):
         from photon_ml_tpu.io.model_io import (
             load_game_model,
