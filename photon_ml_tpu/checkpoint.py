@@ -39,11 +39,12 @@ _FORMAT_VERSION = 1
 
 # ------------------------------------------------------------- serialization
 
-def _save_glm(d: str, m: GeneralizedLinearModel) -> dict:
+def _save_glm(d: Optional[str], m: GeneralizedLinearModel) -> dict:
     arrays = {"means": fetch_global(m.coefficients.means)}
     if m.coefficients.variances is not None:
         arrays["variances"] = fetch_global(m.coefficients.variances)
-    np.savez(os.path.join(d, "glm.npz"), **arrays)
+    if d is not None:
+        np.savez(os.path.join(d, "glm.npz"), **arrays)
     return {"kind": "glm", "task": m.task.name}
 
 
@@ -58,7 +59,7 @@ def _load_glm(d: str, meta: dict) -> GeneralizedLinearModel:
     )
 
 
-def _save_re(d: str, m: RandomEffectModel) -> dict:
+def _save_re(d: Optional[str], m: RandomEffectModel) -> dict:
     arrays = {}
     for b in range(len(m.coefficients)):
         arrays[f"coef_{b}"] = fetch_global(m.coefficients[b])
@@ -66,7 +67,8 @@ def _save_re(d: str, m: RandomEffectModel) -> dict:
         arrays[f"valid_{b}"] = fetch_global(m.proj_valid[b])
         if m.variances[b] is not None:
             arrays[f"var_{b}"] = fetch_global(m.variances[b])
-    np.savez(os.path.join(d, "re.npz"), **arrays)
+    if d is not None:
+        np.savez(os.path.join(d, "re.npz"), **arrays)
     return {
         "kind": "random_effect",
         "task": m.task.name,
@@ -105,12 +107,15 @@ def _load_re(d: str, meta: dict) -> RandomEffectModel:
     )
 
 
-def _save_factored(d: str, m) -> dict:
-    latent_dir = os.path.join(d, "latent")
-    os.makedirs(latent_dir, exist_ok=True)
+def _save_factored(d: Optional[str], m) -> dict:
+    latent_dir = None
+    if d is not None:
+        latent_dir = os.path.join(d, "latent")
+        os.makedirs(latent_dir, exist_ok=True)
     latent_meta = _save_re(latent_dir, m.latent)
-    np.savez(os.path.join(d, "projection.npz"),
-             projection_matrix=fetch_global(m.projection_matrix))
+    B = fetch_global(m.projection_matrix)
+    if d is not None:
+        np.savez(os.path.join(d, "projection.npz"), projection_matrix=B)
     return {
         "kind": "factored_random_effect",
         "task": m.task.name,
@@ -134,12 +139,13 @@ def _load_factored(d: str, meta: dict):
     )
 
 
-def _save_submodel(d: str, model) -> dict:
+def _save_submodel(d: Optional[str], model) -> dict:
     from photon_ml_tpu.algorithm.factored_random_effect import (
         FactoredRandomEffectModel,
     )
 
-    os.makedirs(d, exist_ok=True)
+    if d is not None:
+        os.makedirs(d, exist_ok=True)
     if isinstance(model, GeneralizedLinearModel):
         return _save_glm(d, model)
     if isinstance(model, RandomEffectModel):
@@ -186,7 +192,20 @@ def save_training_checkpoint(
     best_models: Optional[Dict[str, object]] = None,
 ) -> None:
     """Atomically write a checkpoint: build in a tmp sibling dir, fsync the
-    state file, then rename over the target (crash-safe)."""
+    state file, then rename over the target (crash-safe).
+
+    Multi-host: sharded model arrays are gathered on EVERY process (the
+    gathers are collectives), but only process 0 writes files; other
+    processes return after the gathers."""
+    import jax
+
+    write = jax.process_index() == 0
+    if not write:
+        for model in models.values():
+            _save_submodel(None, model)  # run the gather collectives only
+        for model in (best_models or {}).values():
+            _save_submodel(None, model)
+        return
     parent = os.path.dirname(os.path.abspath(directory)) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=parent)

@@ -35,6 +35,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         prog="photon-ml-tpu score-game", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
+    from photon_ml_tpu.parallel.multihost import add_distributed_args
+
+    add_distributed_args(p)
     p.add_argument("--data-dirs", nargs="+", required=True)
     p.add_argument("--model-dir", required=True)
     p.add_argument("--output-dir", required=True)
@@ -93,23 +96,28 @@ def run(args: argparse.Namespace) -> Optional[float]:
     with timer.time("score"):
         scores = model.score(data) + data.offsets
 
+    import jax
+
     with timer.time("save scores"):
-        n = save_scores(
-            args.output_dir,
-            (
-                ScoredItem(
-                    prediction_score=float(s),
-                    label=None if np.isnan(l) else float(l),
-                    weight=float(w),
-                    uid=uid,
-                    id_tags={t: str(data.id_tags[t][i]) for t in id_tags},
-                )
-                for i, (s, l, w, uid) in enumerate(
-                    zip(scores, data.labels, data.weights, uids)
-                )
-            ),
-            model_id=model_id,
-        )
+        if jax.process_index() != 0:
+            n = 0  # single writer on shared filesystems
+        else:
+            n = save_scores(
+                args.output_dir,
+                (
+                    ScoredItem(
+                        prediction_score=float(s),
+                        label=None if np.isnan(l) else float(l),
+                        weight=float(w),
+                        uid=uid,
+                        id_tags={t: str(data.id_tags[t][i]) for t in id_tags},
+                    )
+                    for i, (s, l, w, uid) in enumerate(
+                        zip(scores, data.labels, data.weights, uids)
+                    )
+                ),
+                model_id=model_id,
+            )
     logger.info("saved %d scores to %s", n, args.output_dir)
 
     metric = None
@@ -131,10 +139,12 @@ def run(args: argparse.Namespace) -> Optional[float]:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    from photon_ml_tpu.parallel.multihost import initialize_distributed
+    from photon_ml_tpu.parallel.multihost import initialize_from_args
 
-    initialize_distributed()  # no-op single-process; must precede jax use
-    run(parse_args(argv))
+    args = parse_args(argv)
+    # cluster join (or single-process no-op) must precede any jax device use
+    initialize_from_args(args)
+    run(args)
     return 0
 
 

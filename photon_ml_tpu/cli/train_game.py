@@ -56,6 +56,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         prog="photon-ml-tpu train-game", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
+    from photon_ml_tpu.parallel.multihost import add_distributed_args
+
+    add_distributed_args(p)
     p.add_argument("--train-data-dirs", nargs="+", required=True)
     p.add_argument("--validation-data-dirs", nargs="*", default=[])
     p.add_argument("--train-date-range", default=None,
@@ -131,6 +134,10 @@ def _make_evaluator(spec: Optional[str], task: TaskType, data):
 
 def _save_feature_stats(output_dir, shard, summary, index_map) -> None:
     """writeBasicStatistics parity (ModelProcessingUtils.scala:560)."""
+    import jax
+
+    if jax.process_index() != 0:
+        return  # single writer on shared filesystems
     stats_dir = os.path.join(output_dir, "feature-stats", shard)
     os.makedirs(stats_dir, exist_ok=True)
     mean = np.asarray(summary.mean)
@@ -371,10 +378,12 @@ def run(args: argparse.Namespace) -> GameFit:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    from photon_ml_tpu.parallel.multihost import initialize_distributed
+    from photon_ml_tpu.parallel.multihost import initialize_from_args
 
-    initialize_distributed()  # no-op single-process; must precede jax use
-    run(parse_args(argv))
+    args = parse_args(argv)
+    # cluster join (or single-process no-op) must precede any jax device use
+    initialize_from_args(args)
+    run(args)
     return 0
 
 

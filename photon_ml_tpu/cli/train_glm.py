@@ -51,6 +51,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         prog="photon-ml-tpu train-glm", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
+    from photon_ml_tpu.parallel.multihost import add_distributed_args
+
+    add_distributed_args(p)
     p.add_argument("--training-data-dirs", nargs="+", required=True)
     p.add_argument("--validation-data-dirs", nargs="*", default=[])
     p.add_argument("--task", required=True, choices=[t.name for t in TaskType])
@@ -256,46 +259,50 @@ def run(args: argparse.Namespace) -> dict:
         else:
             best_lambda = fits[0].regularization_weight
 
+        import jax
+
+        write_outputs = jax.process_index() == 0  # single writer on shared FS
         with timer.time("output"):
-            os.makedirs(args.output_dir, exist_ok=True)
-            for fit in fits:
-                _write_model_text(
-                    os.path.join(
-                        args.output_dir, f"model-lambda-{fit.regularization_weight:g}.txt"
-                    ),
-                    fit.model.coefficients.means,
-                    fit.model.coefficients.variances,
-                    imap,
+            if write_outputs:
+                os.makedirs(args.output_dir, exist_ok=True)
+                for fit in fits:
+                    _write_model_text(
+                        os.path.join(
+                            args.output_dir, f"model-lambda-{fit.regularization_weight:g}.txt"
+                        ),
+                        fit.model.coefficients.means,
+                        fit.model.coefficients.variances,
+                        imap,
+                    )
+                best = next(f for f in fits if f.regularization_weight == best_lambda)
+                means = np.asarray(best.model.coefficients.means)
+                ntv = []
+                for i in np.flatnonzero(means):
+                    key = imap.get_feature_name(int(i)) or str(i)
+                    name, _, term = key.partition(NAME_TERM_DELIMITER)
+                    ntv.append({"name": name, "term": term, "value": float(means[i])})
+                record = {
+                    "modelId": "best",
+                    "modelClass": None,
+                    "means": ntv,
+                    "variances": None,
+                    "lossFunction": None,
+                }
+                write_avro_file(
+                    os.path.join(args.output_dir, "best-model.avro"),
+                    schemas.bayesian_linear_model_schema(),
+                    [record],
                 )
-            best = next(f for f in fits if f.regularization_weight == best_lambda)
-            means = np.asarray(best.model.coefficients.means)
-            ntv = []
-            for i in np.flatnonzero(means):
-                key = imap.get_feature_name(int(i)) or str(i)
-                name, _, term = key.partition(NAME_TERM_DELIMITER)
-                ntv.append({"name": name, "term": term, "value": float(means[i])})
-            record = {
-                "modelId": "best",
-                "modelClass": None,
-                "means": ntv,
-                "variances": None,
-                "lossFunction": None,
-            }
-            write_avro_file(
-                os.path.join(args.output_dir, "best-model.avro"),
-                schemas.bayesian_linear_model_schema(),
-                [record],
-            )
-            with open(os.path.join(args.output_dir, "selection.json"), "w") as f:
-                json.dump(
-                    {
-                        "best_lambda": best_lambda,
-                        "metrics": {str(k): v for k, v in metrics.items()},
-                        "evaluator": evaluator.name,
-                    },
-                    f, indent=2,
-                )
-        if args.diagnostic_mode == "ALL":
+                with open(os.path.join(args.output_dir, "selection.json"), "w") as f:
+                    json.dump(
+                        {
+                            "best_lambda": best_lambda,
+                            "metrics": {str(k): v for k, v in metrics.items()},
+                            "evaluator": evaluator.name,
+                        },
+                        f, indent=2,
+                    )
+        if args.diagnostic_mode == "ALL" and write_outputs:
             with timer.time("diagnose"):
                 _diagnose(
                     args, task, data, labeled, fits, best_lambda, imap,
@@ -385,10 +392,12 @@ def _diagnose(
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    from photon_ml_tpu.parallel.multihost import initialize_distributed
+    from photon_ml_tpu.parallel.multihost import initialize_from_args
 
-    initialize_distributed()  # no-op single-process; must precede jax use
-    run(parse_args(argv))
+    args = parse_args(argv)
+    # cluster join (or single-process no-op) must precede any jax device use
+    initialize_from_args(args)
+    run(args)
     return 0
 
 

@@ -128,13 +128,26 @@ def save_game_model(
     model_name: str = "photon-ml-tpu",
     configurations: Optional[dict] = None,
     num_output_files_per_random_effect: int = 1,
+    write: Optional[bool] = None,
 ) -> None:
-    """Write a GAME model directory (see module docstring for layout)."""
-    os.makedirs(output_dir, exist_ok=True)
-    save_game_model_metadata(
-        output_dir, model.task, model_name=model_name,
-        configurations=configurations,
-    )
+    """Write a GAME model directory (see module docstring for layout).
+
+    Multi-host: sharded model arrays are gathered on EVERY process (the
+    gathers are collectives), but by default only process 0 writes files —
+    ``write`` overrides (e.g. True for per-host local-disk copies). Callers
+    in a cluster should barrier (``multihost.barrier``) before reading the
+    saved model from another process.
+    """
+    import jax
+
+    if write is None:
+        write = jax.process_index() == 0
+    if write:
+        os.makedirs(output_dir, exist_ok=True)
+        save_game_model_metadata(
+            output_dir, model.task, model_name=model_name,
+            configurations=configurations,
+        )
     from photon_ml_tpu.algorithm.factored_random_effect import (
         FactoredRandomEffectModel,
     )
@@ -144,28 +157,30 @@ def save_game_model(
         imap = (index_maps or {}).get(meta.feature_shard)
         if isinstance(sub, GeneralizedLinearModel):
             cdir = os.path.join(output_dir, FIXED_EFFECT, cid)
-            os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
-            with open(os.path.join(cdir, ID_INFO), "w") as f:
-                f.write(
-                    meta.feature_shard
-                    + f"\ndim={sub.coefficients.means.shape[0]}\n"
-                    + ("names=positional\n" if imap is None else "")
-                )
             means = _dense_to_sparse(sub.coefficients.means)
             variances = (
                 _dense_to_sparse(sub.coefficients.variances)
                 if sub.coefficients.variances is not None
                 else None
             )
-            write_avro_file(
-                os.path.join(cdir, COEFFICIENTS, "part-00000.avro"),
-                schemas.bayesian_linear_model_schema(),
-                [_glm_record(cid, model.task, means, variances, imap)],
-            )
+            if write:
+                os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
+                with open(os.path.join(cdir, ID_INFO), "w") as f:
+                    f.write(
+                        meta.feature_shard
+                        + f"\ndim={sub.coefficients.means.shape[0]}\n"
+                        + ("names=positional\n" if imap is None else "")
+                    )
+                write_avro_file(
+                    os.path.join(cdir, COEFFICIENTS, "part-00000.avro"),
+                    schemas.bayesian_linear_model_schema(),
+                    [_glm_record(cid, model.task, means, variances, imap)],
+                )
         elif isinstance(sub, RandomEffectModel):
             _save_random_effect(
                 sub, os.path.join(output_dir, RANDOM_EFFECT, cid),
                 model.task, imap, num_output_files_per_random_effect, meta,
+                write,
             )
         elif isinstance(sub, FactoredRandomEffectModel):
             # Materialize per-entity global-space coefficients (w = B·w_lat)
@@ -177,9 +192,11 @@ def save_game_model(
             _save_random_effect(
                 effective, os.path.join(output_dir, RANDOM_EFFECT, cid),
                 model.task, imap, num_output_files_per_random_effect, meta,
+                write,
             )
             _save_factored_latents(
-                sub, os.path.join(output_dir, MATRIX_FACTORIZATION, cid), meta
+                sub, os.path.join(output_dir, MATRIX_FACTORIZATION, cid), meta,
+                write,
             )
         else:
             raise ValueError(f"cannot save sub-model type {type(sub)} for {cid}")
@@ -203,17 +220,23 @@ def _factored_to_effective_re(sub, meta: CoordinateMeta) -> RandomEffectModel:
     )
 
 
-def _save_factored_latents(sub, out_dir: str, meta: CoordinateMeta) -> None:
+def _save_factored_latents(
+    sub, out_dir: str, meta: CoordinateMeta, write: bool = True
+) -> None:
     latent = sub.latent
-    row_dir = os.path.join(out_dir, latent.random_effect_type)
-    os.makedirs(row_dir, exist_ok=True)
+    gathered = [fetch_global(c) for c in latent.coefficients]
+    B = fetch_global(sub.projection_matrix)
+    if not write:
+        return  # collectives done; record building is writer-only work
     records = []
     for b, ids in enumerate(latent.entity_ids):
-        w_b = fetch_global(latent.coefficients[b])
+        w_b = gathered[b]
         for e, eid in enumerate(ids):
             records.append(
                 {"effectId": str(eid), "latentFactor": [float(v) for v in w_b[e]]}
             )
+    row_dir = os.path.join(out_dir, latent.random_effect_type)
+    os.makedirs(row_dir, exist_ok=True)
     write_avro_file(
         os.path.join(row_dir, "part-00000.avro"),
         schemas.latent_factor_schema(),
@@ -222,7 +245,6 @@ def _save_factored_latents(sub, out_dir: str, meta: CoordinateMeta) -> None:
     # The projection matrix B: one latent vector per feature column index.
     col_dir = os.path.join(out_dir, "projection")
     os.makedirs(col_dir, exist_ok=True)
-    B = fetch_global(sub.projection_matrix)
     write_avro_file(
         os.path.join(col_dir, "part-00000.avro"),
         schemas.latent_factor_schema(),
@@ -240,7 +262,14 @@ def _save_random_effect(
     imap: Optional[IndexMap],
     num_files: int,
     meta: CoordinateMeta,
+    write: bool = True,
 ) -> None:
+    # gathers (items/variances fetch sharded arrays) run on every host;
+    # only the writer touches the filesystem
+    items = list(sub.items())
+    variances = _re_variances(sub)
+    if not write:
+        return
     os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
     with open(os.path.join(cdir, ID_INFO), "w") as f:
         f.write(
@@ -248,8 +277,6 @@ def _save_random_effect(
             f"dim={sub.global_dim}\n"
             + ("names=positional\n" if imap is None else "")
         )
-    items = list(sub.items())
-    variances = _re_variances(sub)
     num_files = max(1, min(num_files, max(1, len(items))))
     per_file = -(-len(items) // num_files) if items else 1
     for p in range(num_files):

@@ -40,8 +40,19 @@ def initialize_distributed(
 
     Also points JAX's persistent compilation cache at the per-uid cache dir
     (every CLI funnels through here, so repeat runs skip first-compile cost;
-    PHOTON_ML_TPU_COMPILE_CACHE overrides, "" disables).
+    PHOTON_ML_TPU_COMPILE_CACHE overrides, "" disables), and re-asserts a
+    JAX_PLATFORMS env request via jax.config — some accelerator plugins
+    override the env var at import time, which would otherwise ignore an
+    explicit platform choice (and hang on a dead device tunnel).
     """
+    import os as _os
+
+    env_platform = _os.environ.get("JAX_PLATFORMS", "").strip()
+    if env_platform:
+        try:
+            jax.config.update("jax_platforms", env_platform)
+        except Exception:  # pragma: no cover - very old jax
+            pass
     from photon_ml_tpu.utils.cachedir import enable_compilation_cache
 
     enable_compilation_cache()
@@ -78,6 +89,41 @@ def initialize_distributed(
         logger.debug("no distributed environment detected (%s)", e)
         return False
     return jax.process_count() > 1
+
+
+def barrier(name: str = "photon-ml-tpu-barrier") -> None:
+    """Block until every process reaches this point (no-op single-process).
+
+    Use after single-writer persistence (process 0 writes, everyone then
+    reads) and before tearing down shared resources.
+    """
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def add_distributed_args(parser) -> None:
+    """CLI flags for an explicit cluster launch (torchrun-style): every
+    process of the job runs the same command with its own --process-id.
+    Omit all three on TPU pods/Slurm, where jax auto-detects the cluster."""
+    parser.add_argument(
+        "--coordinator-address", default=None,
+        help="host:port of process 0 (explicit multi-host launch)",
+    )
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+
+
+def initialize_from_args(args) -> bool:
+    """``initialize_distributed`` from parsed CLI args (the CLIs call this
+    first thing, before any jax device use)."""
+    return initialize_distributed(
+        coordinator_address=getattr(args, "coordinator_address", None),
+        num_processes=getattr(args, "num_processes", None),
+        process_id=getattr(args, "process_id", None),
+    )
 
 
 def host_shard_files(paths: Sequence[str]) -> List[str]:

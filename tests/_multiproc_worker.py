@@ -211,14 +211,23 @@ game_fit = est.fit(game_data)
 g_scores = np.asarray(game_fit.model.score(game_data))
 assert np.all(np.isfinite(g_scores))
 
-# --- model persistence across processes: saving gathers sharded model
-# arrays (collectives); every host writes its own copy and reloads it
+# --- model persistence across processes: every host runs the gather
+# collectives, only process 0 writes (single-writer contract), then all
+# hosts read the shared directory after a barrier
 import tempfile
 
 from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.parallel.multihost import barrier
 
-mdir = tempfile.mkdtemp(prefix=f"mp_model_{proc_id}_")
+mdir = os.path.join(tempfile.gettempdir(), f"mp_model_{port}_{os.getppid()}")
+if proc_id == 0 and os.path.isdir(mdir):
+    import shutil
+
+    shutil.rmtree(mdir)  # stale dir from a crashed run must not mask a save
+barrier("model-dir-clean")
 save_game_model(game_fit.model, mdir)
+barrier("model-saved")
+assert os.path.isdir(mdir), "process 0 should have written the shared model"
 reloaded, _ = load_game_model(mdir)
 from photon_ml_tpu.parallel.mesh import fetch_global
 
@@ -230,6 +239,11 @@ r_scores = np.asarray(reloaded.score(game_data))
 assert np.allclose(r_scores, g_scores, atol=1e-4), (
     np.abs(r_scores - g_scores).max()
 )
+barrier("model-reloaded")
+if proc_id == 0:
+    import shutil
+
+    shutil.rmtree(mdir, ignore_errors=True)
 
 print(f"worker {proc_id}: cluster {n_procs} procs x {n_local} devices, "
       f"dp solve corr {corr:.3f}, grid solve matches local, "

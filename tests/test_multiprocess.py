@@ -25,6 +25,121 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _worker_env(n_local_devices: int) -> dict:
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_local_devices}"
+    )
+    env["PHOTON_ML_TPU_PLAN_CACHE"] = ""
+    env["PHOTON_ML_TPU_COMPILE_CACHE"] = ""
+    return env
+
+
+def test_cli_cluster_training(tmp_path):
+    """The production multi-host launch, end to end: two OS processes run
+    the REAL train_game CLI with --coordinator-address/--num-processes/
+    --process-id, train a grid-parallel GAME model over the joint 8-device
+    mesh, and exactly one process (0) writes the model to the shared
+    output directory."""
+    import json
+
+    import numpy as np
+
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    rng = np.random.default_rng(7)
+    n_users, rows, dg, du = 6, 30, 6, 3
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    records = []
+    for i in range(n_users * rows):
+        user = f"user{i % n_users}"
+        xg = rng.normal(size=dg)
+        xu = rng.normal(size=du)
+        records.append({
+            "uid": f"r{i}",
+            "label": float(rng.integers(0, 2)),
+            "features": [("g", str(j), xg[j]) for j in range(dg)],
+            "userFeatures": [("u", str(j), xu[j]) for j in range(du)],
+            "metadataMap": {"userId": user},
+        })
+    write_training_examples(str(train_dir / "part-00000.avro"), records)
+    config = {
+        "feature_shards": {
+            "global": {"feature_bags": ["features"], "add_intercept": True},
+            "per_user": {"feature_bags": ["userFeatures"], "add_intercept": False},
+        },
+        "coordinates": {
+            "fixed": {"type": "fixed", "feature_shard": "global",
+                      "optimizer": {"optimizer": "LBFGS",
+                                    "regularization": "L2",
+                                    "regularization_weight": 0.1}},
+            "per_user": {"type": "random", "feature_shard": "per_user",
+                         "random_effect_type": "userId",
+                         "optimizer": {"regularization": "L2",
+                                       "regularization_weight": 1.0}},
+        },
+        "update_order": ["fixed", "per_user"],
+    }
+    cfg_path = tmp_path / "game.json"
+    cfg_path.write_text(json.dumps(config))
+
+    port = _free_port()
+    out = tmp_path / "out"
+    env = _worker_env(n_local_devices=4)
+    logs = [tmp_path / f"cli{i}.log" for i in range(2)]
+    procs = []
+    for i in range(2):
+        with open(logs[i], "w") as fh:
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "photon_ml_tpu.cli.train_game",
+                        "--train-data-dirs", str(train_dir),
+                        "--coordinate-config", str(cfg_path),
+                        "--task", "LOGISTIC_REGRESSION",
+                        "--output-dir", str(out),
+                        "--num-outer-iterations", "1",
+                        "--parallel-data", "2", "--parallel-feat", "4",
+                        "--coordinator-address", f"127.0.0.1:{port}",
+                        "--num-processes", "2", "--process-id", str(i),
+                    ],
+                    stdout=fh,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                )
+            )
+    timed_out = False
+    try:
+        for p in procs:
+            p.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        for p in procs:
+            p.kill()
+            p.wait()
+    outs = [log.read_text() for log in logs]
+    if timed_out:
+        pytest.fail("CLI cluster timed out:\n" + "\n".join(outs))
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"CLI worker {i} failed:\n{o}"
+
+    # the model exists exactly once, written by process 0, and loads
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    model, _ = load_game_model(str(out / "best"))
+    assert "fixed" in model.models and "per_user" in model.models
+
+
 @pytest.mark.parametrize("n_procs", [2, 4])
 def test_cluster_end_to_end(tmp_path, n_procs):
     port = _free_port()
