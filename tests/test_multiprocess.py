@@ -44,6 +44,30 @@ def _worker_env(n_local_devices: int) -> dict:
     return env
 
 
+
+def _run_cluster(cmds, logs, env, timeout=240):
+    """Launch one process per command with file-backed logs, wait for all,
+    kill the stragglers on timeout. Returns (timed_out, outputs)."""
+    procs = []
+    for cmd, log in zip(cmds, logs):
+        with open(log, "w") as fh:
+            procs.append(
+                subprocess.Popen(
+                    cmd, stdout=fh, stderr=subprocess.STDOUT, env=env
+                )
+            )
+    timed_out = False
+    try:
+        for p in procs:
+            p.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        for p in procs:
+            p.kill()
+            p.wait()
+    return timed_out, procs, [log.read_text() for log in logs]
+
+
 def test_cli_cluster_training(tmp_path):
     """The production multi-host launch, end to end: two OS processes run
     the REAL train_game CLI with --coordinator-address/--num-processes/
@@ -97,37 +121,21 @@ def test_cli_cluster_training(tmp_path):
     out = tmp_path / "out"
     env = _worker_env(n_local_devices=4)
     logs = [tmp_path / f"cli{i}.log" for i in range(2)]
-    procs = []
-    for i in range(2):
-        with open(logs[i], "w") as fh:
-            procs.append(
-                subprocess.Popen(
-                    [
-                        sys.executable, "-m", "photon_ml_tpu.cli.train_game",
-                        "--train-data-dirs", str(train_dir),
-                        "--coordinate-config", str(cfg_path),
-                        "--task", "LOGISTIC_REGRESSION",
-                        "--output-dir", str(out),
-                        "--num-outer-iterations", "1",
-                        "--parallel-data", "2", "--parallel-feat", "4",
-                        "--coordinator-address", f"127.0.0.1:{port}",
-                        "--num-processes", "2", "--process-id", str(i),
-                    ],
-                    stdout=fh,
-                    stderr=subprocess.STDOUT,
-                    env=env,
-                )
-            )
-    timed_out = False
-    try:
-        for p in procs:
-            p.wait(timeout=240)
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        for p in procs:
-            p.kill()
-            p.wait()
-    outs = [log.read_text() for log in logs]
+    cmds = [
+        [
+            sys.executable, "-m", "photon_ml_tpu.cli.train_game",
+            "--train-data-dirs", str(train_dir),
+            "--coordinate-config", str(cfg_path),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--num-outer-iterations", "1",
+            "--parallel-data", "2", "--parallel-feat", "4",
+            "--coordinator-address", f"127.0.0.1:{port}",
+            "--num-processes", "2", "--process-id", str(i),
+        ]
+        for i in range(2)
+    ]
+    timed_out, procs, outs = _run_cluster(cmds, logs, env)
     if timed_out:
         pytest.fail("CLI cluster timed out:\n" + "\n".join(outs))
     for i, (p, o) in enumerate(zip(procs, outs)):
@@ -138,6 +146,36 @@ def test_cli_cluster_training(tmp_path):
 
     model, _ = load_game_model(str(out / "best"))
     assert "fixed" in model.models and "per_user" in model.models
+
+    # scoring CLI across the same cluster: single-writer scores output
+    port2 = _free_port()
+    score_out = tmp_path / "scores"
+    slogs = [tmp_path / f"score{i}.log" for i in range(2)]
+    scmds = [
+        [
+            sys.executable, "-m", "photon_ml_tpu.cli.score_game",
+            "--data-dirs", str(train_dir),
+            "--model-dir", str(out / "best"),
+            "--output-dir", str(score_out),
+            "--coordinator-address", f"127.0.0.1:{port2}",
+            "--num-processes", "2", "--process-id", str(i),
+        ]
+        for i in range(2)
+    ]
+    timed_out, sprocs, souts = _run_cluster(scmds, slogs, env)
+    if timed_out:
+        pytest.fail("score CLI cluster timed out:\n" + "\n".join(souts))
+    for i, (p, o) in enumerate(zip(sprocs, souts)):
+        assert p.returncode == 0, f"score worker {i} failed:\n{o}"
+    # single-writer invariant, asserted on writer identity (file counts
+    # alone could not distinguish a double-writer regression: both
+    # processes would write the same deterministic part file names)
+    assert f"saved {len(records)} scores" in souts[0]
+    assert "saved 0 scores" in souts[1]
+    from photon_ml_tpu.io.scores_io import load_scores
+
+    scored = list(load_scores(str(score_out)))
+    assert len(scored) == len(records)
 
 
 @pytest.mark.parametrize("n_procs", [2, 4])
@@ -155,27 +193,11 @@ def test_cluster_end_to_end(tmp_path, n_procs):
     # workers write to FILES, not pipes: an undrained pipe's backpressure
     # would block one worker mid-collective and hang the whole cluster
     logs = [tmp_path / f"worker{i}.log" for i in range(n_procs)]
-    procs = []
-    for i in range(n_procs):
-        with open(logs[i], "w") as fh:
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, _WORKER, str(i), str(n_procs), str(port)],
-                    stdout=fh,
-                    stderr=subprocess.STDOUT,
-                    env=env,
-                )
-            )
-    timed_out = False
-    try:
-        for p in procs:
-            p.wait(timeout=240)
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        for p in procs:
-            p.kill()
-            p.wait()
-    outs = [log.read_text() for log in logs]
+    cmds = [
+        [sys.executable, _WORKER, str(i), str(n_procs), str(port)]
+        for i in range(n_procs)
+    ]
+    timed_out, procs, outs = _run_cluster(cmds, logs, env)
     if timed_out:
         pytest.fail("multi-process cluster timed out:\n" + "\n".join(outs))
     for i, (p, out) in enumerate(zip(procs, outs)):
