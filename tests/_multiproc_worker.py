@@ -192,22 +192,34 @@ game_data = GameData(
     offsets=np.zeros(ng, np.float32),
     weights=np.ones(ng, np.float32),
 )
+game_coords = {
+    "global": FixedEffectCoordinateConfiguration(
+        feature_shard="g", optimizer=cfg
+    ),
+    "per-user": RandomEffectCoordinateConfiguration(
+        feature_shard="g",
+        data=RandomEffectDataConfiguration(random_effect_type="userId"),
+        optimizer=cfg,
+    ),
+}
 est = GameEstimator(
     task=TaskType.LOGISTIC_REGRESSION,
-    coordinates={
-        "global": FixedEffectCoordinateConfiguration(
-            feature_shard="g", optimizer=cfg
-        ),
-        "per-user": RandomEffectCoordinateConfiguration(
-            feature_shard="g",
-            data=RandomEffectDataConfiguration(random_effect_type="userId"),
-            optimizer=cfg,
-        ),
-    },
+    coordinates=game_coords,
     num_outer_iterations=1,
     parallel=ParallelConfiguration(n_data=2, n_feat=4, engine="benes"),
 )
-game_fit = est.fit(game_data)
+# checkpoint the fit itself: process 0 writes, every host runs the gathers
+import tempfile
+
+from photon_ml_tpu.parallel.multihost import barrier
+
+ckdir = os.path.join(tempfile.gettempdir(), f"mp_ckpt_{port}_{os.getppid()}")
+if proc_id == 0 and os.path.isdir(ckdir):
+    import shutil
+
+    shutil.rmtree(ckdir)
+barrier("ckpt-clean")
+game_fit = est.fit(game_data, checkpoint_dir=ckdir)
 g_scores = np.asarray(game_fit.model.score(game_data))
 assert np.all(np.isfinite(g_scores))
 
@@ -244,6 +256,34 @@ if proc_id == 0:
     import shutil
 
     shutil.rmtree(mdir, ignore_errors=True)
+
+# --- resume across the cluster: a longer run continues from the shared
+# checkpoint written during the fit above
+barrier("ckpt-written")
+assert os.path.isfile(
+    os.path.join(ckdir, "training-state.json")
+), "process 0 should have written the checkpoint state"
+est_resume = GameEstimator(
+    task=TaskType.LOGISTIC_REGRESSION,
+    coordinates=game_coords,
+    num_outer_iterations=2,
+    parallel=ParallelConfiguration(n_data=2, n_feat=4, engine="benes"),
+)
+fit2 = est_resume.fit(game_data, checkpoint_dir=ckdir)  # resumes at iter 2
+# the resumed run must splice iteration 1's objective history from the
+# checkpoint — exact equality proves it loaded rather than retrained
+h1 = game_fit.objective_history
+assert fit2.objective_history[: len(h1)] == h1, (
+    fit2.objective_history[: len(h1)], h1
+)
+assert len(fit2.objective_history) > len(h1)  # and trained iteration 2
+r2 = np.asarray(fit2.model.score(game_data))
+assert np.all(np.isfinite(r2))
+barrier("resume-done")
+if proc_id == 0:
+    import shutil
+
+    shutil.rmtree(ckdir, ignore_errors=True)
 
 print(f"worker {proc_id}: cluster {n_procs} procs x {n_local} devices, "
       f"dp solve corr {corr:.3f}, grid solve matches local, "
