@@ -16,14 +16,19 @@ model class for cross-compat. Loading without index maps builds a compact
 index per shard from the scanned features, exactly like the reference
 (:128-133 doc).
 
-This writer appends extra whitespace-separated tokens to id-info beyond the
-reference's fields: ``dim=N`` (the dense dimension — sparse records drop
-zero coefficients, so the reloaded vectors would otherwise shrink) and,
-for no-index-map saves, ``names=positional`` (feature names are original
-integer indices; the loader restores them to those exact positions instead
-of encounter-order renumbering, which would permute coefficients whenever
-any zero was dropped). Readers of the reference format ignore trailing
-tokens; files written by the reference load here as before.
+id-info files are byte-identical to the reference's (the reference loader
+destructures them with exact arity — ModelProcessingUtils.scala:156/182 —
+so extra lines would throw scala.MatchError there). The writer's extra
+facts live in model-metadata.json instead, under ``featureShards``:
+``dim`` (the dense dimension — sparse records drop zero coefficients, so
+the reloaded vectors would otherwise shrink) and ``positional`` (for
+no-index-map saves: feature names are original integer indices; the loader
+restores them to those exact positions instead of encounter-order
+renumbering, which would permute coefficients whenever any zero was
+dropped). JSON readers ignore unknown keys, so the reference still parses
+the metadata; files written by the reference load here as before, and the
+loader also still honors the legacy ``dim=N`` / ``names=positional``
+id-info tokens that round-3 saves emitted.
 """
 
 from __future__ import annotations
@@ -140,17 +145,39 @@ def save_game_model(
     """
     import jax
 
+    from photon_ml_tpu.algorithm.factored_random_effect import (
+        FactoredRandomEffectModel,
+    )
+
     if write is None:
         write = jax.process_index() == 0
+
+    # Per-shard facts the reference's id-info format cannot carry (it is
+    # arity-checked by the reference loader); persisted in metadata instead.
+    feature_shards: Dict[str, dict] = {}
+    for cid, sub in model.models.items():
+        shard = model.meta[cid].feature_shard
+        imap = (index_maps or {}).get(shard)
+        if isinstance(sub, GeneralizedLinearModel):
+            dim = int(sub.coefficients.means.shape[0])
+        elif isinstance(sub, RandomEffectModel):
+            dim = int(sub.global_dim)
+        elif isinstance(sub, FactoredRandomEffectModel):
+            dim = int(sub.projection_matrix.shape[0])
+        else:
+            continue  # the save loop below raises for unknown types
+        ent = feature_shards.setdefault(
+            shard, {"dim": 0, "positional": imap is None}
+        )
+        ent["dim"] = max(ent["dim"], dim)
+
     if write:
         os.makedirs(output_dir, exist_ok=True)
         save_game_model_metadata(
             output_dir, model.task, model_name=model_name,
             configurations=configurations,
+            feature_shards=feature_shards,
         )
-    from photon_ml_tpu.algorithm.factored_random_effect import (
-        FactoredRandomEffectModel,
-    )
 
     for cid, sub in model.models.items():
         meta = model.meta[cid]
@@ -166,11 +193,7 @@ def save_game_model(
             if write:
                 os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
                 with open(os.path.join(cdir, ID_INFO), "w") as f:
-                    f.write(
-                        meta.feature_shard
-                        + f"\ndim={sub.coefficients.means.shape[0]}\n"
-                        + ("names=positional\n" if imap is None else "")
-                    )
+                    f.write(meta.feature_shard + "\n")
                 write_avro_file(
                     os.path.join(cdir, COEFFICIENTS, "part-00000.avro"),
                     schemas.bayesian_linear_model_schema(),
@@ -272,11 +295,7 @@ def _save_random_effect(
         return
     os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
     with open(os.path.join(cdir, ID_INFO), "w") as f:
-        f.write(
-            f"{sub.random_effect_type}\n{meta.feature_shard}\n"
-            f"dim={sub.global_dim}\n"
-            + ("names=positional\n" if imap is None else "")
-        )
+        f.write(f"{sub.random_effect_type}\n{meta.feature_shard}\n")
     num_files = max(1, min(num_files, max(1, len(items))))
     per_file = -(-len(items) // num_files) if items else 1
     for p in range(num_files):
@@ -317,14 +336,22 @@ def save_game_model_metadata(
     task: TaskType,
     model_name: str = "photon-ml-tpu",
     configurations: Optional[dict] = None,
+    feature_shards: Optional[Dict[str, dict]] = None,
 ) -> None:
-    """model-metadata.json (reference saveGameModelMetadataToHDFS :517)."""
+    """model-metadata.json (reference saveGameModelMetadataToHDFS :517).
+
+    ``feature_shards`` maps shard id → {"dim": int, "positional": bool};
+    an extra JSON key the reference parser ignores (id-info itself must
+    stay arity-exact for the reference loader).
+    """
     os.makedirs(output_dir, exist_ok=True)
     payload = {
         "modelType": task.name,
         "modelName": model_name,
         "configurations": configurations or {},
     }
+    if feature_shards:
+        payload["featureShards"] = feature_shards
     with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
         json.dump(payload, f, indent=2)
 
@@ -403,7 +430,14 @@ def load_game_model(
     models: Dict[str, object] = {}
     meta: Dict[str, CoordinateMeta] = {}
     builders: Dict[str, _MapBuilder] = {}
-    shard_dims: Dict[str, int] = {}  # declared dims from id-info files
+    # Declared dims / positional-ness: from metadata featureShards (current
+    # format) or legacy dim=/names=positional id-info tokens (round-3 saves).
+    shard_dims: Dict[str, int] = {}
+    positional_shards = set()
+    for shard, ent in (metadata.get("featureShards") or {}).items():
+        shard_dims[shard] = int(ent.get("dim", 0))
+        if ent.get("positional"):
+            positional_shards.add(shard)
 
     def map_for(shard: str) -> Tuple[Optional[IndexMap], Optional[_MapBuilder]]:
         if index_maps is not None and shard in index_maps:
@@ -418,7 +452,7 @@ def load_game_model(
                 tokens = f.read().split()
             shard = tokens[0]
             _note_declared_dim(shard_dims, shard, tokens)
-            positional = "names=positional" in tokens
+            positional = shard in positional_shards or "names=positional" in tokens
             imap, builder = map_for(shard)
             records = list(
                 read_avro_dir(os.path.join(cdir, COEFFICIENTS))
@@ -442,7 +476,7 @@ def load_game_model(
                 tokens = f.read().split()
             re_type, shard = tokens[:2]
             _note_declared_dim(shard_dims, shard, tokens)
-            positional = "names=positional" in tokens
+            positional = shard in positional_shards or "names=positional" in tokens
             imap, builder = map_for(shard)
             entity_coefs: Dict[str, Dict[int, float]] = {}
             entity_vars: Dict[str, Dict[int, float]] = {}

@@ -245,7 +245,7 @@ from photon_ml_tpu.parallel.mesh import fetch_global
 
 fe0 = fetch_global(game_fit.model.models["global"].coefficients.means)
 fe1 = fetch_global(reloaded.models["global"].coefficients.means)
-assert fe0.shape == fe1.shape  # dim survives sparse storage (dim= in id-info)
+assert fe0.shape == fe1.shape  # dim survives sparse storage (featureShards in metadata)
 assert np.allclose(fe0, fe1, atol=1e-6)
 r_scores = np.asarray(reloaded.score(game_data))
 assert np.allclose(r_scores, g_scores, atol=1e-4), (

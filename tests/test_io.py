@@ -381,6 +381,65 @@ class TestModelIO:
         got = np.asarray(loaded.models["fixed"].coefficients.means)
         np.testing.assert_array_equal(got, w)  # positions AND dim preserved
 
+    def test_id_info_is_arity_exact_for_reference_loader(self, tmp_path, rng):
+        """The reference destructures id-info with exact arity (1 line for
+        fixed-effect, 2 for random-effect — ModelProcessingUtils.scala:156/
+        182); any extra line throws scala.MatchError there. dim/positional
+        facts must live in model-metadata.json instead."""
+        import json
+        from photon_ml_tpu.io.model_io import save_game_model
+
+        model, _ = self._train_small_game(rng)
+        out = str(tmp_path / "model")
+        save_game_model(model, out)
+        with open(os.path.join(out, "fixed-effect", "fixed", "id-info")) as f:
+            assert f.read().split() == ["g"]
+        with open(os.path.join(out, "random-effect", "per_user", "id-info")) as f:
+            assert f.read().split() == ["userId", "u"]
+        with open(os.path.join(out, "model-metadata.json")) as f:
+            md = json.load(f)
+        assert md["featureShards"]["g"]["dim"] == 8
+        assert md["featureShards"]["u"]["dim"] == 4
+        assert md["featureShards"]["g"]["positional"] is True
+
+    def test_load_legacy_id_info_tokens(self, tmp_path, rng):
+        """Models saved by the round-3 writer carried dim=N /
+        names=positional as extra id-info tokens; the loader still honors
+        them when metadata lacks featureShards."""
+        import json
+        from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.models.game import CoordinateMeta, GameModel
+        from photon_ml_tpu.models.glm import GeneralizedLinearModel
+        from photon_ml_tpu.types import TaskType
+        import jax.numpy as jnp
+
+        w = np.array([0.0, 2.5, 0.0, -1.0, 0.0], dtype=np.float32)
+        model = GameModel(
+            models={
+                "fixed": GeneralizedLinearModel(
+                    coefficients=Coefficients(means=jnp.asarray(w)),
+                    task=TaskType.LINEAR_REGRESSION,
+                )
+            },
+            meta={"fixed": CoordinateMeta(feature_shard="g")},
+            task=TaskType.LINEAR_REGRESSION,
+        )
+        out = str(tmp_path / "model")
+        save_game_model(model, out)
+        # Rewrite artifacts into the legacy round-3 shape.
+        md_path = os.path.join(out, "model-metadata.json")
+        with open(md_path) as f:
+            md = json.load(f)
+        del md["featureShards"]
+        with open(md_path, "w") as f:
+            json.dump(md, f)
+        with open(os.path.join(out, "fixed-effect", "fixed", "id-info"), "w") as f:
+            f.write("g\ndim=5\nnames=positional\n")
+        loaded, _ = load_game_model(out)
+        got = np.asarray(loaded.models["fixed"].coefficients.means)
+        np.testing.assert_array_equal(got, w)
+
     def test_save_load_scoring_equivalence(self, tmp_path, rng):
         from photon_ml_tpu.io.model_io import (
             load_game_model,
