@@ -134,10 +134,13 @@ class GameData:
         - "benes" — permutation-routed engine (ops/sparse_perm.py): vector-
           speed matvec/rmatvec on TPU, with a one-time host routing cost.
         - "fused" — same routing executed as fused Pallas kernels
-          (ops/fused_perm.py): ~3x less HBM traffic per linear map on TPU.
-        - "auto"  — on a TPU backend with a shard large enough for the
-          routing prep to pay for itself: "fused" when the one-time
-          lowering probe passes, else "benes"; everywhere else "ell".
+          (ops/fused_perm.py): ~3x less HBM traffic per linear map on TPU
+          by byte accounting. Opt-in until an on-hardware A/B records a
+          win (bench.py --engine fused / dev-scripts/tpu_validate_fused.py);
+          "auto" only prefers measured engines.
+        - "auto"  — "benes" on a TPU backend with a shard large enough for
+          the routing prep to pay for itself (measured 26.2M example-
+          passes/s vs ELL's 2.2M in round 2); "ell" everywhere else.
         """
         if engine not in ("auto", "ell", "benes", "fused"):
             raise ValueError(
@@ -152,12 +155,9 @@ class GameData:
             import jax
 
             on_tpu = jax.default_backend() == "tpu"
-            if on_tpu and shard.rows.size >= (1 << 20):
-                from photon_ml_tpu.ops.fused_perm import fused_engine_works
-
-                engine = "fused" if fused_engine_works() else "benes"
-            else:
-                engine = "ell"
+            engine = (
+                "benes" if on_tpu and shard.rows.size >= (1 << 20) else "ell"
+            )
         key = (shard_name, engine)
         if key not in cache:
             if engine in ("benes", "fused"):

@@ -499,6 +499,14 @@ def compile_resolver(writer: Any, reader: Any):
     if wk == "enum":
         symbols = list(writer["symbols"])
         known = set(reader["symbols"])
+        # Avro spec (1.9+): a writer symbol absent from the reader's enum
+        # resolves to the reader's default symbol when one is declared.
+        fallback = reader.get("default")
+        if fallback is not None and fallback not in known:
+            raise ValueError(
+                f"enum default {fallback!r} is not one of the reader's "
+                f"symbols {sorted(known)}"
+            )
 
         def enum_fn(r: _Reader):
             i = r.read_long()
@@ -506,7 +514,12 @@ def compile_resolver(writer: Any, reader: Any):
                 raise ValueError(f"enum index {i} out of range")
             sym = symbols[i]
             if sym not in known:
-                raise ValueError(f"enum symbol {sym!r} unknown to reader")
+                if fallback is not None:
+                    return fallback
+                raise ValueError(
+                    f"enum symbol {sym!r} unknown to reader and the reader "
+                    "enum declares no default"
+                )
             return sym
 
         return enum_fn

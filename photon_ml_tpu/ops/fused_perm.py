@@ -61,6 +61,18 @@ _INTERPRET = False
 
 _MAX_BASE_BLOCK = 1024  # rows per base-kernel block (VMEM budget)
 
+# Largest slot group (K or KP) the fused prologue/epilogue can address. For
+# group = 128*q the operand BlockSpec height is LANES*u//q with u as small as
+# 1, so q > LANES would silently produce a zero-height block and an obscure
+# Mosaic failure at production shapes (a row/column with more than
+# LANES*LANES nonzeros after hot-column splitting). Guarded in ``assemble``.
+MAX_FUSED_GROUP = LANES * LANES
+
+
+class FusedGroupTooLarge(ValueError):
+    """A slot group exceeds what the fused executor can tile. The
+    stage-by-stage engine (``engine="benes"``) has no such limit."""
+
 
 # --------------------------------------------------------------------------
 # Plan parsing: recover the canonical (descend* base ascend*) shape that
@@ -676,6 +688,14 @@ def assemble(
     paddings — the fused twin of ``sparse_perm._assemble`` (the grid builder
     stacks identically-shaped tiles built through this)."""
     assert K & (K - 1) == 0 and KP & (KP - 1) == 0, "group sizes must be pow2"
+    for name, group in (("K", K), ("KP", KP)):
+        if group > MAX_FUSED_GROUP:
+            raise FusedGroupTooLarge(
+                f"slot group {name}={group} exceeds the fused executor's "
+                f"limit of {MAX_FUSED_GROUP} (a row/column with more nonzeros "
+                "than that after hot-column splitting, or a pin_k/pin_kp/"
+                "cross-tile pad that large); use engine='benes' for this shard"
+            )
 
     from photon_ml_tpu.ops.sparse_perm import route_layout
 

@@ -174,12 +174,24 @@ def owlqn_solve(
         if has_box:
             # post-step projection (reference LBFGS.scala:72, inherited by
             # OWLQN); recompute at the projected point so curvature pairs
-            # and convergence checks see the true state
-            w_new = _project_box(
+            # and convergence checks see the true state — but only when the
+            # projection actually clipped something (bounds inactive or a
+            # failed line search leave w unchanged, and the line-search
+            # f/g are already exact there)
+            w_proj = _project_box(
                 w_new, config.constraint_lower, config.constraint_upper
             )
-            f_new, g_new = objective.value_and_grad(w_new, data, l2_weight)
-            F_new = f_new + l1 * jnp.sum(jnp.abs(w_new))
+            clipped = jnp.any(w_proj != w_new)
+
+            def _recompute(_):
+                f_p, g_p = objective.value_and_grad(w_proj, data, l2_weight)
+                return f_p, g_p, f_p + l1 * jnp.sum(jnp.abs(w_proj))
+
+            def _reuse(_):
+                return f_new, g_new, F_new
+
+            f_new, g_new, F_new = jax.lax.cond(clipped, _recompute, _reuse, None)
+            w_new = w_proj
 
         s_vec = w_new - s.w
         y_vec = g_new - s.g

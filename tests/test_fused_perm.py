@@ -289,17 +289,16 @@ class TestAutoEngineProbe:
         monkeypatch.setattr(fp, "_PROBE_RESULT", None)
         assert fp.fused_engine_works() is False
 
-    @pytest.mark.parametrize("probe_ok,expect", [(True, "fused"), (False, "benes")])
-    def test_auto_falls_back_when_probe_fails(self, monkeypatch, probe_ok, expect):
-        """On a TPU backend, "auto" picks the fused engine only when the
-        lowering probe passes; otherwise the stage-by-stage engine."""
+    def test_auto_prefers_measured_benes_on_tpu(self, monkeypatch):
+        """On a TPU backend, "auto" picks the stage-by-stage engine — the
+        only large-shard engine with a recorded on-hardware win. The fused
+        executor stays opt-in until a TPU A/B records it faster."""
         import jax
 
         from photon_ml_tpu.data.game_data import FeatureShard, GameData
         from photon_ml_tpu.ops import fused_perm as fp, sparse_perm as sp
 
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-        monkeypatch.setattr(fp, "fused_engine_works", lambda: probe_ok)
         called = {}
         monkeypatch.setattr(
             fp, "from_coo", lambda *a, **k: called.setdefault("engine", "fused")
@@ -321,7 +320,23 @@ class TestAutoEngineProbe:
             weights=np.ones(4, np.float32),
         )
         data.sparse_features("g", engine="auto")
-        assert called["engine"] == expect
+        assert called["engine"] == "benes"
+
+    def test_fused_rejects_oversized_slot_groups(self):
+        """A row/column with more than LANES*LANES nonzeros cannot tile the
+        fused prologue/epilogue (the operand BlockSpec height LANES*u//q
+        would silently hit zero); assemble must fail loudly, not lower to
+        an obscure Mosaic error."""
+        from photon_ml_tpu.ops import fused_perm as fp
+
+        nnz = fp.MAX_FUSED_GROUP * 2  # one row, 2*16384 distinct columns
+        rows = np.zeros(nnz, np.int64)
+        cols = np.arange(nnz, dtype=np.int64)
+        vals = np.ones(nnz, np.float32)
+        with pytest.raises(fp.FusedGroupTooLarge, match="slot group K="):
+            fp.from_coo(
+                rows, cols, vals, (1, nnz), max_hot_cols=0, plan_cache=""
+            )
 
 
 class TestValidators:

@@ -193,6 +193,42 @@ class TestAvroCodec:
         with pytest.raises(ValueError, match="null"):
             list(read_avro_file(bad_path, reader))
 
+    def test_schema_resolution_enum_default_symbol(self, tmp_path):
+        """Avro spec (1.9+): a writer enum symbol unknown to the reader
+        resolves to the reader's declared default symbol; without one it
+        stays an error."""
+        writer = AvroSchema({
+            "type": "record", "name": "Rec", "fields": [
+                {"name": "e", "type": {
+                    "type": "enum", "name": "Color",
+                    "symbols": ["RED", "TEAL", "BLUE"],
+                }},
+            ],
+        })
+        reader_with_default = AvroSchema({
+            "type": "record", "name": "Rec", "fields": [
+                {"name": "e", "type": {
+                    "type": "enum", "name": "Color",
+                    "symbols": ["RED", "BLUE", "OTHER"],
+                    "default": "OTHER",
+                }},
+            ],
+        })
+        reader_no_default = AvroSchema({
+            "type": "record", "name": "Rec", "fields": [
+                {"name": "e", "type": {
+                    "type": "enum", "name": "Color",
+                    "symbols": ["RED", "BLUE"],
+                }},
+            ],
+        })
+        path = str(tmp_path / "enum.avro")
+        write_avro_file(path, writer, [{"e": "RED"}, {"e": "TEAL"}])
+        got = [r["e"] for r in read_avro_file(path, reader_with_default)]
+        assert got == ["RED", "OTHER"]
+        with pytest.raises(ValueError, match="TEAL"):
+            list(read_avro_file(path, reader_no_default))
+
     def test_schema_resolution_missing_default_raises(self, tmp_path):
         writer = AvroSchema({
             "type": "record", "name": "Rec",
