@@ -90,12 +90,43 @@ def parse_optimizer_config(cfg: dict) -> GlmOptimizationConfiguration:
         opt = OptimizerConfig.lbfgs(**kw)
     reg_type = RegularizationType[cfg.get("regularization", "NONE").upper()]
     reg = RegularizationContext(reg_type, alpha=cfg.get("alpha"))
+    weight = cfg.get("regularization_weight")
+    if weight is not None and cfg.get("regularization_weights"):
+        raise ValueError(
+            "give either regularization_weight or the sweep list "
+            "regularization_weights, not both"
+        )
+    if weight is None:
+        # plural form: the sweep list (cross-product across coordinates,
+        # see coordinate_weight_sweeps); its first entry doubles as the
+        # single-config default
+        ws = cfg.get("regularization_weights")
+        weight = ws[0] if ws else 0.0
     return GlmOptimizationConfiguration(
         optimizer_config=opt,
         regularization=reg,
-        regularization_weight=float(cfg.get("regularization_weight", 0.0)),
+        regularization_weight=float(weight),
         down_sampling_rate=float(cfg.get("down_sampling_rate", 1.0)),
     )
+
+
+def coordinate_weight_sweeps(raw: dict) -> Dict[str, List[float]]:
+    """Per-coordinate λ sweep lists from the raw config JSON.
+
+    A coordinate's optimizer block may declare
+    ``"regularization_weights": [w1, w2, ...]`` (plural) instead of a single
+    weight; the training driver then fits the CROSS-PRODUCT of all sweeping
+    coordinates' weights, one GAME model per combination, and selects the
+    best by the validation evaluator — the reference's per-coordinate
+    config arrays expanded by getAllModelConfigs
+    (cli/game/training/GameTrainingParams.scala:212-223).
+    """
+    out: Dict[str, List[float]] = {}
+    for cid, c in (raw.get("coordinates") or {}).items():
+        ws = (c.get("optimizer") or {}).get("regularization_weights")
+        if ws:
+            out[cid] = [float(w) for w in ws]
+    return out
 
 
 def parse_re_data_config(cfg: dict, re_type: str) -> RandomEffectDataConfiguration:

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from photon_ml_tpu.cli.common import (
+    coordinate_weight_sweeps,
     id_tags_needed,
     load_game_config,
     load_index_maps,
@@ -115,6 +116,25 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             "axis sharding)"
         )
     return args
+
+
+def _sweep_model_configs(sweeps, coordinates):
+    """Cross-product of per-coordinate λ lists → fit_multiple config maps
+    (reference getAllModelConfigs)."""
+    import itertools
+
+    if not sweeps:
+        return [{}]
+    ids = sorted(sweeps)
+    return [
+        {
+            cid: dataclasses.replace(
+                coordinates[cid].optimizer, regularization_weight=w
+            )
+            for cid, w in zip(ids, combo)
+        }
+        for combo in itertools.product(*(sweeps[cid] for cid in ids))
+    ]
 
 
 def _make_evaluator(spec: Optional[str], task: TaskType, data):
@@ -309,18 +329,55 @@ def run(args: argparse.Namespace) -> GameFit:
             import jax
 
             profile_ctx = jax.profiler.trace(args.profile_dir)
-        with profile_ctx, timer.time("fit"):
-            fit = estimator.fit(
-                data,
-                validation_data=validation_data,
-                checkpoint_dir=args.checkpoint_dir,
+        sweep_configs = _sweep_model_configs(
+            coordinate_weight_sweeps(raw_config), coordinates
+        )
+        if len(sweep_configs) > 1 and validation_data is None:
+            raise ValueError(
+                "regularization_weights sweeps need --validation-data-dirs: "
+                "without a validation evaluator there is no way to select "
+                "the best of the swept models"
             )
+        fit_overrides: Dict[str, object] = {}  # the winning config's map
+        with profile_ctx, timer.time("fit"):
+            if len(sweep_configs) > 1:
+                # one fit per swept configuration, best by the validation
+                # evaluator (reference Driver.scala:112 selectBestModel over
+                # getAllModelConfigs)
+                fits = estimator.fit_multiple(
+                    data,
+                    validation_data=validation_data,
+                    configs=sweep_configs,
+                    checkpoint_dir=args.checkpoint_dir,
+                )
+                for cfg_map, f in zip(sweep_configs, fits):
+                    logger.info(
+                        "config %s -> metric %s",
+                        {c: v.regularization_weight for c, v in cfg_map.items()},
+                        "n/a" if f.validation_metric is None else
+                        "%.6f" % f.validation_metric,
+                    )
+                best_i = estimator.select_best_fit(fits)
+                if best_i is None:
+                    raise ValueError(
+                        "no swept fit produced a validation metric; cannot "
+                        "select a best model"
+                    )
+                fit = fits[best_i]
+                fit_overrides = sweep_configs[best_i]
+            else:
+                fit = estimator.fit(
+                    data,
+                    validation_data=validation_data,
+                    checkpoint_dir=args.checkpoint_dir,
+                )
         for cid, value in fit.objective_history:
             cfg = estimator.coordinate_configs.get(cid)
+            opt_cfg = fit_overrides.get(cid) or (cfg.optimizer if cfg else None)
             emitter.send_event(PhotonOptimizationLogEvent(
                 coordinate_id=cid,
                 regularization_weight=(
-                    cfg.optimizer.regularization_weight if cfg else 0.0
+                    opt_cfg.regularization_weight if opt_cfg else 0.0
                 ),
                 objective_value=value,
                 iterations=-1,  # per-coordinate iteration counts live in trackers

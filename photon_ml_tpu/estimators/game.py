@@ -47,6 +47,23 @@ from photon_ml_tpu.types import TaskType
 logger = logging.getLogger("photon_ml_tpu")
 
 
+def _describe_config(cfg: GlmOptimizationConfiguration) -> str:
+    return (
+        f"{cfg.optimizer_config.optimizer.name}"
+        f"(λ={cfg.regularization_weight}, {cfg.regularization.reg_type.name})"
+    )
+
+
+def _config_digest(overrides: Dict[str, GlmOptimizationConfiguration]) -> str:
+    """Stable 8-hex fingerprint of a per-coordinate override map; part of
+    the per-config checkpoint path so an edited sweep list cannot resume
+    from a checkpoint trained under different settings."""
+    import hashlib
+
+    key = repr(sorted((cid, cfg) for cid, cfg in overrides.items()))
+    return hashlib.sha1(key.encode()).hexdigest()[:8]
+
+
 @dataclasses.dataclass(frozen=True)
 class ParallelConfiguration:
     """Multi-chip layout for GAME training over a (data x feat) device grid.
@@ -350,6 +367,121 @@ class GameEstimator:
             cid: self._build_coordinate(cid, cfg, data)
             for cid, cfg in self.coordinate_configs.items()
         }
+        return self._run_fit(
+            coordinates, data, validation_data, checkpoint_dir, initial_models
+        )
+
+    def fit_multiple(
+        self,
+        data: GameData,
+        validation_data: Optional[GameData] = None,
+        configs: Sequence[Dict[str, GlmOptimizationConfiguration]] = (),
+        warm_start: bool = True,
+        checkpoint_dir: Optional[str] = None,
+    ) -> List[GameFit]:
+        """One fit per model configuration — the reference's
+        ``fit(data, validation, Seq[GameModelOptimizationConfiguration])``
+        (GameEstimator.scala:175-217), which trains one GAME model per swept
+        configuration and leaves best-model selection to the caller
+        (``select_best_fit`` = Driver.scala:356 selectBestModel).
+
+        Each entry of ``configs`` maps coordinate id → per-coordinate
+        optimizer configuration; coordinates absent from an entry keep the
+        estimator's configured optimizer. The expensive dataset preparation
+        (entity grouping, projection, routing) happens ONCE and is shared
+        by every fit — only the solver configuration changes per run (the
+        analog of the reference reusing prepared trainingDataSets across
+        the config sequence). ``warm_start`` seeds each fit with the
+        previous fit's models. ``checkpoint_dir`` gets one subdirectory per
+        configuration, keyed by index AND a digest of the override map
+        (``config-000-1a2b3c4d``) so a resume after the sweep list was
+        edited retrains instead of silently returning a model trained
+        under different settings.
+        """
+        base = {
+            cid: self._build_coordinate(cid, cfg, data)
+            for cid, cfg in self.coordinate_configs.items()
+        }
+        if not configs:
+            configs = [{}]
+        fits: List[GameFit] = []
+        prev_models: Optional[Dict[str, object]] = None
+        for i, overrides in enumerate(configs):
+            unknown = set(overrides) - set(base)
+            if unknown:
+                raise ValueError(
+                    f"config {i} names unknown coordinates: {sorted(unknown)}"
+                )
+            coords = {
+                cid: (
+                    self._replace_optimizer(coord, overrides[cid])
+                    if cid in overrides
+                    else coord
+                )
+                for cid, coord in base.items()
+            }
+            logger.info(
+                "fit %d/%d with config overrides: %s", i + 1, len(configs),
+                {c: _describe_config(v) for c, v in overrides.items()} or "(defaults)",
+            )
+            fit = self._run_fit(
+                coords,
+                data,
+                validation_data,
+                (
+                    None
+                    if checkpoint_dir is None
+                    else f"{checkpoint_dir}/config-{i:03d}-{_config_digest(overrides)}"
+                ),
+                prev_models if warm_start else None,
+            )
+            fits.append(fit)
+            if warm_start:
+                prev_models = fit.model.models
+        return fits
+
+    def select_best_fit(self, fits: Sequence[GameFit]) -> Optional[int]:
+        """Index of the fit the validation evaluator ranks best (reference
+        Driver.scala:356 selectBestModel — reduce by the first evaluator's
+        betterThan); None when no fit carries a validation metric, like the
+        reference's reduceOption on an empty evaluation sequence."""
+        best: Optional[int] = None
+        for i, fit in enumerate(fits):
+            if fit.validation_metric is None:
+                continue
+            if best is None or self.evaluator.better_than(
+                fit.validation_metric, fits[best].validation_metric
+            ):
+                best = i
+        return best
+
+    @staticmethod
+    def _replace_optimizer(
+        coord: Coordinate, opt: GlmOptimizationConfiguration
+    ) -> Coordinate:
+        """A coordinate with the same (device-resident) dataset but a new
+        optimizer configuration. For factored coordinates the projection-
+        matrix solve follows the sweep only when it was sharing the RE
+        configuration; a separately-configured matrix_optimizer is kept."""
+        if isinstance(coord, FactoredRandomEffectCoordinate):
+            shared = coord.matrix_configuration == coord.re_configuration
+            return dataclasses.replace(
+                coord,
+                re_configuration=opt,
+                matrix_configuration=(
+                    opt if shared else coord.matrix_configuration
+                ),
+            )
+        return dataclasses.replace(coord, configuration=opt)
+
+    def _run_fit(
+        self,
+        coordinates: Dict[str, Coordinate],
+        data: GameData,
+        validation_data: Optional[GameData],
+        checkpoint_dir: Optional[str],
+        initial_models: Optional[Dict[str, object]],
+    ) -> GameFit:
         meta = self._meta()
 
         loss = loss_for_task(self.task)

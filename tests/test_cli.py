@@ -101,6 +101,35 @@ class TestTrainGameDriver:
         assert (out / "best" / "fixed-effect" / "fixed" / "id-info").is_file()
         assert (out / "best" / "random-effect" / "per_user" / "id-info").is_file()
 
+    def test_multiple_optimizer_configs_selects_best(self, glmix_avro, tmp_path):
+        """Reference DriverTest.scala:324-338 "multiple optimizer configs":
+        per-coordinate regularization_weights arrays sweep the cross-product
+        (2x2 = 4 fits here) and the validation evaluator picks the winner —
+        a crushing fixed-effect λ must not be the saved model."""
+        import json as _json
+
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        cfg = _json.loads(glmix_avro["config"].read_text())
+        cfg["coordinates"]["fixed"]["optimizer"].pop("regularization_weight")
+        cfg["coordinates"]["fixed"]["optimizer"]["regularization_weights"] = [0.1, 1e6]
+        cfg["coordinates"]["per_user"]["optimizer"].pop("regularization_weight")
+        cfg["coordinates"]["per_user"]["optimizer"]["regularization_weights"] = [1.0, 10.0]
+        cfg_path = tmp_path / "sweep.json"
+        cfg_path.write_text(_json.dumps(cfg))
+        out = tmp_path / "out"
+        fit = run(parse_args([
+            "--train-data-dirs", str(glmix_avro["train"]),
+            "--validation-data-dirs", str(glmix_avro["test"]),
+            "--coordinate-config", str(cfg_path),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--evaluator", "AUC",
+        ]))
+        # the winner must beat the single-config gate (λ=1e6 would be ~0.5)
+        assert fit.validation_metric > 0.70
+        assert (out / "best" / "model-metadata.json").is_file()
+
     def test_normalization_and_stats(self, glmix_avro, tmp_path):
         from photon_ml_tpu.cli.train_game import parse_args, run
 

@@ -202,3 +202,50 @@ def test_best_model_tracking_with_validation(rng):
     # best metric is the min over history (RMSE: smaller is better)
     hist = [v for _, v in fit.validation_history]
     assert fit.validation_metric == pytest.approx(min(hist))
+
+
+def test_fit_multiple_configs_and_best_selection(rng):
+    """Reference GameEstimator.fit over Seq[GameModelOptimizationConfiguration]
+    (GameEstimator.scala:175-217) + Driver.scala:356 selectBestModel: one
+    model per config, best chosen by the validation evaluator. A crushing λ
+    must lose to a reasonable λ."""
+    data, _ = _glmix_problem(rng, n_users=12, rows_per_user=40)
+    n = data.num_rows
+    mask = np.zeros(n, dtype=bool)
+    mask[: n // 5] = True
+    val = GameData(
+        labels=data.labels[mask],
+        feature_shards={k: s.slice_rows(mask) for k, s in data.feature_shards.items()},
+        id_tags={k: v[mask] for k, v in data.id_tags.items()},
+    )
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinates={
+            "fixed": FixedEffectCoordinateConfiguration("global", L2(0.1)),
+            "per-user": RandomEffectCoordinateConfiguration(
+                "per_user",
+                data=RandomEffectDataConfiguration("userId"),
+                optimizer=L2(1.0),
+            ),
+        },
+        update_order=["fixed", "per-user"],
+        evaluator=RMSE,
+    )
+    # cross-product style sweep: fixed λ in {0.01, 1e6}
+    configs = [{"fixed": L2(0.01)}, {"fixed": L2(1e6)}]
+    fits = est.fit_multiple(data, val, configs=configs)
+    assert len(fits) == 2
+    assert all(f.validation_metric is not None for f in fits)
+    best = est.select_best_fit(fits)
+    assert best == 0, [f.validation_metric for f in fits]
+    # the crushed model must actually be worse (RMSE: larger)
+    assert fits[1].validation_metric > fits[0].validation_metric
+
+    # unknown coordinate ids fail fast
+    with pytest.raises(ValueError, match="unknown coordinates"):
+        est.fit_multiple(data, val, configs=[{"nope": L2(1.0)}])
+
+    # no validation data -> no metric -> no selection (reference
+    # reduceOption on empty evaluations)
+    fits_nv = est.fit_multiple(data, configs=[{"fixed": L2(0.01)}])
+    assert est.select_best_fit(fits_nv) is None

@@ -118,6 +118,21 @@ class TestGoldenRatings:
             fused_perm._INTERPRET = old
         assert fit.validation_metric < 0.45  # captured 0.3885 (ELL engine)
 
+    def test_multiple_optimizer_configs(self, tmp_path):
+        """Reference DriverTest.scala:324-338 "multiple optimizer configs":
+        the fixed coordinate sweeps λ ∈ {10, 1e7}; the driver fits one GAME
+        model per config and the saved best must hit the same golden gate
+        (λ=1e7 crushes the fixed effect and cannot win)."""
+        fixed_sweep = json.loads(json.dumps(FIXED))
+        fixed_sweep["optimizer"].pop("regularization_weight")
+        fixed_sweep["optimizer"]["regularization_weights"] = [10.0, 1e7]
+        fit = _train(
+            tmp_path,
+            {"fixed": fixed_sweep, "per_user": PER_USER, "per_movie": PER_MOVIE},
+            ["fixed", "per_user", "per_movie"],
+        )
+        assert fit.validation_metric < 0.45  # captured 0.3885 (single config)
+
     def test_standardization_matches_unnormalized(self, tmp_path):
         fit = _train(
             tmp_path,
