@@ -13,6 +13,11 @@ Captured baselines (this implementation, 2026-07-29, CPU float32):
   FE + user/movie   RMSE 0.3885
   FE + RE + stdz    RMSE 0.3875
 Thresholds below leave ~10-15% headroom, like the reference's gates.
+
+These captures are additionally anchored to INDEPENDENT oracles in
+test_oracle.py (scipy L-BFGS-B / sklearn / float64 closed forms on the
+same fixture and objective), so a systematic math bug shared by the
+capture run and these gates would still be caught there.
 """
 
 import json
