@@ -205,6 +205,11 @@ class FactoredRandomEffectCoordinate:
     matrix_configuration: GlmOptimizationConfiguration   # projection-matrix solve
     mf_configuration: MFOptimizationConfiguration
     base_offsets: np.ndarray
+    # multi-chip: entity-axis sharding re-applied after every offset rebuild
+    # (update_offsets produces host arrays — same contract as
+    # RandomEffectCoordinate.mesh/_place)
+    mesh: Optional[object] = None
+    mesh_axes: Optional[tuple] = None
 
     def __post_init__(self) -> None:
         # RANDOM-projected datasets carry no per-column global index map
@@ -227,12 +232,21 @@ class FactoredRandomEffectCoordinate:
         B = rng.standard_normal((self.dataset.global_dim, k)) / np.sqrt(k)
         return jnp.asarray(B.astype(np.float32))
 
+    def _place(self, ds: RandomEffectDataset) -> RandomEffectDataset:
+        if self.mesh is None:
+            return ds
+        from photon_ml_tpu.data.random_effect import place_dataset
+
+        return place_dataset(ds, self.mesh, self.mesh_axes)
+
     def update_model(
         self,
         model: Optional[FactoredRandomEffectModel],
         residual_scores: np.ndarray,
     ) -> FactoredRandomEffectModel:
-        ds = self.dataset.update_offsets(self.base_offsets + residual_scores)
+        ds = self._place(
+            self.dataset.update_offsets(self.base_offsets + residual_scores)
+        )
         B = model.projection_matrix if model is not None else self._init_matrix()
         latent_model = model.latent if model is not None else None
 

@@ -197,15 +197,6 @@ class GameEstimator:
             offsets=data.offsets,
             weights=data.weights,
         )
-        if isinstance(cfg, FactoredRandomEffectCoordinateConfiguration):
-            return FactoredRandomEffectCoordinate(
-                dataset=re_ds,
-                task=self.task,
-                re_configuration=cfg.optimizer,
-                matrix_configuration=cfg.matrix_optimizer or cfg.optimizer,
-                mf_configuration=cfg.mf,
-                base_offsets=data.offsets,
-            )
         mesh = None
         mesh_axes = None
         if self.parallel is not None:
@@ -218,8 +209,22 @@ class GameEstimator:
             n_dev = self.parallel.n_data * self.parallel.n_feat
             mesh = self._mesh
             mesh_axes = (DATA_AXIS, FEAT_AXIS)
+            # entity-axis sharding over every device of the grid — for the
+            # factored coordinate too (its latent datasets derive from these
+            # arrays, so the per-entity solves inherit the placement)
             re_ds = place_dataset(
                 pad_entities_to_multiple(re_ds, n_dev), mesh, mesh_axes
+            )
+        if isinstance(cfg, FactoredRandomEffectCoordinateConfiguration):
+            return FactoredRandomEffectCoordinate(
+                dataset=re_ds,
+                task=self.task,
+                re_configuration=cfg.optimizer,
+                matrix_configuration=cfg.matrix_optimizer or cfg.optimizer,
+                mf_configuration=cfg.mf,
+                base_offsets=data.offsets,
+                mesh=mesh,
+                mesh_axes=mesh_axes,
             )
         return RandomEffectCoordinate(
             dataset=re_ds,
