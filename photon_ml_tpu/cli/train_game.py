@@ -75,9 +75,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--output-dir", required=True)
     p.add_argument("--num-outer-iterations", type=int, default=None,
                    help="overrides the config file's num_outer_iterations (default 1)")
-    p.add_argument("--evaluator", default=None,
-                   help="e.g. AUC, RMSE, or sharded 'AUC:userId' "
-                        "(reference MultiEvaluatorType syntax)")
+    p.add_argument("--evaluator", nargs="+", default=None,
+                   help="one or more of AUC, RMSE, PRECISION@k, or sharded "
+                        "'AUC:userId' / 'PRECISION@5:userId' (reference "
+                        "MultiEvaluatorType syntax). The FIRST selects the "
+                        "best model; all are logged per coordinate per "
+                        "iteration (CoordinateDescent.scala:283-293)")
     p.add_argument("--normalization-type", default="NONE",
                    choices=[n.name for n in NormalizationType])
     p.add_argument("--offheap-indexmap-dir", default=None)
@@ -138,18 +141,34 @@ def _sweep_model_configs(sweeps, coordinates):
 
 
 def _make_evaluator(spec: Optional[str], task: TaskType, data):
-    """'AUC' or 'AUC:idTag' → Evaluator / MultiEvaluator bound to the
-    validation id tag (reference MultiEvaluatorType.scala:46-60)."""
+    """'AUC', 'AUC:idTag', or 'PRECISION@k[:idTag]' → Evaluator /
+    MultiEvaluator bound to the validation id tag (reference
+    MultiEvaluatorType.scala:46-60 parses exactly these spellings)."""
     if not spec:
         return None
     name, _, tag = spec.partition(":")
-    base = evaluator_for(EvaluatorType[name.strip().upper()])
+    name = name.strip().upper()
+    if name.startswith("PRECISION@"):
+        from photon_ml_tpu.evaluation.evaluators import PrecisionAtK
+
+        try:
+            k = int(name[len("PRECISION@"):])
+        except ValueError:
+            raise ValueError(
+                f"bad precision@k spelling {name!r}; expected PRECISION@<int>"
+            )
+        if k <= 0:
+            raise ValueError(f"precision@k needs k >= 1, got {k}")
+        base = PrecisionAtK(k)
+    else:
+        base = evaluator_for(EvaluatorType[name])
     if not tag:
         return base
-    ids = data.id_tags.get(tag.strip())
+    tag = tag.strip()
+    ids = data.id_tags.get(tag)
     if ids is None:
         raise ValueError(f"validation data has no id tag '{tag}'")
-    return MultiEvaluator(base=base, group_ids=tuple(ids))
+    return MultiEvaluator(base=base, group_ids=tuple(ids), tag=tag)
 
 
 def _save_feature_stats(output_dir, shard, summary, index_map) -> None:
@@ -240,8 +259,8 @@ def run(args: argparse.Namespace) -> GameFit:
         # a sharded evaluator ('AUC:tag') needs its tag in the validation read
         # even when no coordinate uses it
         val_tags = list(id_tags)
-        if args.evaluator and ":" in args.evaluator:
-            tag = args.evaluator.partition(":")[2].strip()
+        for spec in args.evaluator or []:
+            tag = spec.partition(":")[2].strip()
             if tag and tag not in val_tags:
                 val_tags.append(tag)
 
@@ -294,11 +313,19 @@ def run(args: argparse.Namespace) -> GameFit:
                         intercept_index=intercept_indices[sid],
                     )
 
-        evaluator = (
-            _make_evaluator(args.evaluator, task, validation_data)
-            if validation_data is not None
-            else None
-        )
+        if args.evaluator and not all(s.strip() for s in args.evaluator):
+            raise ValueError(
+                "--evaluator got an empty spec (check shell quoting); "
+                f"specs were {args.evaluator!r}"
+            )
+        evaluator = None
+        extra_evaluators = []
+        if validation_data is not None and args.evaluator:
+            evaluator = _make_evaluator(args.evaluator[0], task, validation_data)
+            extra_evaluators = [
+                _make_evaluator(s, task, validation_data)
+                for s in args.evaluator[1:]
+            ]
         parallel = None
         if args.parallel_data > 0:
             from photon_ml_tpu.estimators.game import ParallelConfiguration
@@ -318,6 +345,7 @@ def run(args: argparse.Namespace) -> GameFit:
                 else int(raw_config.get("num_outer_iterations", 1))
             ),
             evaluator=evaluator,
+            extra_evaluators=extra_evaluators,
             normalization=normalization,
             intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
             parallel=parallel,

@@ -148,12 +148,18 @@ class GameEstimator:
         normalization: Optional[Dict[str, NormalizationContext]] = None,
         intercept_indices: Optional[Dict[str, int]] = None,
         parallel: Optional[ParallelConfiguration] = None,
+        extra_evaluators: Sequence[Evaluator] = (),
     ) -> None:
         """``normalization``/``intercept_indices`` are per-feature-shard;
         they apply to fixed-effect coordinates (training runs in normalized
         space, coefficients are mapped back after each solve — reference
         prepareNormalizationContexts, GameEstimator.scala). Random-effect
-        locals are index-map projected and train unnormalized."""
+        locals are index-map projected and train unnormalized.
+
+        ``evaluator`` selects best models; ``extra_evaluators`` are
+        additionally computed and logged per coordinate per CD iteration
+        (the reference logs EVERY configured evaluator there,
+        CoordinateDescent.scala:283-293) without affecting selection."""
         if not coordinates:
             raise ValueError("need at least one coordinate configuration")
         self.task = task
@@ -161,6 +167,7 @@ class GameEstimator:
         self.update_order = list(update_order) if update_order else list(coordinates)
         self.num_outer_iterations = num_outer_iterations
         self.evaluator = evaluator or default_evaluator(task)
+        self.extra_evaluators = list(extra_evaluators)
         self.normalization = dict(normalization or {})
         self.intercept_indices = dict(intercept_indices or {})
         self.parallel = parallel
@@ -504,9 +511,27 @@ class GameEstimator:
             def validate(models: Dict[str, object]) -> float:
                 gm = GameModel(models=dict(models), meta=meta, task=self.task)
                 scores = gm.score(validation_data) + validation_data.offsets
-                return self.evaluator.evaluate(
+                primary = self.evaluator.evaluate(
                     scores, validation_data.labels, validation_data.weights
                 )
+                if self.extra_evaluators:
+                    # reference CoordinateDescent.scala:283-293: every
+                    # configured evaluator is computed and logged per
+                    # coordinate update; only the first drives selection
+                    extras = {
+                        ev.name: ev.evaluate(
+                            scores,
+                            validation_data.labels,
+                            validation_data.weights,
+                        )
+                        for ev in self.extra_evaluators
+                    }
+                    logger.info(
+                        "validation metrics: %s=%.6f %s",
+                        self.evaluator.name, primary,
+                        " ".join(f"{k}={v:.6f}" for k, v in extras.items()),
+                    )
+                return primary
 
         cd = CoordinateDescent(
             coordinates,

@@ -227,10 +227,11 @@ class MultiEvaluator:
 
     base: Evaluator
     group_ids: tuple  # hashable snapshot of per-row group keys
+    tag: Optional[str] = None  # the id-tag name, for log/metric labels
 
     @property
     def name(self) -> str:
-        return f"{self.base.name}:grouped"
+        return f"{self.base.name}:{self.tag or 'grouped'}"
 
     @property
     def larger_is_better(self) -> bool:
