@@ -125,6 +125,7 @@ class GameEstimatorEvaluationFunction:
             update_order=self.estimator.update_order,
             num_outer_iterations=self.estimator.num_outer_iterations,
             evaluator=self.estimator.evaluator,
+            extra_evaluators=self.estimator.extra_evaluators,
             normalization=self.estimator.normalization,
             intercept_indices=self.estimator.intercept_indices,
             parallel=self.estimator.parallel,
