@@ -532,7 +532,9 @@ def main():
             print(f"auc clock failed: {e}", file=sys.stderr)
     if not args.skip_grid:
         try:
-            extras["grid16m_passes_per_s"] = round(_grid_northstar("benes"), 1)
+            grid_engine = "benes" if args.engine in ("all", "ell") else args.engine
+            extras["grid16m_passes_per_s"] = round(_grid_northstar(grid_engine), 1)
+            extras["grid16m_engine"] = grid_engine
             extras["grid16m_dim"] = D_GRID
         except Exception as e:  # pragma: no cover
             print(f"grid north-star failed: {e}", file=sys.stderr)
