@@ -532,7 +532,7 @@ def main():
             print(f"auc clock failed: {e}", file=sys.stderr)
     if not args.skip_grid:
         try:
-            grid_engine = "benes" if args.engine in ("all", "ell") else args.engine
+            grid_engine = "benes" if args.engine == "all" else args.engine
             extras["grid16m_passes_per_s"] = round(_grid_northstar(grid_engine), 1)
             extras["grid16m_engine"] = grid_engine
             extras["grid16m_dim"] = D_GRID

@@ -1,0 +1,206 @@
+"""ONE batched TPU measurement session — run the moment the tunnel is up.
+
+    python dev-scripts/tpu_session.py [--out TPU_MEASUREMENTS.json]
+
+The device tunnel flaps for hours at a time, so every real-TPU measurement
+the repo needs is batched into this single process (pay the startup and
+compile cost once):
+
+1. preflight — prove the backend answers (60s timeout, 3 attempts).
+2. fused-engine validation — dev-scripts/tpu_validate_fused.py as a child
+   process: hardware-lowering correctness vs ELL + benes/fused timings.
+3. bench — python bench.py (full engine A/B + AUC clock + 16M grid shard);
+   its JSON line is captured verbatim.
+4. kernel microbenchmarks — matvec/rmatvec wall time per engine at bench
+   scale with derived achieved HBM GB/s (bytes moved per linear map are
+   computed from the layouts; see docs/SCALING.md), the utilization
+   numbers VERDICT r3 asked for.
+
+Everything lands in ONE json file (default TPU_MEASUREMENTS.json at the
+repo root) plus a human summary on stderr, including the recommended
+`auto` engine = argmax of measured throughput. Each phase is independent:
+a failure records an "error" entry and the session continues.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def _preflight(timeout_s: int = 60, attempts: int = 3) -> None:
+    code = "import jax, jax.numpy as jnp; jax.block_until_ready(jnp.arange(4).sum())"
+    for i in range(attempts):
+        try:
+            subprocess.run([sys.executable, "-c", code], timeout=timeout_s, check=True)
+            return
+        except Exception as e:
+            print(f"preflight {i + 1}/{attempts} failed: {type(e).__name__}",
+                  file=sys.stderr)
+            time.sleep(30)
+    raise SystemExit("backend unreachable; try again when the tunnel is up")
+
+
+def _phase_validate(results: dict) -> None:
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "dev-scripts", "tpu_validate_fused.py")],
+        capture_output=True, text=True, timeout=1800,
+    )
+    results["validate_fused"] = {
+        "returncode": proc.returncode,
+        "stdout": proc.stdout[-4000:],
+        "stderr": proc.stderr[-2000:],
+    }
+
+
+def _phase_bench(results: dict) -> None:
+    env = dict(os.environ, BENCH_WATCHDOG_S="2400")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=2700, env=env,
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "{}"
+    try:
+        results["bench"] = json.loads(line)
+    except json.JSONDecodeError:
+        results["bench"] = {"error": f"unparseable bench output: {line[:200]}"}
+    results["bench_stderr"] = proc.stderr[-2000:]
+
+
+def _phase_kernels(results: dict) -> None:
+    """Per-engine matvec/rmatvec wall times + achieved HBM bandwidth at the
+    bench FE shape. Byte accounting per linear map (f32):
+
+    - ell:   read values [n,K] + indices [n,K] (int32) + gathered w, write z
+             → ~(2·nnz + nnz + n)·4 bytes lower bound (gather granularity
+             makes the true figure higher; this is the optimistic bound the
+             % is measured against).
+    - benes: ~11 passes over the routed [S] array per map → ~11·S·4 bytes.
+    - fused: 2m+1 passes over [S] → ~(2m+1)·S·4 bytes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import fused_perm, sparse_perm
+    from photon_ml_tpu.ops.features import from_scipy_like
+
+    smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+    n, k, d = (1 << 12, 8, 1 << 10) if smoke else (1 << 18, 32, 1 << 17)
+    nnz = n * k
+    rng = np.random.default_rng(0)
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)
+    cols = rng.integers(0, d, nnz).astype(np.int64)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    w = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+    c = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+    out = {}
+    engines = {
+        "ell": lambda: from_scipy_like(rows, cols, vals, (n, d)),
+        "benes": lambda: sparse_perm.from_coo(rows, cols, vals, (n, d)),
+        "fused": lambda: fused_perm.from_coo(rows, cols, vals, (n, d)),
+    }
+    for name, build in engines.items():
+        try:
+            feats = build()
+            mv = jax.jit(feats.matvec)
+            rmv = jax.jit(feats.rmatvec)
+            jax.block_until_ready(mv(w))
+            jax.block_until_ready(rmv(c))
+            tm, tr = [], []
+            for _ in range(10):
+                t0 = time.perf_counter()
+                jax.block_until_ready(mv(w))
+                tm.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                jax.block_until_ready(rmv(c))
+                tr.append(time.perf_counter() - t0)
+            t_mv, t_rmv = min(tm), min(tr)
+            if name == "ell":
+                bytes_map = (3 * nnz + n) * 4
+            else:
+                S = feats.plan.size
+                m = sum(
+                    1 for kind in feats.plan.kinds if kind[0] == "enter"
+                )
+                passes = 11 if name == "benes" else 2 * m + 1
+                bytes_map = passes * S * 4
+            out[name] = {
+                "matvec_s": round(t_mv, 6),
+                "rmatvec_s": round(t_rmv, 6),
+                "achieved_GBps_matvec": round(bytes_map / t_mv / 1e9, 2),
+                "achieved_GBps_rmatvec": round(bytes_map / t_rmv / 1e9, 2),
+                "bytes_per_map": bytes_map,
+            }
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    results["kernels"] = out
+
+    # profiler trace for manual xprof inspection (small, one engine each)
+    trace_dir = os.path.join(REPO, "profile-traces")
+    try:
+        with jax.profiler.trace(trace_dir):
+            feats = engines["benes"]()
+            jax.block_until_ready(jax.jit(feats.matvec)(w))
+        results["trace_dir"] = trace_dir
+    except Exception as e:
+        results["trace_dir"] = f"trace failed: {e}"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(REPO, "TPU_MEASUREMENTS.json"))
+    ap.add_argument("--skip-validate", action="store_true")
+    ap.add_argument("--skip-bench", action="store_true")
+    ap.add_argument("--skip-kernels", action="store_true")
+    args = ap.parse_args()
+
+    _preflight()
+    results: dict = {"started_unix": time.time()}
+    phases = [
+        ("validate", _phase_validate, args.skip_validate),
+        ("bench", _phase_bench, args.skip_bench),
+        ("kernels", _phase_kernels, args.skip_kernels),
+    ]
+    for name, fn, skip in phases:
+        if skip:
+            continue
+        print(f"=== phase {name} ===", file=sys.stderr)
+        t0 = time.perf_counter()
+        try:
+            fn(results)
+        except Exception as e:
+            results[name + "_error"] = f"{type(e).__name__}: {e}"
+        print(f"=== phase {name} done in {time.perf_counter() - t0:.0f}s ===",
+              file=sys.stderr)
+        # persist after every phase: a mid-session tunnel death keeps
+        # everything measured so far
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+
+    engines = {
+        k: v
+        for k, v in results.get("bench", {}).get("engines", {}).items()
+        if k in ("ell", "benes", "fused")  # settable sparse_engine values
+    }
+    if engines:
+        rec = max(engines, key=engines.get)
+        results["recommended_auto_engine"] = rec
+        print(f"recommended auto engine (measured): {rec} {engines}",
+              file=sys.stderr)
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    print(f"session written to {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

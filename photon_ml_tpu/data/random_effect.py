@@ -234,27 +234,42 @@ def _pearson_scores(x: np.ndarray, y: np.ndarray, w: np.ndarray) -> np.ndarray:
     return np.where(const_nonzero, np.inf, corr)
 
 
-def _plan_buckets(sizes: np.ndarray, nb: int) -> np.ndarray:
+def _plan_buckets(samples: np.ndarray, dims: np.ndarray, nb: int) -> np.ndarray:
     """Entity → bucket assignment minimizing total padded cells.
 
-    Exact DP over ≤512 candidate boundaries on the size-sorted entities:
-    cost of a bucket spanning sorted ranks (j, i] is (count) x (max size),
-    the padded-cell bill of one [E, maxS, maxD]-style block. O(512² x nb)
-    regardless of entity count (candidates are count-quantile collapsed).
+    Exact DP over ≤512 candidate boundaries on entities sorted by
+    (samples, dims): the cost of a bucket spanning sorted ranks (j, i] is
+    count x maxS x maxD — the REAL padded-cell bill of one [E, maxS, maxD]
+    block, with the two maxima tracked separately (a product surrogate can
+    underestimate ~1000x when samples and dims anti-correlate). O(512² x
+    nb) regardless of entity count (candidates are count-quantile
+    collapsed, so boundaries are optimal at ~0.2% count granularity).
     The reference bounds the same skew with its partitioner + active cap
     (RandomEffectDataSet.scala:287-388); with dense padded blocks the
     bucket boundaries ARE the balancing mechanism, so they are optimized.
     """
-    n = len(sizes)
+    n = len(samples)
     if nb <= 1 or n <= 1:
         return np.zeros(n, dtype=np.int64)
-    order = np.argsort(sizes, kind="stable")
-    s_sorted = sizes[order].astype(np.float64)
+    order = np.lexsort((dims, samples))
+    s_sorted = samples[order].astype(np.float64)
+    d_sorted = dims[order].astype(np.float64)
     m = min(512, n)
     bounds = np.unique((np.arange(1, m + 1, dtype=np.int64) * n) // m)  # prefix counts
-    val = s_sorted[bounds - 1]          # max size of each candidate group
-    C = np.concatenate([[0], bounds]).astype(np.float64)  # [G+1] prefix counts
     G = len(bounds)
+    # group g covers sorted ranks [bounds[g-1], bounds[g]); sorted by
+    # samples, so a range's maxS is its LAST group's max; maxD needs a
+    # running max per range start
+    starts = np.concatenate([[0], bounds[:-1]])
+    grp_maxS = np.maximum.reduceat(s_sorted, starts)
+    grp_maxD = np.maximum.reduceat(d_sorted, starts)
+    # maxD[j, i-1] = max of groups j..i-1 (suffix cummax per row); an extra
+    # all-zero row for j = G keeps the cand matrix rectangular (that column
+    # is forbidden below anyway)
+    maxD = np.zeros((G + 1, G))
+    for j in range(G):
+        maxD[j, j:] = np.maximum.accumulate(grp_maxD[j:])
+    C = np.concatenate([[0], bounds]).astype(np.float64)  # [G+1] prefix counts
 
     # dp[j] = min cost of the first j candidate groups with at most k
     # buckets; splits[k][i-1] remembers the argmin boundary for backtrack
@@ -265,8 +280,11 @@ def _plan_buckets(sizes: np.ndarray, nb: int) -> np.ndarray:
     forbid = col > row  # bucket (j, i] needs j <= i-1, i = row+1
     splits = []
     for _ in range(nb):
-        # cand[i-1, j] = dp[j] + (C[i] - C[j]) * val[i-1]
-        cand = dp[None, :] + (C[1:, None] - C[None, :]) * val[:, None]
+        # cand[i-1, j] = dp[j] + (C[i] - C[j]) * maxS(j,i] * maxD(j,i]
+        cand = (
+            dp[None, :]
+            + (C[1:, None] - C[None, :]) * grp_maxS[:, None] * maxD.T
+        )  # maxD.T is [G, G+1]: rows i-1, cols j (col G forbidden below)
         cand[forbid] = np.inf
         arg = np.argmin(cand, axis=1)                      # [G]
         best = cand[np.arange(G), arg]
@@ -447,10 +465,12 @@ def build_random_effect_dataset(
     # both kinds of boundary where they pay (tests/test_ragged_stress.py
     # gates the measured overhead at <2x).
     nb = max(1, min(config.num_buckets, n_ent))
-    sizes = acounts * (
-        rproj.projected_dim if rproj else np.maximum(dlocs, 1)
+    dims_e = (
+        np.full(n_ent, rproj.projected_dim, dtype=np.int64)
+        if rproj
+        else np.maximum(dlocs, 1)
     )
-    bucket_of = _plan_buckets(sizes, nb)
+    bucket_of = _plan_buckets(acounts, dims_e, nb)
     nb = int(bucket_of.max()) + 1 if n_ent else 1
 
     # Resolve every active nonzero's local column once (INDEX_MAP only).
