@@ -46,7 +46,8 @@ def _preflight(timeout_s: int = 60, attempts: int = 3) -> None:
         except Exception as e:
             print(f"preflight {i + 1}/{attempts} failed: {type(e).__name__}",
                   file=sys.stderr)
-            time.sleep(30)
+            if i < attempts - 1:
+                time.sleep(30)
     raise SystemExit("backend unreachable; try again when the tunnel is up")
 
 
@@ -74,6 +75,22 @@ def _phase_bench(results: dict) -> None:
     except json.JSONDecodeError:
         results["bench"] = {"error": f"unparseable bench output: {line[:200]}"}
     results["bench_stderr"] = proc.stderr[-2000:]
+    # the recommendation depends only on bench data — write it NOW so a
+    # tunnel hang in a later phase cannot lose it
+    _recommend(results)
+
+
+def _recommend(results: dict) -> None:
+    engines = {
+        k: v
+        for k, v in results.get("bench", {}).get("engines", {}).items()
+        if k in ("ell", "benes", "fused")  # settable sparse_engine values
+    }
+    if engines:
+        rec = max(engines, key=engines.get)
+        results["recommended_auto_engine"] = rec
+        print(f"recommended auto engine (measured): {rec} {engines}",
+              file=sys.stderr)
 
 
 def _phase_kernels(results: dict) -> None:
@@ -187,18 +204,6 @@ def main() -> None:
         with open(args.out, "w") as f:
             json.dump(results, f, indent=2)
 
-    engines = {
-        k: v
-        for k, v in results.get("bench", {}).get("engines", {}).items()
-        if k in ("ell", "benes", "fused")  # settable sparse_engine values
-    }
-    if engines:
-        rec = max(engines, key=engines.get)
-        results["recommended_auto_engine"] = rec
-        print(f"recommended auto engine (measured): {rec} {engines}",
-              file=sys.stderr)
-        with open(args.out, "w") as f:
-            json.dump(results, f, indent=2)
     print(f"session written to {args.out}", file=sys.stderr)
 
 

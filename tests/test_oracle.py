@@ -167,6 +167,77 @@ class TestFixedEffectOracle:
         np.testing.assert_allclose(w_ours, w_oracle, rtol=2e-3, atol=2e-3)
 
 
+class TestOwlqnAndPoissonOracle:
+    def test_owlqn_l1_matches_sklearn_lasso(self, rng):
+        """OWL-QN on squared loss + L1 vs sklearn Lasso: our objective
+        sum 0.5(z-y)² + λ||w||₁ equals n·(Lasso objective) at α = λ/n, so
+        the minimizers coincide — an external oracle for the orthant-wise
+        path (Andrew & Gao), which no other oracle test covers."""
+        from sklearn.linear_model import Lasso
+
+        n, d = 400, 15
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = rng.normal(size=d).astype(np.float32)
+        w_true[rng.choice(d, 6, replace=False)] = 0.0  # sparse truth
+        y = (X @ w_true + 0.05 * rng.normal(size=n)).astype(np.float32)
+        lam = 20.0
+
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates={"fixed": FixedEffectCoordinateConfiguration(
+                "g", GlmOptimizationConfiguration(
+                    optimizer_config=OptimizerConfig.lbfgs(
+                        tolerance=1e-10, max_iterations=500),
+                    regularization=RegularizationContext(RegularizationType.L1),
+                    regularization_weight=lam,
+                ),
+            )},
+        )
+        data = GameData(labels=y, feature_shards={"g": dense_to_shard(X)}, id_tags={})
+        fit = est.fit(data)
+        w_ours = np.asarray(fit.model.models["fixed"].coefficients.means)
+
+        sk = Lasso(alpha=lam / n, fit_intercept=False, tol=1e-12,
+                   max_iter=100000).fit(X.astype(np.float64), y)
+        np.testing.assert_allclose(w_ours, sk.coef_, rtol=5e-3, atol=5e-3)
+        # the L1 zero pattern must agree too
+        assert np.array_equal(np.abs(w_ours) < 1e-4, np.abs(sk.coef_) < 1e-4)
+
+    def test_poisson_l2_matches_scipy(self, rng):
+        """Poisson regression (BASELINE config 3's loss) vs scipy float64
+        L-BFGS-B on the exact objective sum(e^z - y z) + 0.5 λ||w||²."""
+        from scipy.optimize import minimize
+
+        n, d = 300, 10
+        X = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
+        w_true = (rng.normal(size=d) * 0.5).astype(np.float32)
+        y = rng.poisson(np.exp(X @ w_true)).astype(np.float32)
+        lam = 1.0
+
+        est = GameEstimator(
+            task=TaskType.POISSON_REGRESSION,
+            coordinates={"fixed": FixedEffectCoordinateConfiguration(
+                "g", L2(lam, optimizer_config=OptimizerConfig.lbfgs(
+                    tolerance=1e-10, max_iterations=300)),
+            )},
+        )
+        data = GameData(labels=y, feature_shards={"g": dense_to_shard(X)}, id_tags={})
+        fit = est.fit(data)
+        w_ours = np.asarray(fit.model.models["fixed"].coefficients.means)
+
+        X64, y64 = X.astype(np.float64), y.astype(np.float64)
+
+        def fg(w):
+            z = X64 @ w
+            ez = np.exp(z)
+            return (np.sum(ez - y64 * z) + 0.5 * lam * w @ w,
+                    X64.T @ (ez - y64) + lam * w)
+
+        res = minimize(fg, np.zeros(d), jac=True, method="L-BFGS-B",
+                       options={"maxiter": 500, "ftol": 1e-14, "gtol": 1e-10})
+        np.testing.assert_allclose(w_ours, res.x, rtol=2e-3, atol=2e-3)
+
+
 class TestRandomEffectOracle:
     def test_re_solves_match_per_entity_scipy(self, rng):
         """Every per-entity random-effect solve must match an independent
