@@ -9,7 +9,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from photon_ml_tpu.losses import LogisticLoss, SquaredLoss, make_glm_objective
+from photon_ml_tpu.losses import (
+    GlmObjective,
+    LogisticLoss,
+    SquaredLoss,
+    make_glm_objective,
+)
 from photon_ml_tpu.ops import DenseFeatures, LabeledData
 from photon_ml_tpu.opt import (
     GlmOptimizationConfiguration,
@@ -223,3 +228,103 @@ def test_warm_start_lambda_sweep_no_recompile(rng):
     r_low = jitted(r_high.w, data, jnp.float32(0.1))
     assert jitted._cache_size() == 1
     assert float(r_low.value) < float(r_high.value)
+
+
+# ---------------------------------------------------------------------------
+# Reference OptimizerIntegTest.scala:120-200: convergence-state invariants
+# over 100 random starts on the fake centroid objective (TestObjective.scala:
+# f(w) = 0.5*||w - CENTROID||^2, CENTROID = 4.0), vmapped into one batched
+# solve per optimizer instead of 100 sequential Spark jobs.
+# ---------------------------------------------------------------------------
+
+_CENTROID = 4.0
+
+
+def _centroid_objective():
+    def value(w, data, l2):
+        d = w - _CENTROID
+        return 0.5 * jnp.dot(d, d)
+
+    def value_and_grad(w, data, l2):
+        d = w - _CENTROID
+        return 0.5 * jnp.dot(d, d), d
+
+    def hessian_vec(w, v, data, l2):
+        return v
+
+    def hessian_diag(w, data, l2):
+        return jnp.ones_like(w)
+
+    return GlmObjective(
+        value=value,
+        value_and_grad=value_and_grad,
+        hessian_vec=hessian_vec,
+        hessian_diag=hessian_diag,
+        has_hessian=True,
+    )
+
+
+@pytest.mark.parametrize(
+    "name,batched_solver",
+    [
+        (
+            "lbfgs",
+            lambda obj, cfg: jax.jit(jax.vmap(
+                lambda w0: lbfgs_solve(obj, w0, jnp.zeros(1), jnp.float32(0.0), cfg)
+            )),
+        ),
+        (
+            "tron",
+            lambda obj, cfg: jax.jit(jax.vmap(
+                lambda w0: tron_solve(obj, w0, jnp.zeros(1), jnp.float32(0.0), cfg)
+            )),
+        ),
+        (
+            "owlqn",
+            lambda obj, cfg: jax.jit(jax.vmap(
+                lambda w0: owlqn_solve(
+                    obj, w0, jnp.zeros(1), jnp.float32(0.0), jnp.float32(0.0), cfg
+                )
+            )),
+        ),
+    ],
+)
+def test_invariants_100_random_starts(rng, name, batched_solver):
+    """Every start must converge to the centroid with a monotone value
+    history and a reason consistent with its final state."""
+    d, n_starts = 10, 100
+    obj = _centroid_objective()
+    cfg = (
+        OptimizerConfig.tron(tolerance=1e-7, max_iterations=100)
+        if name == "tron"
+        else OptimizerConfig.lbfgs(tolerance=1e-7, max_iterations=200)
+    )
+    starts = jnp.asarray(rng.normal(size=(n_starts, d)).astype(np.float32) * 10)
+    res = batched_solver(obj, cfg)(starts)
+
+    reasons = np.asarray(res.reason)
+    assert np.all(reasons != ConvergenceReason.NOT_CONVERGED.value)
+    assert np.all(reasons != ConvergenceReason.MAX_ITERATIONS.value), (
+        f"{name}: some starts hit max iterations: "
+        f"{np.bincount(reasons, minlength=5)}"
+    )
+    # expected parameters (reference PARAMETER_TOLERANCE=1e-4, f64; f32 here)
+    w = np.asarray(res.w)
+    np.testing.assert_allclose(w, _CENTROID, atol=5e-3)
+
+    # reason-consistent final state (OBJECTIVE/GRADIENT_TOLERANCE analogs)
+    values = np.asarray(res.value)
+    gnorms = np.asarray(res.grad_norm)
+    f_conv = reasons == ConvergenceReason.FUNCTION_VALUES_CONVERGED.value
+    g_conv = reasons == ConvergenceReason.GRADIENT_CONVERGED.value
+    assert np.all(values[f_conv] < 1e-4)
+    assert np.all(gnorms[g_conv] < 1e-2)
+
+    # monotone non-increasing value history over the tracked prefix
+    hist = np.asarray(res.value_history)  # [starts, max_iter+1], NaN padded
+    valid = ~np.isnan(hist)
+    diffs = np.diff(hist, axis=1)
+    ok = np.isnan(diffs) | (diffs <= 1e-5)
+    assert np.all(ok[valid[:, :-1] & valid[:, 1:]]), (
+        f"{name}: objective increased somewhere in the tracked history"
+    )
