@@ -202,6 +202,22 @@ def load_game_config(path: str) -> Tuple[
     return shards, coordinates, update_order, raw
 
 
+def expand_data_dirs(
+    dirs: List[str],
+    date_range: Optional[str],
+    days_ago: Optional[str],
+) -> List[str]:
+    """Date-range expansion shared by the drivers (reference
+    --train-date-range / --date-range): each dir expands to its daily
+    yyyy/MM/dd subdirs; an empty result fails fast."""
+    from photon_ml_tpu.utils.date_range import paths_for_date_range
+
+    out = paths_for_date_range(dirs, date_range, days_ago)
+    if not out:
+        raise FileNotFoundError(f"no input dirs in date range under {dirs}")
+    return out
+
+
 def load_index_maps(
     offheap_dir: Optional[str],
     shard_ids,

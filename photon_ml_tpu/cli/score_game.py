@@ -41,12 +41,31 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--data-dirs", nargs="+", required=True)
     p.add_argument("--model-dir", required=True)
     p.add_argument("--output-dir", required=True)
+    p.add_argument("--date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd; expands each data dir to its "
+                        "daily yyyy/MM/dd subdirs (reference --date-range)")
+    p.add_argument("--date-days-ago", default=None,
+                   help="start-end days ago, e.g. 90-1 (reference "
+                        "--date-range-days-ago)")
     p.add_argument("--model-id", default=None,
                    help="modelId stamped on ScoringResultAvro records "
                         "(defaults to the saved model name)")
+    p.add_argument("--offheap-indexmap-dir", default=None,
+                   help="score through prebuilt off-heap index stores "
+                        "instead of the maps reconstructed from the model "
+                        "(reference --offheap-indexmap-dir)")
+    def _positive_int(v: str) -> int:
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+        return n
+
+    p.add_argument("--num-output-files", type=_positive_int, default=None,
+                   help="partition the score output into this many part "
+                        "files (reference --num-files)")
     p.add_argument("--evaluator", default=None,
-                   help="optional metric over scored data, e.g. AUC or "
-                        "'RMSE:userId'")
+                   help="optional metric over scored data, e.g. AUC, "
+                        "'RMSE:userId', or 'PRECISION@5:userId'")
     p.add_argument("--log-file", default=None)
     return p.parse_args(argv)
 
@@ -55,8 +74,13 @@ def run(args: argparse.Namespace) -> Optional[float]:
     logger = setup_logger(args.log_file)
     timer = Timer()
 
-    with timer.time("load model"):
-        model, index_maps = load_game_model(args.model_dir)
+    # a bad date spec must fail before the (possibly huge) model load
+    from photon_ml_tpu.cli.common import expand_data_dirs
+
+    data_dirs = expand_data_dirs(
+        args.data_dirs, args.date_range, args.date_days_ago
+    )
+
     metadata = load_game_model_metadata(args.model_dir)
     model_id = args.model_id or metadata.get("modelName", "game-model")
 
@@ -68,6 +92,31 @@ def run(args: argparse.Namespace) -> Optional[float]:
         shard_bags[sid] = FeatureShardConfiguration(
             feature_bags=s["feature_bags"],
             add_intercept=bool(s.get("add_intercept", True)),
+        )
+
+    preloaded_maps = None
+    if args.offheap_indexmap_dir:
+        from photon_ml_tpu.cli.common import load_index_maps
+
+        if not shard_bags:
+            raise ValueError(
+                "--offheap-indexmap-dir needs the model metadata to name "
+                "its feature shards (configurations.feature_shards); this "
+                "model carries none, so the off-heap stores cannot be "
+                "bound to shards"
+            )
+        with timer.time("load index maps"):
+            preloaded_maps = load_index_maps(
+                args.offheap_indexmap_dir, shard_bags
+            )
+        logger.info(
+            "scoring through off-heap index stores for shards: %s",
+            sorted(preloaded_maps),
+        )
+
+    with timer.time("load model"):
+        model, index_maps = load_game_model(
+            args.model_dir, index_maps=preloaded_maps
         )
     for sid in index_maps:
         shard_bags.setdefault(
@@ -88,7 +137,7 @@ def run(args: argparse.Namespace) -> Optional[float]:
             id_tags.append(tag)
     with timer.time("read data"):
         data, _, uids = read_game_data(
-            args.data_dirs, shard_bags, index_maps,
+            data_dirs, shard_bags, index_maps,
             id_tags=id_tags, is_response_required=False,
         )
     logger.info("scoring rows: %d", data.num_rows)
@@ -102,6 +151,9 @@ def run(args: argparse.Namespace) -> Optional[float]:
         if jax.process_index() != 0:
             n = 0  # single writer on shared filesystems
         else:
+            per_file = 1_000_000
+            if args.num_output_files:
+                per_file = max(1, -(-data.num_rows // args.num_output_files))
             n = save_scores(
                 args.output_dir,
                 (
@@ -117,6 +169,7 @@ def run(args: argparse.Namespace) -> Optional[float]:
                     )
                 ),
                 model_id=model_id,
+                records_per_file=per_file,
             )
     logger.info("saved %d scores to %s", n, args.output_dir)
 

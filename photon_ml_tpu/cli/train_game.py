@@ -239,15 +239,11 @@ def run(args: argparse.Namespace) -> GameFit:
         with timer.time("prepare feature maps"):
             index_maps = load_index_maps(args.offheap_indexmap_dir, shard_configs)
 
-        from photon_ml_tpu.utils.date_range import paths_for_date_range
+        from photon_ml_tpu.cli.common import expand_data_dirs
 
-        train_dirs = paths_for_date_range(
+        train_dirs = expand_data_dirs(
             args.train_data_dirs, args.train_date_range, args.train_date_days_ago
         )
-        if not train_dirs:
-            raise FileNotFoundError(
-                f"no input dirs in date range under {args.train_data_dirs}"
-            )
 
         id_tags = id_tags_needed(coordinates)
         with timer.time("read training data"):

@@ -377,9 +377,13 @@ def _record_sparse(
     imap: Optional[IndexMap],
     builder: Optional["_MapBuilder"],
     positional: bool = False,
+    dropped: Optional[List[int]] = None,
 ) -> Dict[int, float]:
     """NameTermValue list → {index: value}; builds a compact index on the
-    fly when no map is given (reference load-without-index behavior)."""
+    fly when no map is given (reference load-without-index behavior).
+    Coefficients whose feature is absent from a provided map are counted
+    into ``dropped`` (a one-element list) — silently losing model weight
+    against a mismatched index must at least be visible to the caller."""
     out: Dict[int, float] = {}
     arr = record.get(field) or []
     for ntv in arr:
@@ -391,6 +395,8 @@ def _record_sparse(
         if imap is not None:
             idx = imap.get_index(key)
             if idx < 0:
+                if dropped is not None:
+                    dropped[0] += 1
                 continue
         else:
             assert builder is not None
@@ -439,6 +445,8 @@ def load_game_model(
         if ent.get("positional"):
             positional_shards.add(shard)
 
+    dropped = [0]  # coefficients lost to a mismatched provided index map
+
     def map_for(shard: str) -> Tuple[Optional[IndexMap], Optional[_MapBuilder]]:
         if index_maps is not None and shard in index_maps:
             return index_maps[shard], None
@@ -462,8 +470,12 @@ def load_game_model(
                     f"{cid}: expected one fixed-effect GLM, got {len(records)}"
                 )
             rec = records[0]
-            means = _record_sparse(rec, "means", imap, builder, positional)
-            variances = _record_sparse(rec, "variances", imap, builder, positional)
+            means = _record_sparse(
+                rec, "means", imap, builder, positional, dropped=dropped
+            )
+            variances = _record_sparse(
+                rec, "variances", imap, builder, positional, dropped=dropped
+            )
             models[cid] = (rec, means, variances or None)
             meta[cid] = CoordinateMeta(feature_shard=shard)
 
@@ -482,8 +494,12 @@ def load_game_model(
             entity_vars: Dict[str, Dict[int, float]] = {}
             for rec in read_avro_dir(os.path.join(cdir, COEFFICIENTS)):
                 eid = rec["modelId"]
-                entity_coefs[eid] = _record_sparse(rec, "means", imap, builder, positional)
-                v = _record_sparse(rec, "variances", imap, builder, positional)
+                entity_coefs[eid] = _record_sparse(
+                    rec, "means", imap, builder, positional, dropped=dropped
+                )
+                v = _record_sparse(
+                    rec, "variances", imap, builder, positional, dropped=dropped
+                )
                 if v:
                     entity_vars[eid] = v
             re_specs[cid] = (re_type, shard, entity_coefs, entity_vars)
@@ -493,6 +509,15 @@ def load_game_model(
 
     if not models and not re_specs:
         raise ValueError(f"no models could be loaded from: {models_dir}")
+    if dropped[0]:
+        import logging
+
+        logging.getLogger("photon_ml_tpu").warning(
+            "%d model coefficients were DROPPED because their features are "
+            "absent from the provided index maps — scores will differ from "
+            "the saved model (was the index built from different data?)",
+            dropped[0],
+        )
 
     # Finalize index maps (builders are complete only after every coordinate
     # sharing the shard has been scanned).

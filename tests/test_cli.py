@@ -260,6 +260,24 @@ class TestScoreGameDriver:
         assert got[0].uid == "r0"
         assert got[0].id_tags["userId"] == "user0"
 
+        # --num-output-files partitions the output (reference --num-files);
+        # scores must be identical across the partitioning
+        scores3_dir = tmp_path / "scores3"
+        metric3 = score_run(score_args([
+            "--data-dirs", str(glmix_avro["test"]),
+            "--model-dir", str(out / "best"),
+            "--output-dir", str(scores3_dir),
+            "--evaluator", "AUC",
+            "--num-output-files", "3",
+        ]))
+        assert metric3 == metric
+        parts = sorted(p.name for p in scores3_dir.glob("part-*.avro"))
+        assert len(parts) == 3, parts
+        got3 = list(load_scores(str(scores3_dir)))
+        assert [s.prediction_score for s in got3] == [
+            s.prediction_score for s in got
+        ]
+
 
 class TestLegacyGlmDriver:
     def test_lambda_sweep_selects_best(self, glmix_avro, tmp_path):

@@ -181,6 +181,20 @@ class TestGoldenRatings:
         ]))
         assert fit.validation_metric < 0.45
 
+        # scoring through the same off-heap stores (reference scoring
+        # Params --offheap-indexmap-dir) must hit the same gate
+        from photon_ml_tpu.cli.score_game import parse_args as sargs
+        from photon_ml_tpu.cli.score_game import run as srun
+
+        metric = srun(sargs([
+            "--data-dirs", os.path.join(HERE, "test"),
+            "--model-dir", str(tmp_path / "out_offheap" / "best"),
+            "--output-dir", str(tmp_path / "scores_offheap"),
+            "--evaluator", "RMSE",
+            "--offheap-indexmap-dir", str(tmp_path / "idx"),
+        ]))
+        assert metric < 0.45
+
     def test_scoring_round_trip_on_fixture(self, tmp_path):
         from photon_ml_tpu.cli.score_game import parse_args as sargs
         from photon_ml_tpu.cli.score_game import run as srun
