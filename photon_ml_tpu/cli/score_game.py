@@ -151,9 +151,13 @@ def run(args: argparse.Namespace) -> Optional[float]:
         if jax.process_index() != 0:
             n = 0  # single writer on shared filesystems
         else:
-            per_file = 1_000_000
+            file_sizes = None
             if args.num_output_files:
-                per_file = max(1, -(-data.num_rows // args.num_output_files))
+                # exactly N part files (reference --num-files), the first
+                # rows % N of them one record larger
+                nf = args.num_output_files
+                base, rem = divmod(data.num_rows, nf)
+                file_sizes = [base + (1 if i < rem else 0) for i in range(nf)]
             n = save_scores(
                 args.output_dir,
                 (
@@ -169,7 +173,7 @@ def run(args: argparse.Namespace) -> Optional[float]:
                     )
                 ),
                 model_id=model_id,
-                records_per_file=per_file,
+                file_sizes=file_sizes,
             )
     logger.info("saved %d scores to %s", n, args.output_dir)
 

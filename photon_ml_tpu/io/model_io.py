@@ -470,12 +470,12 @@ def load_game_model(
                     f"{cid}: expected one fixed-effect GLM, got {len(records)}"
                 )
             rec = records[0]
+            # count drops on means only: variances share the same feature
+            # keys, and double-counting would report a 2x mismatch
             means = _record_sparse(
                 rec, "means", imap, builder, positional, dropped=dropped
             )
-            variances = _record_sparse(
-                rec, "variances", imap, builder, positional, dropped=dropped
-            )
+            variances = _record_sparse(rec, "variances", imap, builder, positional)
             models[cid] = (rec, means, variances or None)
             meta[cid] = CoordinateMeta(feature_shard=shard)
 
@@ -497,9 +497,7 @@ def load_game_model(
                 entity_coefs[eid] = _record_sparse(
                     rec, "means", imap, builder, positional, dropped=dropped
                 )
-                v = _record_sparse(
-                    rec, "variances", imap, builder, positional, dropped=dropped
-                )
+                v = _record_sparse(rec, "variances", imap, builder, positional)
                 if v:
                     entity_vars[eid] = v
             re_specs[cid] = (re_type, shard, entity_coefs, entity_vars)

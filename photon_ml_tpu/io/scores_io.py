@@ -32,17 +32,29 @@ def save_scores(
     items: Iterable[ScoredItem],
     model_id: str,
     records_per_file: int = 1_000_000,
+    file_sizes: Optional[List[int]] = None,
 ) -> int:
-    """Write ScoringResultAvro part files under ``path``; returns count."""
+    """Write ScoringResultAvro part files under ``path``; returns count.
+
+    ``file_sizes`` forces an exact per-file record partition (the reference
+    --num-files contract: exactly N part files, empty ones included);
+    zero-sized entries may only TRAIL the list (records are assigned in
+    order). Otherwise files roll over every ``records_per_file`` records."""
     os.makedirs(path, exist_ok=True)
     schema = schemas.scoring_result_schema()
     total = 0
     part = 0
     batch: List[dict] = []
+    sizes = list(file_sizes) if file_sizes is not None else None
 
-    def flush() -> None:
+    def _current_cap() -> int:
+        if sizes is None:
+            return records_per_file
+        return sizes[part] if part < len(sizes) else max(sizes[-1], 1)
+
+    def flush(force: bool = False) -> None:
         nonlocal part, batch
-        if batch:
+        if batch or force:
             write_avro_file(
                 os.path.join(path, f"part-{part:05d}.avro"), schema, batch
             )
@@ -61,9 +73,12 @@ def save_scores(
             }
         )
         total += 1
-        if len(batch) >= records_per_file:
+        if len(batch) >= _current_cap():
             flush()
     flush()
+    if sizes is not None:
+        while part < len(sizes):
+            flush(force=True)  # empty trailing parts keep the exact count
     return total
 
 
