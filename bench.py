@@ -384,13 +384,23 @@ def _emit_failure(error: str) -> None:
         "vs_baseline": 0.0,
     }
     try:
-        # the watchdog thread may race a main-thread _PARTIAL.update; a
-        # failed snapshot must still produce the zeros line, never a hang
-        payload.update(dict(_PARTIAL))
-    except RuntimeError:
+        # the watchdog thread may race a main-thread _PARTIAL.update (and
+        # nested dicts may be live references); any serialization failure
+        # must still produce the zeros line, never a hang
+        snap = json.loads(json.dumps(dict(_PARTIAL), default=str))
+        payload.update(snap)
+    except Exception:
         pass
     payload["error"] = error
-    print(json.dumps(payload), flush=True)
+    try:
+        line = json.dumps(payload)
+    except Exception:
+        line = json.dumps(
+            {"metric": "glmix_logistic_train_throughput", "value": 0.0,
+             "unit": "example_passes/sec/chip", "vs_baseline": 0.0,
+             "error": error}
+        )
+    print(line, flush=True)
     sys.stderr.write(f"bench failure: {error}\n")
     os._exit(2 if not payload.get("value") else 3)
 
@@ -484,7 +494,7 @@ def main():
         engine_results["ell"] = round(passes / tpu_time, 1)
         best_fe_data = fe_data
         _PARTIAL.update(
-            value=round(passes / tpu_time, 1), engines=engine_results
+            value=round(passes / tpu_time, 1), engines=dict(engine_results)
         )
     else:
         passes, tpu_time, fe_iters, re_iters = None, None, None, None
@@ -507,7 +517,7 @@ def main():
                 passes, tpu_time, fe_iters, re_iters = e_passes, e_time, e_fe, e_re
                 best_fe_data = e_data
             _PARTIAL.update(
-                value=round(passes / tpu_time, 1), engines=engine_results
+                value=round(passes / tpu_time, 1), engines=dict(engine_results)
             )
         except Exception as e:  # pragma: no cover
             print(f"{engine} path failed: {e}", file=sys.stderr)
@@ -532,7 +542,7 @@ def main():
             if p_passes / p_time > passes / tpu_time:
                 passes, tpu_time, fe_iters, re_iters = p_passes, p_time, p_fe, p_re
             _PARTIAL.update(
-                value=round(passes / tpu_time, 1), engines=engine_results
+                value=round(passes / tpu_time, 1), engines=dict(engine_results)
             )
         except Exception as e:  # pragma: no cover
             print(f"pallas path failed, using XLA: {e}", file=sys.stderr)
@@ -546,7 +556,9 @@ def main():
             extras["wallclock_to_auc_s"] = round(secs, 3)
             extras["auc_target"] = round(target, 4)
             extras["auc_final"] = round(achieved, 4)
-            _PARTIAL.update(extras)
+            _PARTIAL.update(
+                {k: dict(v) if isinstance(v, dict) else v for k, v in extras.items()}
+            )
         except Exception as e:  # pragma: no cover
             print(f"auc clock failed: {e}", file=sys.stderr)
     if not args.skip_grid:
@@ -555,7 +567,9 @@ def main():
             extras["grid16m_passes_per_s"] = round(_grid_northstar(grid_engine), 1)
             extras["grid16m_engine"] = grid_engine
             extras["grid16m_dim"] = D_GRID
-            _PARTIAL.update(extras)
+            _PARTIAL.update(
+                {k: dict(v) if isinstance(v, dict) else v for k, v in extras.items()}
+            )
         except Exception as e:  # pragma: no cover
             print(f"grid north-star failed: {e}", file=sys.stderr)
 

@@ -172,12 +172,19 @@ def _make_evaluator(spec: Optional[str], task: TaskType, data):
 
 
 def _save_feature_stats(output_dir, shard, summary, index_map) -> None:
-    """writeBasicStatistics parity (ModelProcessingUtils.scala:560)."""
+    """Per-shard stats under <output-dir>/feature-stats/<shard>."""
+    write_feature_stats(
+        os.path.join(output_dir, "feature-stats", shard), summary, index_map
+    )
+
+
+def write_feature_stats(stats_dir, summary, index_map) -> None:
+    """writeBasicStatistics parity (ModelProcessingUtils.scala:560):
+    FeatureSummarizationResultAvro part files into ``stats_dir``."""
     import jax
 
     if jax.process_index() != 0:
         return  # single writer on shared filesystems
-    stats_dir = os.path.join(output_dir, "feature-stats", shard)
     os.makedirs(stats_dir, exist_ok=True)
     mean = np.asarray(summary.mean)
     var = np.asarray(summary.variance)

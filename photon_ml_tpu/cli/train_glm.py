@@ -25,7 +25,11 @@ from typing import List, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.cli.common import parse_optimizer_config, setup_logger
+from photon_ml_tpu.cli.common import (
+    load_index_maps,
+    parse_optimizer_config,
+    setup_logger,
+)
 from photon_ml_tpu.data.validators import (
     DataValidationType,
     validate_labeled_data,
@@ -78,6 +82,18 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    choices=[n.name for n in NormalizationType])
     p.add_argument("--coefficient-box-constraints", default=None,
                    help='JSON: {"lower": -1.0, "upper": 1.0}')
+    p.add_argument("--offheap-indexmap-dir", default=None,
+                   help="read features through prebuilt off-heap index "
+                        "stores (reference --offheap-indexmap-dir; AVRO "
+                        "input only)")
+    p.add_argument("--summarization-output-dir", default=None,
+                   help="write per-feature summary stats as "
+                        "FeatureSummarizationResultAvro (reference "
+                        "--summarization-output-dir)")
+    p.add_argument("--selected-features-file", default=None,
+                   help="Avro file of name/term records; training uses "
+                        "ONLY these features (reference "
+                        "--selected-features-file)")
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.name for v in DataValidationType])
     p.add_argument("--compute-variances", action="store_true")
@@ -92,6 +108,53 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "importance; reference Driver diagnose stage)")
     p.add_argument("--log-file", default=None)
     return p.parse_args(argv)
+
+
+def _filter_selected_features(data, imap, path: str, logger):
+    """Keep only features named in the Avro name/term file (+ intercept) —
+    reference GLMSuite.getSelectedFeatureSetFromFile:139-146: entries of
+    the COO shard whose feature key is not selected are dropped before
+    training (the model dimension is unchanged; unselected coefficients
+    simply never receive data)."""
+    import dataclasses as _dc
+
+    from photon_ml_tpu.indexmap import feature_key
+    from photon_ml_tpu.io.avro import read_avro_dir
+
+    selected = set()
+    for rec in read_avro_dir(path):
+        selected.add(feature_key(str(rec["name"]), str(rec.get("term") or "")))
+    if not selected:
+        raise ValueError(
+            f"--selected-features-file {path!r} yielded no name/term "
+            "records; refusing to silently train on ALL features"
+        )
+    keep_idx = np.array(
+        [
+            i
+            for i in range(len(imap))
+            if (key := imap.get_feature_name(i)) is not None
+            and (key in selected or key == INTERCEPT_KEY)
+        ],
+        dtype=np.int64,
+    )
+    keep_mask = np.zeros(len(imap), dtype=bool)
+    keep_mask[keep_idx] = True
+    shard = data.feature_shards["features"]
+    m = keep_mask[shard.cols]
+    logger.info(
+        "selected-features filter: %d/%d features kept, %d/%d entries",
+        len(keep_idx), len(imap), int(m.sum()), len(shard.cols),
+    )
+    return _dc.replace(
+        data,
+        feature_shards={
+            "features": _dc.replace(
+                shard,
+                rows=shard.rows[m], cols=shard.cols[m], vals=shard.vals[m],
+            )
+        },
+    )
 
 
 def _labeled_from_game(data, shard: str, norm=None) -> LabeledData:
@@ -150,6 +213,12 @@ def run(args: argparse.Namespace) -> dict:
 
                 if len(args.training_data_dirs) > 1:
                     raise ValueError("LIBSVM input takes a single path")
+                for flag in ("offheap_indexmap_dir", "selected_features_file"):
+                    if getattr(args, flag):
+                        raise ValueError(
+                            f"--{flag.replace('_', '-')} applies to AVRO "
+                            "input (LIBSVM features are positional)"
+                        )
                 data, imap = read_libsvm(
                     args.training_data_dirs[0],
                     use_intercept=args.add_intercept,
@@ -157,10 +226,17 @@ def run(args: argparse.Namespace) -> dict:
                 )
                 index_maps = {"features": imap}
             else:
+                preloaded = load_index_maps(
+                    args.offheap_indexmap_dir, shard_cfg
+                ) if args.offheap_indexmap_dir else None
                 data, index_maps, _ = read_game_data(
-                    args.training_data_dirs, shard_cfg
+                    args.training_data_dirs, shard_cfg, preloaded
                 )
                 imap = index_maps["features"]
+            if args.selected_features_file:
+                data = _filter_selected_features(
+                    data, imap, args.selected_features_file, logger
+                )
             labeled = _labeled_from_game(data, "features")
             validate_labeled_data(
                 labeled, task, DataValidationType[args.data_validation]
@@ -169,8 +245,15 @@ def run(args: argparse.Namespace) -> dict:
             intercept_index = icpt if icpt >= 0 else None
             norm = None
             norm_type = NormalizationType[args.normalization_type]
-            if norm_type is not NormalizationType.NONE:
+            if norm_type is not NormalizationType.NONE or args.summarization_output_dir:
                 summary = summarize(labeled)
+                if args.summarization_output_dir:
+                    from photon_ml_tpu.cli.train_game import write_feature_stats
+
+                    write_feature_stats(
+                        args.summarization_output_dir, summary, imap
+                    )
+            if norm_type is not NormalizationType.NONE:
                 norm = build_normalization_context(
                     norm_type,
                     mean=summary.mean,

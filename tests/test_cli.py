@@ -301,6 +301,57 @@ class TestLegacyGlmDriver:
         line = (out / "model-lambda-0.1.txt").read_text().splitlines()[0]
         assert len(line.split("\t")) == 3
 
+    def test_selected_features_summarization_and_offheap(self, glmix_avro, tmp_path):
+        """Legacy Driver parity: --selected-features-file restricts training
+        to the named features (GLMSuite.scala:139-146),
+        --summarization-output-dir writes FeatureSummarizationResultAvro,
+        and --offheap-indexmap-dir reads through prebuilt stores."""
+        from photon_ml_tpu.cli.build_index import parse_args as iargs
+        from photon_ml_tpu.cli.build_index import run as irun
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+        from photon_ml_tpu.io.avro import AvroSchema, read_avro_dir, write_avro_file
+
+        idx = tmp_path / "idx"
+        irun(iargs([
+            "--data-dirs", str(glmix_avro["train"]),
+            "--output-dir", str(idx),
+            "--feature-shard", "features=features",
+        ]))
+
+        # select only g/0 and g/1 of the six global features
+        sel_schema = AvroSchema({
+            "type": "record", "name": "FeatureNameTerm", "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": "string"},
+            ],
+        })
+        sel_dir = tmp_path / "selected"
+        sel_dir.mkdir()
+        write_avro_file(
+            str(sel_dir / "part-00000.avro"), sel_schema,
+            [{"name": "g", "term": "0"}, {"name": "g", "term": "1"}],
+        )
+        out = tmp_path / "out_sel"
+        summ = tmp_path / "summary"
+        result = run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--validation-data-dirs", str(glmix_avro["test"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "0.1",
+            "--offheap-indexmap-dir", str(idx),
+            "--selected-features-file", str(sel_dir),
+            "--summarization-output-dir", str(summ),
+        ]))
+        # summary written directly into the given dir
+        recs = list(read_avro_dir(str(summ)))
+        assert any(r["featureName"] == "g" for r in recs)
+        # model text: only the selected features (+ intercept) can be nonzero
+        txt = (out / "model-lambda-0.1.txt").read_text().splitlines()
+        names = {line.split("\t")[0] + ":" + line.split("\t")[1] for line in txt}
+        allowed = {"g:0", "g:1", "(INTERCEPT):"}
+        assert names <= allowed, names
+
     def test_normalization_types_reach_same_optimum(self, glmix_avro, tmp_path):
         """All normalization types converge to comparable validation metric
         (reference NormalizationTest invariant)."""
