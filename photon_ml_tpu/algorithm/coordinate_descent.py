@@ -116,8 +116,15 @@ class CoordinateDescent:
                     logger.info(
                         "CD iter %d coordinate %s: validation %.6f", outer, cid, metric
                     )
-                    if best_metric is None or self.validation_better_than(
-                        metric, best_metric
+                    # best-model tracking starts once EVERY coordinate has
+                    # trained: a mid-first-iteration snapshot would be a
+                    # partial model (missing whole coordinates on disk) —
+                    # the reference's snapshots always carry all
+                    # coordinates (CoordinateDescent.scala:265-294, its
+                    # models hold initial coefficients from the start)
+                    if all(c in models for c in self.update_order) and (
+                        best_metric is None
+                        or self.validation_better_than(metric, best_metric)
                     ):
                         best_metric = metric
                         best_models = dict(models)

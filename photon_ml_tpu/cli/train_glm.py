@@ -129,12 +129,13 @@ def _filter_selected_features(data, imap, path: str, logger):
             f"--selected-features-file {path!r} yielded no name/term "
             "records; refusing to silently train on ALL features"
         )
+    # forward-lookup the (small) selected set, not a reverse scan of the
+    # (possibly millions-large) index map
     keep_idx = np.array(
         [
-            i
-            for i in range(len(imap))
-            if (key := imap.get_feature_name(i)) is not None
-            and (key in selected or key == INTERCEPT_KEY)
+            idx
+            for idx in imap.get_indices(sorted(selected) + [INTERCEPT_KEY])
+            if idx >= 0
         ],
         dtype=np.int64,
     )
@@ -226,9 +227,7 @@ def run(args: argparse.Namespace) -> dict:
                 )
                 index_maps = {"features": imap}
             else:
-                preloaded = load_index_maps(
-                    args.offheap_indexmap_dir, shard_cfg
-                ) if args.offheap_indexmap_dir else None
+                preloaded = load_index_maps(args.offheap_indexmap_dir, shard_cfg)
                 data, index_maps, _ = read_game_data(
                     args.training_data_dirs, shard_cfg, preloaded
                 )

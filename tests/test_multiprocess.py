@@ -71,9 +71,10 @@ def _run_cluster(cmds, logs, env, timeout=240):
 def test_cli_cluster_training(tmp_path):
     """The production multi-host launch, end to end: two OS processes run
     the REAL train_game CLI with --coordinator-address/--num-processes/
-    --process-id, train a grid-parallel GAME model over the joint 8-device
-    mesh, and exactly one process (0) writes the model to the shared
-    output directory."""
+    --process-id, sweep TWO fixed-effect λ configs (fit_multiple across the
+    cluster, per-config digest-keyed checkpoints, validation-evaluator
+    selection) over the joint 8-device grid mesh, and exactly one process
+    (0) writes the winning model to the shared output directory."""
     import json
 
     import numpy as np
@@ -82,21 +83,32 @@ def test_cli_cluster_training(tmp_path):
 
     rng = np.random.default_rng(7)
     n_users, rows, dg, du = 6, 30, 6, 3
+    wg = rng.normal(size=dg)
     train_dir = tmp_path / "train"
+    val_dir = tmp_path / "val"
     train_dir.mkdir()
-    records = []
-    for i in range(n_users * rows):
-        user = f"user{i % n_users}"
-        xg = rng.normal(size=dg)
-        xu = rng.normal(size=du)
-        records.append({
-            "uid": f"r{i}",
-            "label": float(rng.integers(0, 2)),
-            "features": [("g", str(j), xg[j]) for j in range(dg)],
-            "userFeatures": [("u", str(j), xu[j]) for j in range(du)],
-            "metadataMap": {"userId": user},
-        })
+    val_dir.mkdir()
+
+    def make(n, seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for i in range(n):
+            user = f"user{i % n_users}"
+            xg = r.normal(size=dg)
+            xu = r.normal(size=du)
+            y = 1.0 if 1 / (1 + np.exp(-(xg @ wg))) > r.random() else 0.0
+            out.append({
+                "uid": f"r{i}",
+                "label": y,
+                "features": [("g", str(j), xg[j]) for j in range(dg)],
+                "userFeatures": [("u", str(j), xu[j]) for j in range(du)],
+                "metadataMap": {"userId": user},
+            })
+        return out
+
+    records = make(n_users * rows, 1)
     write_training_examples(str(train_dir / "part-00000.avro"), records)
+    write_training_examples(str(val_dir / "part-00000.avro"), make(60, 2))
     config = {
         "feature_shards": {
             "global": {"feature_bags": ["features"], "add_intercept": True},
@@ -106,7 +118,7 @@ def test_cli_cluster_training(tmp_path):
             "fixed": {"type": "fixed", "feature_shard": "global",
                       "optimizer": {"optimizer": "LBFGS",
                                     "regularization": "L2",
-                                    "regularization_weight": 0.1}},
+                                    "regularization_weights": [0.1, 1e5]}},
             "per_user": {"type": "random", "feature_shard": "per_user",
                          "random_effect_type": "userId",
                          "optimizer": {"regularization": "L2",
@@ -125,10 +137,13 @@ def test_cli_cluster_training(tmp_path):
         [
             sys.executable, "-m", "photon_ml_tpu.cli.train_game",
             "--train-data-dirs", str(train_dir),
+            "--validation-data-dirs", str(val_dir),
+            "--evaluator", "AUC",
             "--coordinate-config", str(cfg_path),
             "--task", "LOGISTIC_REGRESSION",
             "--output-dir", str(out),
             "--num-outer-iterations", "1",
+            "--checkpoint-dir", str(tmp_path / "ckpt"),
             "--parallel-data", "2", "--parallel-feat", "4",
             "--coordinator-address", f"127.0.0.1:{port}",
             "--num-processes", "2", "--process-id", str(i),
@@ -146,6 +161,13 @@ def test_cli_cluster_training(tmp_path):
 
     model, _ = load_game_model(str(out / "best"))
     assert "fixed" in model.models and "per_user" in model.models
+    # both sweep configs trained (digest-keyed checkpoint dirs), and the
+    # crushed λ=1e5 config did not win: the saved fixed effect has real
+    # weight
+    ckpts = sorted(p.name for p in (tmp_path / "ckpt").iterdir())
+    assert len(ckpts) == 2 and all(c.startswith("config-") for c in ckpts)
+    w_fixed = np.asarray(model.models["fixed"].coefficients.means)
+    assert float(np.abs(w_fixed).max()) > 1e-2, w_fixed
 
     # scoring CLI across the same cluster: single-writer scores output
     port2 = _free_port()
