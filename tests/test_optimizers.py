@@ -328,3 +328,41 @@ def test_invariants_100_random_starts(rng, name, batched_solver):
     assert np.all(ok[valid[:, :-1] & valid[:, 1:]]), (
         f"{name}: objective increased somewhere in the tracked history"
     )
+
+
+@pytest.mark.parametrize("task_name", ["LINEAR_REGRESSION", "LOGISTIC_REGRESSION"])
+@pytest.mark.parametrize("solver_name", ["lbfgs", "tron", "owlqn"])
+def test_solvers_survive_ill_conditioned_data(task_name, solver_name):
+    """Reference OptimizerIntegTest drives each optimizer over deliberately
+    ill-conditioned ("outlier") draws: the solve must stay finite and end
+    with a valid convergence reason — never NaN coefficients or a crash."""
+    from photon_ml_tpu.losses import make_glm_objective
+    from photon_ml_tpu.losses.pointwise import loss_for_task
+    from photon_ml_tpu.testing import draw_sample
+    from photon_ml_tpu.types import TaskType
+
+    task = TaskType[task_name]
+    X, y, _ = draw_sample(task, n=256, d=8, regime="outlier", seed=11)
+    data = LabeledData.create(
+        DenseFeatures(matrix=jnp.asarray(X)), jnp.asarray(y)
+    )
+    obj = make_glm_objective(loss_for_task(task))
+    cfg = (
+        OptimizerConfig.tron(max_iterations=20)
+        if solver_name == "tron"
+        else OptimizerConfig.lbfgs(max_iterations=50)
+    )
+    l2 = jnp.float32(1.0)
+    if solver_name == "lbfgs":
+        res = lbfgs_solve(obj, jnp.zeros(8), data, l2, cfg)
+    elif solver_name == "tron":
+        res = tron_solve(obj, jnp.zeros(8), data, l2, cfg)
+    else:
+        res = owlqn_solve(obj, jnp.zeros(8), data, l2, jnp.float32(0.1), cfg)
+    w = np.asarray(res.w)
+    assert np.all(np.isfinite(w)), f"{solver_name} produced non-finite w"
+    assert np.isfinite(float(res.value))
+    assert int(res.reason) in {r.value for r in ConvergenceReason}
+    # the solve must improve on w=0
+    f0 = float(obj.value(jnp.zeros(8), data, l2))
+    assert float(res.value) <= f0 + 1e-6
