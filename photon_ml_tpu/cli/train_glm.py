@@ -102,10 +102,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="EventListener classes to register (reference "
                         "--event-listeners, Params.scala:186)")
     p.add_argument("--diagnostic-mode", default="NONE",
-                   choices=["NONE", "ALL"],
-                   help="ALL writes model-diagnostic.html (bootstrap, "
-                        "Hosmer-Lemeshow, error independence, feature "
-                        "importance; reference Driver diagnose stage)")
+                   choices=["NONE", "TRAIN", "VALIDATE", "ALL"],
+                   help="writes model-diagnostic.html (reference Driver "
+                        "diagnose stage, DiagnosticMode.scala): TRAIN runs "
+                        "the training-data diagnostics (learning curves + "
+                        "bootstrap), VALIDATE the held-out diagnostics "
+                        "(Hosmer-Lemeshow, error independence, feature "
+                        "importance), ALL both")
     p.add_argument("--log-file", default=None)
     return p.parse_args(argv)
 
@@ -384,11 +387,12 @@ def run(args: argparse.Namespace) -> dict:
                         },
                         f, indent=2,
                     )
-        if args.diagnostic_mode == "ALL" and write_outputs:
+        if args.diagnostic_mode != "NONE" and write_outputs:
             with timer.time("diagnose"):
                 _diagnose(
                     args, task, data, labeled, fits, best_lambda, imap,
                     intercept_index, configuration, logger,
+                    val_data=vdata if args.validation_data_dirs else None,
                 )
 
         emitter.send_event(TrainingFinishEvent(
@@ -404,70 +408,121 @@ def run(args: argparse.Namespace) -> dict:
 
 def _diagnose(
     args, task, data, labeled, fits, best_lambda, imap, intercept_index,
-    configuration, logger,
+    configuration, logger, val_data=None,
 ) -> None:
-    """Reference Driver diagnose() stage: full diagnostic HTML report for
-    the selected model."""
+    """Reference Driver diagnose() stage (Driver.scala:612-638): the mode
+    splits the report — TRAIN|ALL runs the training-data diagnostics
+    (FittingDiagnostic learning curves + BootstrapTrainingDiagnostic),
+    VALIDATE|ALL the held-out diagnostics (Hosmer-Lemeshow, prediction-error
+    independence, mean + variance feature importance). Held-out diagnostics
+    score the validation set when one was given, else the training set."""
     from photon_ml_tpu.diagnostics import (
         bootstrap_training,
         evaluate_metrics,
         expected_magnitude_importance,
+        fitting_diagnostic,
         hosmer_lemeshow_diagnostic,
         prediction_error_independence,
+        variance_importance,
     )
     from photon_ml_tpu.diagnostics.report import (
         build_diagnostic_document,
         write_diagnostic_report,
     )
 
+    do_train = args.diagnostic_mode in ("TRAIN", "ALL")
+    do_validate = args.diagnostic_mode in ("VALIDATE", "ALL")
+    lambdas = [f.regularization_weight for f in fits]
     best = next(f for f in fits if f.regularization_weight == best_lambda)
-    feats = data.sparse_features("features", engine="auto")
-    scores = np.asarray(best.model.compute_score(feats)) + data.offsets
-    metrics = evaluate_metrics(scores, data.labels, task, data.weights)
 
-    def boot_train(idx):
+    # held-out diagnostics run on the validation set when available
+    ddata = val_data if val_data is not None else data
+    feats = ddata.sparse_features("features", engine="auto")
+    scores = np.asarray(best.model.compute_score(feats)) + ddata.offsets
+    metrics = evaluate_metrics(scores, ddata.labels, task, ddata.weights)
+
+    def _sub_fits(idx, weights):
         sub = data.take_rows(idx)
         # same normalization as the diagnosed model — the regularizer acts
         # in normalized space, so dropping it would bootstrap a different
         # estimator
         sub_labeled = _labeled_from_game(sub, "features", norm=labeled.norm)
-        fit = train_glm(
+        return sub, train_glm(
             sub_labeled, task, configuration,
-            regularization_weights=[best_lambda],
+            regularization_weights=weights,
             intercept_index=intercept_index,
-        )[0]
-        s = np.asarray(fit.model.compute_score(sub.sparse_features("features", engine="auto")))
-        return (
-            np.asarray(fit.model.coefficients.means),
-            evaluate_metrics(s + sub.offsets, sub.labels, task, sub.weights),
         )
 
-    bootstrap = bootstrap_training(
-        boot_train, data.num_rows, num_samples=6, seed=0
-    )
+    fitting = None
+    bootstrap = None
+    if do_train:
+        def fit_portion(idx, warm):
+            _, sub_fit = _sub_fits(idx, lambdas)
+            return {f.regularization_weight: f.model for f in sub_fit}
+
+        def eval_rows(model, idx):
+            sub = data.take_rows(idx)
+            s = np.asarray(
+                model.compute_score(sub.sparse_features("features", engine="auto"))
+            )
+            return evaluate_metrics(s + sub.offsets, sub.labels, task, sub.weights)
+
+        fitting = fitting_diagnostic(
+            fit_portion, eval_rows, data.num_rows, len(imap), seed=0
+        )
+
+        def boot_train(idx):
+            sub, sub_fit = _sub_fits(idx, [best_lambda])
+            fit = sub_fit[0]
+            s = np.asarray(
+                fit.model.compute_score(sub.sparse_features("features", engine="auto"))
+            )
+            return (
+                np.asarray(fit.model.coefficients.means),
+                evaluate_metrics(s + sub.offsets, sub.labels, task, sub.weights),
+            )
+
+        bootstrap = bootstrap_training(
+            boot_train, data.num_rows, num_samples=6, seed=0
+        )
 
     hl = None
-    if task is TaskType.LOGISTIC_REGRESSION:
-        from photon_ml_tpu.diagnostics.evaluation import _sigmoid
+    independence = None
+    importance = None
+    importance_var = None
+    if do_validate:
+        if task is TaskType.LOGISTIC_REGRESSION:
+            from photon_ml_tpu.diagnostics.evaluation import _sigmoid
 
-        hl = hosmer_lemeshow_diagnostic(
-            _sigmoid(scores), data.labels, len(imap)
+            hl = hosmer_lemeshow_diagnostic(
+                _sigmoid(scores), ddata.labels, len(imap)
+            )
+        independence = prediction_error_independence(
+            scores, ddata.labels, max_items=2000
         )
-
-    summary = summarize(labeled)
-    doc = build_diagnostic_document(
-        f"Model diagnostics (lambda = {best_lambda:g})",
-        metrics=metrics,
-        bootstrap=bootstrap,
-        hosmer_lemeshow=hl,
-        independence=prediction_error_independence(
-            scores, data.labels, max_items=2000
-        ),
-        importance=expected_magnitude_importance(
+        # importance scales (E|x|, Var x) come from the TRAINING summary,
+        # like the reference's preprocessing-stage summary
+        summary = summarize(labeled)
+        importance = expected_magnitude_importance(
             best.model.coefficients.means,
             mean_abs=np.asarray(summary.mean_abs),
             index_map=imap,
-        ),
+        )
+        importance_var = variance_importance(
+            best.model.coefficients.means,
+            variance=np.asarray(summary.variance),
+            index_map=imap,
+        )
+
+    doc = build_diagnostic_document(
+        f"Model diagnostics (lambda = {best_lambda:g})",
+        metrics=metrics,
+        fitting=fitting,
+        bootstrap=bootstrap,
+        hosmer_lemeshow=hl,
+        independence=independence,
+        importance=importance,
+        importance_variance=importance_var,
     )
     out = write_diagnostic_report(args.output_dir, doc)
     logger.info("diagnostic report: %s", out)

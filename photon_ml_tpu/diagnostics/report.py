@@ -39,6 +39,7 @@ def build_diagnostic_document(
     hosmer_lemeshow: Optional[HosmerLemeshowReport] = None,
     independence: Optional[KendallTauReport] = None,
     importance: Optional[FeatureImportanceReport] = None,
+    importance_variance: Optional[FeatureImportanceReport] = None,
 ) -> Document:
     doc = Document(title=title)
 
@@ -129,19 +130,22 @@ def build_diagnostic_document(
             ] + ([SimpleText(kt.message)] if kt.message else []),
         )]))
 
-    if importance:
-        doc.chapters.append(Chapter("Feature importance", [Section(
-            importance.importance_description, [
-                Table(
-                    headers=["Rank", "Name", "Term", "Importance"],
-                    rows=[
-                        (r + 1, name, term, f"{imp:.4g}")
-                        for r, (name, term, _, imp)
-                        in enumerate(importance.ranked_features)
-                    ],
-                ),
-            ],
-        )]))
+    importance_sections = [
+        Section(rep.importance_description, [
+            Table(
+                headers=["Rank", "Name", "Term", "Importance"],
+                rows=[
+                    (r + 1, name, term, f"{imp:.4g}")
+                    for r, (name, term, _, imp)
+                    in enumerate(rep.ranked_features)
+                ],
+            ),
+        ])
+        for rep in (importance, importance_variance)
+        if rep is not None
+    ]
+    if importance_sections:
+        doc.chapters.append(Chapter("Feature importance", importance_sections))
 
     return doc
 

@@ -389,6 +389,42 @@ class TestLegacyGlmDriver:
         assert "Feature importance" in html
         assert "<svg" in html
 
+    def test_diagnostic_mode_train_validate_split(self, glmix_avro, tmp_path):
+        """DiagnosticMode.scala TRAIN/VALIDATE split: TRAIN = training-data
+        diagnostics (learning curves + bootstrap), VALIDATE = held-out
+        diagnostics (HL, independence, mean+variance importance)."""
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        out = tmp_path / "glm_diag_train"
+        run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "1.0",
+            "--diagnostic-mode", "TRAIN",
+        ]))
+        html = (out / "model-diagnostic.html").read_text()
+        assert "Bootstrap" in html
+        assert "Fitting analysis" in html
+        assert "Hosmer-Lemeshow" not in html
+        assert "Feature importance" not in html
+
+        out = tmp_path / "glm_diag_validate"
+        run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--validation-data-dirs", str(glmix_avro["test"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "1.0",
+            "--diagnostic-mode", "VALIDATE",
+        ]))
+        html = (out / "model-diagnostic.html").read_text()
+        assert "Hosmer-Lemeshow" in html
+        assert "Feature importance" in html
+        assert "variance contribution" in html  # both importance rankings
+        assert "Bootstrap" not in html
+        assert "Fitting analysis" not in html
+
     def test_tron_and_box_constraints(self, glmix_avro, tmp_path):
         from photon_ml_tpu.cli.train_glm import parse_args, run
 
