@@ -237,6 +237,23 @@ def _pro_specs(pro, R1: int, u: int):
 # --------------------------------------------------------------------------
 
 
+def _tile_rows(R1: int) -> int:
+    """Sublane tile count u for the 3-D entered layout [B*128, R1, 128].
+
+    Mosaic's lowering requires the middle block dim be divisible by 8 or
+    equal to the full array dim R1, so u = 8 whenever 8 | R1 and u = R1
+    below that (plans are power-of-two sized, making R1 < 8 exact)."""
+    u = 8
+    while R1 % u:
+        u //= 2
+    if u < 8 and u != R1:
+        raise ValueError(
+            f"R1={R1} admits no Mosaic-legal sublane tile (need 8 | u or "
+            "u == R1); plan sizes must be powers of two"
+        )
+    return u
+
+
 def _descend_call(v, idx, B: int, R: int, pro, interpret: bool) -> jax.Array:
     """(lane shuffle; enter relayout) in one pass; optional input prologue.
 
@@ -244,9 +261,7 @@ def _descend_call(v, idx, B: int, R: int, pro, interpret: bool) -> jax.Array:
     as a 3-D [B*128, R1, 128] array (the caller treats it as opaque).
     """
     R1 = R // LANES
-    u = 4
-    while R1 % u:
-        u //= 2
+    u = _tile_rows(R1)
 
     def kernel(*refs):
         o_ref = refs[-1]
@@ -289,9 +304,7 @@ def _ascend_call(v3, idx, B: int, R: int, epi, interpret: bool):
     rows, or the epilogue's reduced vector.
     """
     R1 = R // LANES
-    u = 4
-    while R1 % u:
-        u //= 2
+    u = _tile_rows(R1)
 
     def _shuffled(x_ref, i_ref):
         t = x_ref[...]  # [128, u, 128]: t[c, t_, j] = row (g*u+t_)*128+j lane c
