@@ -224,7 +224,7 @@ def _grid_northstar(engine: str = "benes"):
     mesh = grid_mesh(1, 1)
     gf = grid_from_coo(
         rows, cols, vals, (N_GRID, D_GRID), mesh, engine=engine,
-        plan_cache=None if engine == "ell" else _plan_cache_dir(),
+        plan_cache=_plan_cache_dir(),
     )
     y_pad = np.zeros(gf.num_rows, np.float32)
     y_pad[:N_GRID] = y

@@ -90,7 +90,7 @@ def main():
     # ---- phase 2: timings at bench scale ---------------------------------
     import bench as B
 
-    fe_np, _, re_np, re_data = B._build()
+    fe_np, _, re_np, re_data, _, _ = B._build()
 
     def t(f, reps=3):
         r = f()

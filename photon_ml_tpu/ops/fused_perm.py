@@ -192,6 +192,7 @@ def _build_input_block(pro, w_ref, v_ref, rows: int):
         x = jax.lax.dot_general(
             wb, expand, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )  # [rows, 128]
     else:
         q = group // LANES
@@ -203,6 +204,7 @@ def _build_input_block(pro, w_ref, v_ref, rows: int):
         col = jax.lax.dot_general(
             sel, wb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )  # [rows, 1]
         x = jnp.broadcast_to(col, (rows, LANES))
     if isinstance(pro, MulBroadcast):
@@ -320,6 +322,7 @@ def _ascend_call(v3, idx, B: int, R: int, epi, interpret: bool):
             return jax.lax.dot_general(
                 y, reduce, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
             )  # [128u, 128//group]
         q = group // LANES
         rowsum = jnp.sum(y, axis=1, keepdims=True)  # [128u, 1]
@@ -330,6 +333,7 @@ def _ascend_call(v3, idx, B: int, R: int, epi, interpret: bool):
         return jax.lax.dot_general(
             sel2, rowsum, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )  # [128u//q, 1]
 
     def kernel_plain(x_ref, i_ref, o_ref):
@@ -510,8 +514,12 @@ def _run_probe() -> bool:
         z = np.asarray(jax.jit(feats.matvec)(jnp.asarray(w)))
         c = rng.standard_normal(n).astype(np.float32)
         g = np.asarray(jax.jit(feats.rmatvec)(jnp.asarray(c)))
-        ok = np.allclose(z, dense @ w, atol=2e-3) and np.allclose(
-            g, dense.T @ c, atol=2e-3
+        # tight tolerance on purpose: the kernels force Precision.HIGHEST,
+        # so anything beyond f32 accumulation noise (e.g. a lowering that
+        # silently drops to one-pass bf16 MXU matmuls, ~1e-3 error here but
+        # ~1e-2 at production scale) must fail the probe and fall back
+        ok = np.allclose(z, dense @ w, atol=3e-4) and np.allclose(
+            g, dense.T @ c, atol=3e-4
         )
         if not ok:
             import logging

@@ -57,6 +57,7 @@ def _kernel(kind: str, x_ref, y_ref, off_ref, wt_ref, w_ref,
         x, w_ref[...],                   # [BN, D] x [1, D]
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )[:, 0] + off_ref[:, 0]              # [BN]
     y = y_ref[:, 0]
     wt = wt_ref[:, 0]
@@ -72,6 +73,7 @@ def _kernel(kind: str, x_ref, y_ref, off_ref, wt_ref, w_ref,
         dz[None, :], x,                  # [1, BN] x [BN, D]
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
 
 
@@ -144,6 +146,7 @@ def _single_kernel(kind: str, x_ref, y_ref, off_ref, wt_ref, w_ref,
     z = jax.lax.dot_general(
         x, w_ref[...], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )[:, 0] + off_ref[0, :]
     y = y_ref[0, :]
     wt = wt_ref[0, :]
@@ -156,6 +159,7 @@ def _single_kernel(kind: str, x_ref, y_ref, off_ref, wt_ref, w_ref,
     grad_ref[...] = jax.lax.dot_general(
         dz[None, :], x, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
 
 
