@@ -70,6 +70,9 @@ class FixedEffectCoordinate(Coordinate):
     # when data.norm is set, the shift modes need the intercept slot to map
     # coefficients back to the original space (train_glm contract)
     intercept_index: Optional[int] = None
+    # attach per-coefficient variances ~ 1/(H_jj+eps) to trained models
+    # (reference COMPUTE_VARIANCE -> DistributedOptimizationProblem.scala:80-94)
+    compute_variances: bool = False
     # telemetry from the most recent update (reference
     # FixedEffectOptimizationTracker.scala)
     last_tracker: Optional[FixedEffectOptimizationTracker] = dataclasses.field(
@@ -116,6 +119,7 @@ class FixedEffectCoordinate(Coordinate):
             self.task,
             self.configuration,
             initial_model=self._pad_model(model),
+            compute_variances=self.compute_variances,
             intercept_index=self.intercept_index,
         )[0]
         self.last_tracker = FixedEffectOptimizationTracker(
@@ -198,6 +202,9 @@ class RandomEffectCoordinate(Coordinate):
     # every offset rebuild
     mesh: Optional[object] = None
     mesh_axes: Optional[tuple] = None
+    # per-entity coefficient variances from the local Hessian diagonals
+    # (reference COMPUTE_VARIANCE; SingleNodeOptimizationProblem variances)
+    compute_variances: bool = False
 
     def _place(self, ds: RandomEffectDataset) -> RandomEffectDataset:
         if self.mesh is None:
@@ -213,7 +220,8 @@ class RandomEffectCoordinate(Coordinate):
             self.dataset.update_offsets(self.base_offsets + residual_scores)
         )
         new_model, results = train_random_effects(
-            ds, self.task, self.configuration, initial_model=model
+            ds, self.task, self.configuration, initial_model=model,
+            compute_variances=self.compute_variances,
         )
         # entity lanes beyond the real ids (mesh padding) carry zero weights
         # and all-invalid projections: their solves are trivial, their

@@ -202,6 +202,23 @@ def load_game_config(path: str) -> Tuple[
     return shards, coordinates, update_order, raw
 
 
+def parse_input_columns(spec: Optional[str]) -> Dict[str, str]:
+    """``--input-columns-names`` JSON → ``read_game_data`` field kwargs
+    (reference InputColumnsNames: user-defined response/offset/weight/uid
+    column names). Shared by the training and scoring drivers."""
+    if not spec:
+        return {}
+    raw_cols = json.loads(spec)
+    allowed = {"response", "offset", "weight", "uid"}
+    bad = set(raw_cols) - allowed
+    if bad:
+        raise ValueError(
+            f"--input-columns-names has unknown keys {sorted(bad)}; "
+            f"allowed: {sorted(allowed)}"
+        )
+    return {f"{k}_field": v for k, v in raw_cols.items()}
+
+
 def expand_data_dirs(
     dirs: List[str],
     date_range: Optional[str],

@@ -66,8 +66,53 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--evaluator", default=None,
                    help="optional metric over scored data, e.g. AUC, "
                         "'RMSE:userId', or 'PRECISION@5:userId'")
+    p.add_argument("--delete-output-dir-if-exists", action="store_true",
+                   help="remove an existing --output-dir before writing")
+    p.add_argument("--random-effect-id-set", default=None,
+                   help="comma-separated random effect types to read from "
+                        "the records, overriding the set derived from the "
+                        "model (reference --random-effect-id-set)")
+    p.add_argument("--input-columns-names", default=None,
+                   help="JSON map overriding input field names; keys: "
+                        "response, offset, weight, uid (reference "
+                        "InputColumnsNames)")
+    p.add_argument("--log-data-and-model-stats", action="store_true",
+                   help="log dataset stats (rows, per-id-tag entity counts "
+                        "and samples-per-entity) and per-coordinate model "
+                        "sizes (reference --log-game-dataset-and-model-stats)")
     p.add_argument("--log-file", default=None)
     return p.parse_args(argv)
+
+
+def _log_data_and_model_stats(logger, data, model, id_tags) -> None:
+    """Reference logGameDataSet/logGameModel (scoring Driver.scala:88-103):
+    debug-level dataset summary (samples per id-tag entity) + model sizes."""
+    logger.info("dataset stats: numSamples: %d", data.num_rows)
+    for tag in id_tags:
+        ids = data.id_tags.get(tag)
+        if ids is None:
+            continue
+        _, counts = np.unique(np.asarray(ids), return_counts=True)
+        logger.info(
+            "dataset stats: samples per %s: entities=%d mean=%.2f "
+            "stdev=%.2f min=%d max=%d",
+            tag, counts.size, counts.mean(),
+            counts.std(), counts.min(), counts.max(),
+        )
+    for cid, sub in model.models.items():
+        coef = getattr(sub, "coefficients", None)
+        if coef is not None and hasattr(coef, "means"):
+            logger.info(
+                "model stats [%s]: fixed effect, %d coefficients",
+                cid, int(np.asarray(coef.means).shape[0]),
+            )
+        elif hasattr(sub, "num_entities"):
+            logger.info(
+                "model stats [%s]: random effect '%s', %d entities",
+                cid, getattr(sub, "random_effect_type", "?"), sub.num_entities,
+            )
+        else:
+            logger.info("model stats [%s]: %s", cid, type(sub).__name__)
 
 
 def run(args: argparse.Namespace) -> Optional[float]:
@@ -123,29 +168,49 @@ def run(args: argparse.Namespace) -> Optional[float]:
             sid, FeatureShardConfiguration(feature_bags=[sid])
         )
 
-    id_tags = sorted(
-        {
-            m.random_effect_type
-            for m in model.meta.values()
-            if m.random_effect_type
-        }
-    )
+    if args.random_effect_id_set:
+        id_tags = sorted(
+            t.strip() for t in args.random_effect_id_set.split(",") if t.strip()
+        )
+    else:
+        id_tags = sorted(
+            {
+                m.random_effect_type
+                for m in model.meta.values()
+                if m.random_effect_type
+            }
+        )
     # a sharded evaluator tag must be read even if no sub-model uses it
     if args.evaluator and ":" in args.evaluator:
         tag = args.evaluator.partition(":")[2].strip()
         if tag and tag not in id_tags:
             id_tags.append(tag)
+
+    from photon_ml_tpu.cli.common import parse_input_columns
+
+    col_names = parse_input_columns(args.input_columns_names)
+
     with timer.time("read data"):
         data, _, uids = read_game_data(
             data_dirs, shard_bags, index_maps,
-            id_tags=id_tags, is_response_required=False,
+            id_tags=id_tags, is_response_required=False, **col_names,
         )
     logger.info("scoring rows: %d", data.num_rows)
+
+    if args.log_data_and_model_stats:
+        _log_data_and_model_stats(logger, data, model, id_tags)
 
     with timer.time("score"):
         scores = model.score(data) + data.offsets
 
     import jax
+
+    if args.delete_output_dir_if_exists:
+        import os
+        import shutil
+
+        if jax.process_index() == 0 and os.path.isdir(args.output_dir):
+            shutil.rmtree(args.output_dir)
 
     with timer.time("save scores"):
         if jax.process_index() != 0:

@@ -68,8 +68,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "--train-date-range)")
     p.add_argument("--train-date-days-ago", default=None,
                    help="start-end days ago, e.g. 90-1")
+    p.add_argument("--validation-date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd for the validation dirs "
+                        "(reference --validation-date-range)")
+    p.add_argument("--validation-date-days-ago", default=None,
+                   help="start-end days ago for the validation dirs")
     p.add_argument("--coordinate-config", required=True,
                    help="typed JSON config: feature shards + coordinates")
+    p.add_argument("--updating-sequence", nargs="+", default=None,
+                   help="coordinate update order for coordinate descent; "
+                        "overrides the config file's order (reference "
+                        "--updating-sequence)")
     p.add_argument("--task", required=True,
                    choices=[t.name for t in TaskType])
     p.add_argument("--output-dir", required=True)
@@ -84,9 +93,44 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--normalization-type", default="NONE",
                    choices=[n.name for n in NormalizationType])
     p.add_argument("--offheap-indexmap-dir", default=None)
+    p.add_argument("--compute-variance", action="store_true",
+                   help="attach per-coefficient variances ~ 1/(H_jj+eps) to "
+                        "FE and RE models; saved in the BayesianLinearModel"
+                        "Avro variances field (reference --compute-variance)")
+    p.add_argument("--model-output-mode", default="BEST",
+                   choices=["ALL", "BEST", "NONE"],
+                   help="BEST saves the selected model under <output>/best; "
+                        "ALL additionally saves every swept configuration "
+                        "under <output>/all/<i>; NONE saves nothing "
+                        "(reference ModelOutputMode)")
+    p.add_argument("--delete-output-dir-if-exists", action="store_true",
+                   help="remove an existing --output-dir before writing")
+    p.add_argument("--check-data", action="store_true",
+                   help="run per-task input validation over every feature "
+                        "shard before training (reference CHECK_DATA -> "
+                        "DataValidators.sanityCheckData)")
+    p.add_argument("--input-columns-names", default=None,
+                   help="JSON map overriding input field names, e.g. "
+                        '\'{"response": "y", "weight": "w"}\'; keys: '
+                        "response, offset, weight, uid (reference "
+                        "InputColumnsNames)")
+    p.add_argument("--summarization-output-dir", default=None,
+                   help="write per-shard feature stats here instead of "
+                        "<output-dir>/feature-stats (implies stats are "
+                        "computed for every shard)")
     p.add_argument("--hyperparameter-tuning", default="NONE",
                    choices=["NONE", "RANDOM", "BAYESIAN"])
     p.add_argument("--hyperparameter-tuning-iter", type=int, default=10)
+    p.add_argument("--regularization-weight-range", default=None,
+                   help="lower,upper bounds for tuned regularization "
+                        "weights, e.g. 1e-4,1e4 (reference "
+                        "--regularization-weight-range)")
+    p.add_argument("--use-warm-start", dest="use_warm_start",
+                   action="store_true", default=True,
+                   help="warm-start tuning trials from the previous trial's "
+                        "models (default on, reference USE_WARM_START)")
+    p.add_argument("--no-warm-start", dest="use_warm_start",
+                   action="store_false")
     p.add_argument("--model-name", default="photon-ml-tpu-game")
     p.add_argument("--checkpoint-dir", default=None,
                    help="atomic per-outer-iteration training checkpoints; "
@@ -171,11 +215,9 @@ def _make_evaluator(spec: Optional[str], task: TaskType, data):
     return MultiEvaluator(base=base, group_ids=tuple(ids), tag=tag)
 
 
-def _save_feature_stats(output_dir, shard, summary, index_map) -> None:
-    """Per-shard stats under <output-dir>/feature-stats/<shard>."""
-    write_feature_stats(
-        os.path.join(output_dir, "feature-stats", shard), summary, index_map
-    )
+def _save_feature_stats(stats_base, shard, summary, index_map) -> None:
+    """Per-shard stats under <stats_base>/<shard>."""
+    write_feature_stats(os.path.join(stats_base, shard), summary, index_map)
 
 
 def write_feature_stats(stats_dir, summary, index_map) -> None:
@@ -242,6 +284,25 @@ def run(args: argparse.Namespace) -> GameFit:
         shard_configs, coordinates, update_order, raw_config = load_game_config(
             args.coordinate_config
         )
+        if args.updating_sequence:
+            unknown = [c for c in args.updating_sequence if c not in coordinates]
+            if unknown:
+                raise ValueError(
+                    f"--updating-sequence names unknown coordinates {unknown}; "
+                    f"config has {sorted(coordinates)}"
+                )
+            update_order = list(args.updating_sequence)
+
+        from photon_ml_tpu.cli.common import parse_input_columns
+
+        col_names = parse_input_columns(args.input_columns_names)
+
+        if args.delete_output_dir_if_exists and os.path.isdir(args.output_dir):
+            import jax
+            import shutil
+
+            if jax.process_index() == 0:
+                shutil.rmtree(args.output_dir)
 
         with timer.time("prepare feature maps"):
             index_maps = load_index_maps(args.offheap_indexmap_dir, shard_configs)
@@ -255,9 +316,34 @@ def run(args: argparse.Namespace) -> GameFit:
         id_tags = id_tags_needed(coordinates)
         with timer.time("read training data"):
             data, index_maps, _ = read_game_data(
-                train_dirs, shard_configs, index_maps, id_tags=id_tags
+                train_dirs, shard_configs, index_maps, id_tags=id_tags,
+                **col_names,
             )
         logger.info("training rows: %d", data.num_rows)
+
+        def _check_shards(game_data, phase: str) -> None:
+            """--check-data gate over every feature shard (reference CHECK_DATA
+            -> readAndCheckGameDataSet wraps BOTH the train and validation
+            reads, Driver.scala:74-75). engine="auto" reuses the same cached
+            layout training/stats will use."""
+            from photon_ml_tpu.data.validators import validate_labeled_data
+
+            with timer.time(f"check data [{phase}]"):
+                import jax.numpy as jnp
+
+                for sid in shard_configs:
+                    validate_labeled_data(
+                        LabeledData.create(
+                            game_data.sparse_features(sid, engine="auto"),
+                            jnp.asarray(game_data.labels),
+                            offsets=jnp.asarray(game_data.offsets),
+                            weights=jnp.asarray(game_data.weights),
+                        ),
+                        task,
+                    )
+
+        if args.check_data:
+            _check_shards(data, "train")
 
         # a sharded evaluator ('AUC:tag') needs its tag in the validation read
         # even when no coordinate uses it
@@ -269,12 +355,19 @@ def run(args: argparse.Namespace) -> GameFit:
 
         validation_data = None
         if args.validation_data_dirs:
+            validation_dirs = expand_data_dirs(
+                args.validation_data_dirs,
+                args.validation_date_range,
+                args.validation_date_days_ago,
+            )
             with timer.time("read validation data"):
                 validation_data, _, _ = read_game_data(
-                    args.validation_data_dirs, shard_configs, index_maps,
-                    id_tags=val_tags,
+                    validation_dirs, shard_configs, index_maps,
+                    id_tags=val_tags, **col_names,
                 )
             logger.info("validation rows: %d", validation_data.num_rows)
+            if args.check_data:
+                _check_shards(validation_data, "validation")
 
         norm_type = NormalizationType[args.normalization_type]
         normalization = {}
@@ -290,10 +383,14 @@ def run(args: argparse.Namespace) -> GameFit:
         }
         # summarize only what's needed: fe shards for normalization, every shard
         # when stats output was requested
-        stat_shards = (
-            list(shard_configs) if args.save_feature_stats else sorted(fe_shards)
+        stats_base = args.summarization_output_dir or (
+            os.path.join(args.output_dir, "feature-stats")
+            if args.save_feature_stats else None
         )
-        if norm_type is not NormalizationType.NONE or args.save_feature_stats:
+        stat_shards = (
+            list(shard_configs) if stats_base else sorted(fe_shards)
+        )
+        if norm_type is not NormalizationType.NONE or stats_base:
             for sid in stat_shards:
                 with timer.time(f"feature stats [{sid}]"):
                     import jax.numpy as jnp
@@ -303,8 +400,8 @@ def run(args: argparse.Namespace) -> GameFit:
                         weights=jnp.asarray(data.weights),
                     )
                     summary = summarize(labeled)
-                if args.save_feature_stats:
-                    _save_feature_stats(args.output_dir, sid, summary, index_maps[sid])
+                if stats_base:
+                    _save_feature_stats(stats_base, sid, summary, index_maps[sid])
                 icpt = index_maps[sid].get_index(INTERCEPT_KEY)
                 intercept_indices[sid] = icpt if icpt >= 0 else None
                 if norm_type is not NormalizationType.NONE and sid in fe_shards:
@@ -352,6 +449,7 @@ def run(args: argparse.Namespace) -> GameFit:
             normalization=normalization,
             intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
             parallel=parallel,
+            compute_variance=args.compute_variance,
         )
 
         emitter.send_event(TrainingStartEvent(task=task.name))
@@ -369,7 +467,22 @@ def run(args: argparse.Namespace) -> GameFit:
                 "without a validation evaluator there is no way to select "
                 "the best of the swept models"
             )
+        def _config_with_overrides(overrides) -> dict:
+            """raw_config with one sweep point's λ folded in, so each saved
+            model's metadata names the configuration that trained IT
+            (reference writes per-model modelConfig, Driver.scala:419-427)."""
+            if not overrides:
+                return raw_config
+            cfg = json.loads(json.dumps(raw_config))
+            for cid, opt in overrides.items():
+                opt_cfg = cfg["coordinates"][cid].setdefault("optimizer", {})
+                opt_cfg.pop("regularization_weights", None)
+                opt_cfg["regularization_weight"] = opt.regularization_weight
+            return cfg
+
         fit_overrides: Dict[str, object] = {}  # the winning config's map
+        all_fits: List[GameFit] = []  # every swept fit, for --model-output-mode ALL
+        all_fit_overrides: List[Dict[str, object]] = []  # aligned with all_fits
         with profile_ctx, timer.time("fit"):
             if len(sweep_configs) > 1:
                 # one fit per swept configuration, best by the validation
@@ -396,12 +509,16 @@ def run(args: argparse.Namespace) -> GameFit:
                     )
                 fit = fits[best_i]
                 fit_overrides = sweep_configs[best_i]
+                all_fits = list(fits)
+                all_fit_overrides = list(sweep_configs)
             else:
                 fit = estimator.fit(
                     data,
                     validation_data=validation_data,
                     checkpoint_dir=args.checkpoint_dir,
                 )
+                all_fits = [fit]
+                all_fit_overrides = [{}]
         for cid, value in fit.objective_history:
             cfg = estimator.coordinate_configs.get(cid)
             opt_cfg = fit_overrides.get(cid) or (cfg.optimizer if cfg else None)
@@ -424,12 +541,28 @@ def run(args: argparse.Namespace) -> GameFit:
             and validation_data is not None
             and args.hyperparameter_tuning_iter > 0
         ):
+            tuning_kwargs = {}
+            if args.regularization_weight_range:
+                parts = args.regularization_weight_range.split(",")
+                if len(parts) != 2:
+                    raise ValueError(
+                        "--regularization-weight-range expects lower,upper "
+                        f"(e.g. 1e-4,1e4), got {args.regularization_weight_range!r}"
+                    )
+                lo, hi = float(parts[0]), float(parts[1])
+                if not (0 < lo < hi):
+                    raise ValueError(
+                        f"need 0 < lower < upper, got {lo}, {hi}"
+                    )
+                tuning_kwargs["log10_range"] = (np.log10(lo), np.log10(hi))
             with timer.time("hyperparameter tuning"):
                 trials = run_hyperparameter_tuning(
                     estimator, data, validation_data,
                     mode=args.hyperparameter_tuning,
                     num_iterations=args.hyperparameter_tuning_iter,
                     prior_fits=[fit],
+                    warm_start=args.use_warm_start,
+                    **tuning_kwargs,
                 )
             for t in trials:
                 logger.info(
@@ -445,15 +578,32 @@ def run(args: argparse.Namespace) -> GameFit:
                 ):
                     best = c
 
-        with timer.time("save model"):
-            save_game_model(
-                best.model,
-                os.path.join(args.output_dir, "best"),
-                index_maps=index_maps,
-                model_name=args.model_name,
-                configurations=raw_config,
-            )
-        logger.info("model saved to %s", os.path.join(args.output_dir, "best"))
+        if args.model_output_mode != "NONE":
+            with timer.time("save model"):
+                save_game_model(
+                    best.model,
+                    os.path.join(args.output_dir, "best"),
+                    index_maps=index_maps,
+                    model_name=args.model_name,
+                    configurations=_config_with_overrides(
+                        fit_overrides if best is fit else {}
+                    ),
+                )
+                if args.model_output_mode == "ALL":
+                    # reference Driver.scala:416-433: every swept
+                    # configuration's model under <output>/all/<i>, each with
+                    # the metadata of its own configuration
+                    for i, (f, ovr) in enumerate(
+                        zip(all_fits, all_fit_overrides)
+                    ):
+                        save_game_model(
+                            f.model,
+                            os.path.join(args.output_dir, "all", str(i)),
+                            index_maps=index_maps,
+                            model_name=args.model_name,
+                            configurations=_config_with_overrides(ovr),
+                        )
+            logger.info("model saved to %s", os.path.join(args.output_dir, "best"))
         emitter.send_event(TrainingFinishEvent(
             task=task.name, wall_seconds=time.perf_counter() - t_start
         ))

@@ -149,6 +149,7 @@ class GameEstimator:
         intercept_indices: Optional[Dict[str, int]] = None,
         parallel: Optional[ParallelConfiguration] = None,
         extra_evaluators: Sequence[Evaluator] = (),
+        compute_variance: bool = False,
     ) -> None:
         """``normalization``/``intercept_indices`` are per-feature-shard;
         they apply to fixed-effect coordinates (training runs in normalized
@@ -172,6 +173,10 @@ class GameEstimator:
         self.intercept_indices = dict(intercept_indices or {})
         self.parallel = parallel
         self._mesh = parallel.build_mesh() if parallel is not None else None
+        # reference COMPUTE_VARIANCE (GameTrainingParams): attach 1/(H_jj+eps)
+        # coefficient variances to FE and RE models (not the factored/MF
+        # coordinate — random-projection variances don't back-project)
+        self.compute_variance = compute_variance
 
     def _build_coordinate(
         self, cid: str, cfg: CoordinateConfiguration, data: GameData
@@ -192,6 +197,7 @@ class GameEstimator:
                 task=self.task,
                 configuration=cfg.optimizer,
                 intercept_index=self.intercept_indices.get(cfg.feature_shard),
+                compute_variances=self.compute_variance,
             )
         re_ds = build_random_effect_dataset(
             data.id_tags[cfg.data.random_effect_type],
@@ -240,6 +246,7 @@ class GameEstimator:
             base_offsets=data.offsets,
             mesh=mesh,
             mesh_axes=mesh_axes,
+            compute_variances=self.compute_variance,
         )
 
     def _build_grid_fixed_effect(
@@ -292,6 +299,7 @@ class GameEstimator:
             intercept_index=self.intercept_indices.get(cfg.feature_shard),
             num_real_rows=n,
             num_real_cols=d,
+            compute_variances=self.compute_variance,
         )
 
     def _meta(self) -> Dict[str, CoordinateMeta]:

@@ -129,6 +129,7 @@ class GameEstimatorEvaluationFunction:
             normalization=self.estimator.normalization,
             intercept_indices=self.estimator.intercept_indices,
             parallel=self.estimator.parallel,
+            compute_variance=self.estimator.compute_variance,
         )
         fit = estimator.fit(
             self.data,

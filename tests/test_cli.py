@@ -231,6 +231,217 @@ class TestTrainGameDriver:
         assert 0.4 < fit.validation_metric <= 1.0
 
 
+class TestGameTrainingParityFlags:
+    """Flags mirrored from the reference GameTrainingParams
+    (GameTrainingParams.scala:274-610) beyond the core training path."""
+
+    def test_compute_variance_output_mode_all_and_stats_dir(
+        self, glmix_avro, tmp_path
+    ):
+        """--compute-variance attaches 1/(H_jj+eps) variances to the saved
+        models; --model-output-mode ALL writes every swept config under
+        all/<i> (Driver.scala:416-433); --summarization-output-dir redirects
+        feature stats; --updating-sequence overrides the config order."""
+        import json as _json
+
+        from photon_ml_tpu.cli.train_game import parse_args, run
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        cfg = _json.loads(glmix_avro["config"].read_text())
+        cfg["coordinates"]["fixed"]["optimizer"].pop("regularization_weight")
+        cfg["coordinates"]["fixed"]["optimizer"]["regularization_weights"] = [0.1, 10.0]
+        cfg_path = tmp_path / "sweep.json"
+        cfg_path.write_text(_json.dumps(cfg))
+        out = tmp_path / "out"
+        stats_dir = tmp_path / "stats"
+        fit = run(parse_args([
+            "--train-data-dirs", str(glmix_avro["train"]),
+            "--validation-data-dirs", str(glmix_avro["test"]),
+            "--coordinate-config", str(cfg_path),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--evaluator", "AUC",
+            "--compute-variance",
+            "--model-output-mode", "ALL",
+            "--summarization-output-dir", str(stats_dir),
+            "--updating-sequence", "per_user", "fixed",
+        ]))
+        assert fit.validation_metric > 0.70
+        # both swept configurations saved, plus the best; each all/<i>
+        # metadata names the λ that trained THAT model (not the sweep list)
+        assert (out / "best" / "model-metadata.json").is_file()
+        lams = []
+        for i in range(2):
+            meta = _json.loads(
+                (out / "all" / str(i) / "model-metadata.json").read_text()
+            )
+            opt = meta["configurations"]["coordinates"]["fixed"]["optimizer"]
+            assert "regularization_weights" not in opt
+            lams.append(opt["regularization_weight"])
+        assert sorted(lams) == [0.1, 10.0]
+        # stats redirected (and computed for every shard)
+        assert (stats_dir / "global" / "part-00000.avro").is_file()
+        assert (stats_dir / "per_user" / "part-00000.avro").is_file()
+        # variances round-trip through BayesianLinearModelAvro
+        model, _ = load_game_model(str(out / "best"))
+        fe = model.models["fixed"]
+        assert fe.coefficients.variances is not None
+        assert np.all(np.asarray(fe.coefficients.variances) > 0)
+
+    def test_model_output_mode_none(self, glmix_avro, tmp_path):
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        out = tmp_path / "none_out"
+        fit = run(parse_args([
+            "--train-data-dirs", str(glmix_avro["train"]),
+            "--coordinate-config", str(glmix_avro["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--model-output-mode", "NONE",
+        ]))
+        assert fit is not None
+        assert not (out / "best").exists()
+
+    def test_delete_output_dir_if_exists(self, glmix_avro, tmp_path):
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        out = tmp_path / "stale_out"
+        (out / "best").mkdir(parents=True)
+        stale = out / "best" / "stale-marker"
+        stale.write_text("old run")
+        run(parse_args([
+            "--train-data-dirs", str(glmix_avro["train"]),
+            "--coordinate-config", str(glmix_avro["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--delete-output-dir-if-exists",
+        ]))
+        assert not stale.exists()
+        assert (out / "best" / "model-metadata.json").is_file()
+
+    def test_updating_sequence_unknown_coordinate(self, glmix_avro, tmp_path):
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        with pytest.raises(ValueError, match="updating-sequence"):
+            run(parse_args([
+                "--train-data-dirs", str(glmix_avro["train"]),
+                "--coordinate-config", str(glmix_avro["config"]),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(tmp_path / "o"),
+                "--updating-sequence", "fixed", "nope",
+            ]))
+
+    def test_input_columns_names(self, glmix_avro, tmp_path):
+        """Custom response field name (the reference's ResponsePrediction
+        data uses 'response' where TrainingExample uses 'label' —
+        InputColumnsNames exists exactly for this)."""
+        import json as _json
+
+        from photon_ml_tpu.cli.train_game import parse_args, run
+        from photon_ml_tpu.io import schemas as _schemas
+        from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+
+        src = glmix_avro["train"] / "part-00000.avro"
+        renamed_dir = tmp_path / "renamed"
+        renamed_dir.mkdir()
+        schema = _json.loads(_json.dumps(_schemas.TRAINING_EXAMPLE))  # deep copy
+        schema["fields"] = [
+            dict(f, name="response") if f["name"] == "label" else f
+            for f in schema["fields"]
+        ] + [{
+            "name": "userFeatures",
+            "type": {"type": "array", "items": "FeatureAvro"},
+            "default": [],
+        }]
+        recs = []
+        for rec in read_avro_file(str(src)):
+            rec = dict(rec)
+            rec["response"] = rec.pop("label")
+            recs.append(rec)
+        write_avro_file(str(renamed_dir / "part-00000.avro"), schema, recs)
+
+        out = tmp_path / "cols_out"
+        fit = run(parse_args([
+            "--train-data-dirs", str(renamed_dir),
+            "--coordinate-config", str(glmix_avro["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--input-columns-names", '{"response": "response"}',
+        ]))
+        assert fit is not None
+        assert (out / "best" / "model-metadata.json").is_file()
+
+    def test_input_columns_names_rejects_unknown_keys(self, glmix_avro, tmp_path):
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        with pytest.raises(ValueError, match="unknown keys"):
+            run(parse_args([
+                "--train-data-dirs", str(glmix_avro["train"]),
+                "--coordinate-config", str(glmix_avro["config"]),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(tmp_path / "o"),
+                "--input-columns-names", '{"label": "y"}',
+            ]))
+
+    def test_check_data_rejects_nonfinite(self, glmix_avro, tmp_path):
+        """--check-data runs the DataValidators gate (bad-input failure
+        cases, reference DriverTest.scala:470-496)."""
+        from photon_ml_tpu.cli.train_game import parse_args, run
+        from photon_ml_tpu.data.validators import DataValidationError
+
+        bad_dir = tmp_path / "bad"
+        bad_dir.mkdir()
+        write_training_examples(str(bad_dir / "part-00000.avro"), [
+            {
+                "uid": "r0",
+                "label": 1.0,
+                "features": [("g", "0", float("nan"))],
+                "userFeatures": [("u", "0", 1.0)],
+                "metadataMap": {"userId": "user0"},
+            },
+            {
+                "uid": "r1",
+                "label": 0.0,
+                "features": [("g", "0", 1.0)],
+                "userFeatures": [("u", "0", 1.0)],
+                "metadataMap": {"userId": "user1"},
+            },
+        ])
+        with pytest.raises(DataValidationError):
+            run(parse_args([
+                "--train-data-dirs", str(bad_dir),
+                "--coordinate-config", str(glmix_avro["config"]),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(tmp_path / "o"),
+                "--check-data",
+            ]))
+
+    def test_validation_date_range(self, glmix_avro, tmp_path):
+        """--validation-date-range expands validation dirs to daily
+        yyyy/MM/dd subdirs like the train-side flag."""
+        import shutil
+
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        dated = tmp_path / "dated_val"
+        day = dated / "2024" / "01" / "02"
+        day.mkdir(parents=True)
+        shutil.copy(
+            str(glmix_avro["test"] / "part-00000.avro"),
+            str(day / "part-00000.avro"),
+        )
+        fit = run(parse_args([
+            "--train-data-dirs", str(glmix_avro["train"]),
+            "--validation-data-dirs", str(dated),
+            "--validation-date-range", "20240101-20240103",
+            "--coordinate-config", str(glmix_avro["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(tmp_path / "dr_out"),
+            "--evaluator", "AUC",
+        ]))
+        assert fit.validation_metric > 0.70
+
+
 class TestScoreGameDriver:
     def test_score_after_train(self, glmix_avro, tmp_path):
         from photon_ml_tpu.cli.score_game import parse_args as score_args
@@ -277,6 +488,54 @@ class TestScoreGameDriver:
         assert [s.prediction_score for s in got3] == [
             s.prediction_score for s in got
         ]
+
+    def test_scoring_parity_flags(self, glmix_avro, tmp_path, caplog):
+        """--delete-output-dir-if-exists, --random-effect-id-set,
+        --log-data-and-model-stats, --input-columns-names on the scoring
+        driver (reference scoring Params.scala flags)."""
+        import logging
+
+        from photon_ml_tpu.cli.score_game import parse_args as score_args
+        from photon_ml_tpu.cli.score_game import run as score_run
+        from photon_ml_tpu.cli.train_game import parse_args as train_args
+        from photon_ml_tpu.cli.train_game import run as train_run
+
+        out = tmp_path / "model_out"
+        train_run(train_args([
+            "--train-data-dirs", str(glmix_avro["train"]),
+            "--coordinate-config", str(glmix_avro["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+        ]))
+        scores_dir = tmp_path / "scores"
+        scores_dir.mkdir()
+        stale = scores_dir / "part-99999.avro"
+        stale.write_bytes(b"stale")
+        with caplog.at_level(logging.INFO):
+            metric = score_run(score_args([
+                "--data-dirs", str(glmix_avro["test"]),
+                "--model-dir", str(out / "best"),
+                "--output-dir", str(scores_dir),
+                "--evaluator", "AUC",
+                "--delete-output-dir-if-exists",
+                "--random-effect-id-set", "userId",
+                "--log-data-and-model-stats",
+            ]))
+        assert metric > 0.70
+        assert not stale.exists()
+        text = caplog.text
+        assert "samples per userId" in text
+        assert "model stats [fixed]" in text
+        assert "model stats [per_user]" in text
+
+        # unknown --input-columns-names keys fail fast
+        with pytest.raises(ValueError, match="unknown keys"):
+            score_run(score_args([
+                "--data-dirs", str(glmix_avro["test"]),
+                "--model-dir", str(out / "best"),
+                "--output-dir", str(tmp_path / "s2"),
+                "--input-columns-names", '{"label": "y"}',
+            ]))
 
 
 class TestLegacyGlmDriver:
