@@ -202,6 +202,20 @@ def load_game_config(path: str) -> Tuple[
     return shards, coordinates, update_order, raw
 
 
+def delete_dirs_if_exist(*dirs: Optional[str]) -> None:
+    """Single-writer removal of stale output dirs (reference
+    DELETE_OUTPUT_DIR_IF_EXISTS). Process 0 only; None entries skipped."""
+    import shutil
+
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    for d in dirs:
+        if d and os.path.isdir(d):
+            shutil.rmtree(d)
+
+
 def parse_input_columns(spec: Optional[str]) -> Dict[str, str]:
     """``--input-columns-names`` JSON → ``read_game_data`` field kwargs
     (reference InputColumnsNames: user-defined response/offset/weight/uid

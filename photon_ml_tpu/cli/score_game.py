@@ -18,7 +18,11 @@ from typing import List, Optional
 
 import numpy as np
 
-from photon_ml_tpu.cli.common import setup_logger
+from photon_ml_tpu.cli.common import (
+    delete_dirs_if_exist,
+    parse_input_columns,
+    setup_logger,
+)
 from photon_ml_tpu.cli.train_game import _make_evaluator
 from photon_ml_tpu.io.data_reader import (
     FeatureShardConfiguration,
@@ -186,8 +190,6 @@ def run(args: argparse.Namespace) -> Optional[float]:
         if tag and tag not in id_tags:
             id_tags.append(tag)
 
-    from photon_ml_tpu.cli.common import parse_input_columns
-
     col_names = parse_input_columns(args.input_columns_names)
 
     with timer.time("read data"):
@@ -206,11 +208,7 @@ def run(args: argparse.Namespace) -> Optional[float]:
     import jax
 
     if args.delete_output_dir_if_exists:
-        import os
-        import shutil
-
-        if jax.process_index() == 0 and os.path.isdir(args.output_dir):
-            shutil.rmtree(args.output_dir)
+        delete_dirs_if_exist(args.output_dir)
 
     with timer.time("save scores"):
         if jax.process_index() != 0:

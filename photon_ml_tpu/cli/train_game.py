@@ -28,9 +28,11 @@ import numpy as np
 
 from photon_ml_tpu.cli.common import (
     coordinate_weight_sweeps,
+    delete_dirs_if_exist,
     id_tags_needed,
     load_game_config,
     load_index_maps,
+    parse_input_columns,
     setup_logger,
 )
 from photon_ml_tpu.estimators.game import GameEstimator, GameFit
@@ -293,16 +295,10 @@ def run(args: argparse.Namespace) -> GameFit:
                 )
             update_order = list(args.updating_sequence)
 
-        from photon_ml_tpu.cli.common import parse_input_columns
-
         col_names = parse_input_columns(args.input_columns_names)
 
-        if args.delete_output_dir_if_exists and os.path.isdir(args.output_dir):
-            import jax
-            import shutil
-
-            if jax.process_index() == 0:
-                shutil.rmtree(args.output_dir)
+        if args.delete_output_dir_if_exists:
+            delete_dirs_if_exist(args.output_dir)
 
         with timer.time("prepare feature maps"):
             index_maps = load_index_maps(args.offheap_indexmap_dir, shard_configs)
@@ -468,16 +464,27 @@ def run(args: argparse.Namespace) -> GameFit:
                 "the best of the swept models"
             )
         def _config_with_overrides(overrides) -> dict:
-            """raw_config with one sweep point's λ folded in, so each saved
-            model's metadata names the configuration that trained IT
-            (reference writes per-model modelConfig, Driver.scala:419-427)."""
+            """raw_config with one sweep point's (or tuning trial's) λ folded
+            in, so each saved model's metadata names the configuration that
+            trained IT (reference writes per-model modelConfig,
+            Driver.scala:419-427). ``overrides`` values may be
+            GlmOptimizationConfiguration (sweep) or full
+            CoordinateConfiguration (tuning trials, incl. factored matrix λ)."""
             if not overrides:
                 return raw_config
             cfg = json.loads(json.dumps(raw_config))
-            for cid, opt in overrides.items():
+            for cid, o in overrides.items():
+                opt = getattr(o, "optimizer", o)
                 opt_cfg = cfg["coordinates"][cid].setdefault("optimizer", {})
                 opt_cfg.pop("regularization_weights", None)
                 opt_cfg["regularization_weight"] = opt.regularization_weight
+                matrix = getattr(o, "matrix_optimizer", None)
+                if matrix is not None:
+                    m_cfg = cfg["coordinates"][cid].setdefault(
+                        "matrix_optimizer", {}
+                    )
+                    m_cfg.pop("regularization_weights", None)
+                    m_cfg["regularization_weight"] = matrix.regularization_weight
             return cfg
 
         fit_overrides: Dict[str, object] = {}  # the winning config's map
@@ -536,6 +543,7 @@ def run(args: argparse.Namespace) -> GameFit:
             logger.info("validation metric: %.6f", fit.validation_metric)
 
         best = fit
+        best_overrides: Dict[str, object] = fit_overrides
         if (
             args.hyperparameter_tuning != "NONE"
             and validation_data is not None
@@ -569,14 +577,26 @@ def run(args: argparse.Namespace) -> GameFit:
                     "trial lambda=%s metric=%.6f",
                     ["%.4g" % (10.0 ** v) for v in t.hyperparameters], t.value,
                 )
-            candidates = [fit] + [t.fit for t in trials]
+            # trial hyperparameters → per-coordinate configs so the winning
+            # trial's λ lands in the saved metadata too
+            from photon_ml_tpu.estimators.tuning import (
+                GameEstimatorEvaluationFunction,
+            )
+
+            to_configs = GameEstimatorEvaluationFunction(
+                estimator, None, None
+            ).vector_to_configuration
+            candidates = [(fit, fit_overrides)] + [
+                (t.fit, to_configs(t.hyperparameters)) for t in trials
+            ]
             better = estimator.evaluator.better_than
-            for c in candidates:
+            for c, ovr in candidates:
                 if c.validation_metric is not None and (
                     best.validation_metric is None
                     or better(c.validation_metric, best.validation_metric)
                 ):
                     best = c
+                    best_overrides = ovr
 
         if args.model_output_mode != "NONE":
             with timer.time("save model"):
@@ -585,9 +605,7 @@ def run(args: argparse.Namespace) -> GameFit:
                     os.path.join(args.output_dir, "best"),
                     index_maps=index_maps,
                     model_name=args.model_name,
-                    configurations=_config_with_overrides(
-                        fit_overrides if best is fit else {}
-                    ),
+                    configurations=_config_with_overrides(best_overrides),
                 )
                 if args.model_output_mode == "ALL":
                     # reference Driver.scala:416-433: every swept

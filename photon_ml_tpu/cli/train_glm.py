@@ -97,6 +97,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.name for v in DataValidationType])
     p.add_argument("--compute-variances", action="store_true")
+    p.add_argument("--delete-output-dirs-if-exist", action="store_true",
+                   help="remove existing output (and summarization) dirs "
+                        "before writing (reference DELETE_OUTPUT_DIRS_IF_EXIST)")
+    p.add_argument("--use-warm-start", dest="use_warm_start",
+                   action="store_true", default=True,
+                   help="warm-start each lambda of the sweep from the "
+                        "previous optimum (default on, reference "
+                        "USE_WARM_START)")
+    p.add_argument("--no-warm-start", dest="use_warm_start",
+                   action="store_false")
+    p.add_argument("--validate-per-iteration", action="store_true",
+                   help="track per-iteration models (reference "
+                        "ModelTracker/OPTIMIZATION_STATE_TRACKER) and log "
+                        "the validation metric of every iteration's model "
+                        "(reference VALIDATE_PER_ITERATION); requires "
+                        "--validation-data-dirs")
     p.add_argument("--event-listeners", nargs="*", default=[],
                    metavar="module.Class",
                    help="EventListener classes to register (reference "
@@ -205,6 +221,15 @@ def run(args: argparse.Namespace) -> dict:
     emitter.send_event(PhotonSetupEvent(params=vars(args)))
     t_start = time.perf_counter()
     try:
+        if args.validate_per_iteration and not args.validation_data_dirs:
+            raise ValueError(
+                "--validate-per-iteration requires --validation-data-dirs"
+            )
+        if args.delete_output_dirs_if_exist:
+            from photon_ml_tpu.cli.common import delete_dirs_if_exist
+
+            delete_dirs_if_exist(args.output_dir, args.summarization_output_dir)
+
         shard_cfg = {
             "features": FeatureShardConfiguration(
                 feature_bags=args.feature_bags, add_intercept=args.add_intercept
@@ -289,7 +314,9 @@ def run(args: argparse.Namespace) -> dict:
                 task,
                 configuration,
                 regularization_weights=args.regularization_weights,
+                warm_start=args.use_warm_start,
                 compute_variances=args.compute_variances,
+                track_models=args.validate_per_iteration,
                 intercept_index=intercept_index,
             )
         for fit in fits:
@@ -334,6 +361,21 @@ def run(args: argparse.Namespace) -> dict:
                         "lambda=%g %s=%.6f", fit.regularization_weight,
                         evaluator.name, m,
                     )
+                    if args.validate_per_iteration and fit.tracked_models:
+                        # metric-vs-iteration curve from the per-iteration
+                        # tracked models (reference validatePerIteration)
+                        for i, tm in enumerate(fit.tracked_models):
+                            s_i = np.asarray(
+                                tm.compute_score(vfeats)
+                            ) + vdata.offsets
+                            m_i = evaluator.evaluate(
+                                s_i, vdata.labels, vdata.weights
+                            )
+                            logger.info(
+                                "lambda=%g iteration=%d %s=%.6f",
+                                fit.regularization_weight, i,
+                                evaluator.name, m_i,
+                            )
             best_lambda = None
             for lam, m in metrics.items():
                 # nan-aware comparison (NaN never wins; reference

@@ -37,6 +37,10 @@ class GlmFit:
     regularization_weight: float
     model: GeneralizedLinearModel
     result: SolveResult
+    # per-iteration models (original feature space) when track_models was
+    # requested — the reference's ModelTracker (ModelTracker.scala,
+    # DistributedOptimizationProblem per-iteration tracking)
+    tracked_models: Optional[List[GeneralizedLinearModel]] = None
 
 
 def train_glm(
@@ -47,6 +51,7 @@ def train_glm(
     initial_model: Optional[GeneralizedLinearModel] = None,
     warm_start: bool = True,
     compute_variances: bool = False,
+    track_models: bool = False,
     intercept_index: Optional[int] = None,
 ) -> List[GlmFit]:
     """Train one GLM per regularization weight, warm-starting down the sorted
@@ -59,6 +64,13 @@ def train_glm(
     objective = make_glm_objective(loss_for_task(task))
     if regularization_weights is None:
         regularization_weights = [configuration.regularization_weight]
+    if track_models:
+        configuration = dataclasses.replace(
+            configuration,
+            optimizer_config=dataclasses.replace(
+                configuration.optimizer_config, track_coefficients=True
+            ),
+        )
 
     dim = data.dim
     if initial_model is not None:
@@ -114,6 +126,24 @@ def train_glm(
         model = GeneralizedLinearModel(
             coefficients=Coefficients(means=w_out, variances=variances), task=task
         )
-        fits[lam] = GlmFit(regularization_weight=lam, model=model, result=result)
+
+        tracked = None
+        if track_models and result.w_history is not None:
+            tracked = []
+            iters = int(result.iterations)
+            for w_i in result.w_history[: iters + 1]:
+                if data.norm is not None:
+                    w_i = data.norm.transform_model_coefficients(
+                        w_i, intercept_index
+                    )
+                tracked.append(
+                    GeneralizedLinearModel(
+                        coefficients=Coefficients(means=w_i), task=task
+                    )
+                )
+        fits[lam] = GlmFit(
+            regularization_weight=lam, model=model, result=result,
+            tracked_models=tracked,
+        )
 
     return [fits[lam] for lam in regularization_weights]

@@ -87,6 +87,10 @@ class OptimizerConfig:
     # constraint map; see estimators).
     constraint_lower: Optional[float] = None
     constraint_upper: Optional[float] = None
+    # Record per-iteration coefficients in SolveResult.w_history
+    # ([max_iterations+1, d] — the reference's ModelTracker). Costs a
+    # max_iter x d buffer; off by default.
+    track_coefficients: bool = False
 
     def __post_init__(self) -> None:
         if self.history_dtype not in (None, "float32", "bfloat16"):

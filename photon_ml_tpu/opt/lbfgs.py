@@ -42,6 +42,7 @@ class _LbfgsState(NamedTuple):
     it: jax.Array         # int32 outer iteration
     reason: jax.Array     # int32 ConvergenceReason
     history: jax.Array    # [max_iter+1] objective values
+    w_hist: jax.Array     # [max_iter+1, d] coefficients (or [0] when off)
 
 
 def two_loop_direction(
@@ -150,6 +151,11 @@ def lbfgs_solve(
 
     hdtype = resolve_history_dtype(config, dtype)
     history0 = jnp.full((max_iter + 1,), jnp.nan, dtype=dtype).at[0].set(f0)
+    w_hist0 = (
+        jnp.full((max_iter + 1, dim), jnp.nan, dtype=dtype).at[0].set(w0)
+        if config.track_coefficients
+        else jnp.zeros((0,), dtype=dtype)
+    )
     init = _LbfgsState(
         w=w0,
         f=f0,
@@ -161,6 +167,7 @@ def lbfgs_solve(
         it=jnp.int32(0),
         reason=jnp.int32(ConvergenceReason.NOT_CONVERGED.value),
         history=history0,
+        w_hist=w_hist0,
     )
 
     def cond(s: _LbfgsState):
@@ -241,6 +248,11 @@ def lbfgs_solve(
             it=it,
             reason=reason,
             history=s.history.at[it].set(f_new),
+            w_hist=(
+                s.w_hist.at[it].set(w_new)
+                if config.track_coefficients
+                else s.w_hist
+            ),
         )
 
     out = jax.lax.while_loop(cond, body, init)
@@ -256,4 +268,5 @@ def lbfgs_solve(
         iterations=out.it,
         reason=reason,
         value_history=out.history,
+        w_history=out.w_hist if config.track_coefficients else None,
     )

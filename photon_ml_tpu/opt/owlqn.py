@@ -70,6 +70,7 @@ class _OwlqnState(NamedTuple):
     it: jax.Array
     reason: jax.Array
     history: jax.Array
+    w_hist: jax.Array     # [max_iter+1, d] coefficients (or [0] when off)
 
 
 def owlqn_solve(
@@ -97,6 +98,11 @@ def owlqn_solve(
 
     hdtype = resolve_history_dtype(config, dtype)
     history0 = jnp.full((max_iter + 1,), jnp.nan, dtype=dtype).at[0].set(F0)
+    w_hist0 = (
+        jnp.full((max_iter + 1, dim), jnp.nan, dtype=dtype).at[0].set(w0)
+        if config.track_coefficients
+        else jnp.zeros((0,), dtype=dtype)
+    )
     init = _OwlqnState(
         w=w0,
         f=f0,
@@ -109,6 +115,7 @@ def owlqn_solve(
         it=jnp.int32(0),
         reason=jnp.int32(ConvergenceReason.NOT_CONVERGED.value),
         history=history0,
+        w_hist=w_hist0,
     )
 
     GAMMA = 1e-4  # sufficient-decrease constant (Andrew & Gao use 1e-4)
@@ -234,6 +241,11 @@ def owlqn_solve(
             it=it,
             reason=reason,
             history=s.history.at[it].set(F_new),
+            w_hist=(
+                s.w_hist.at[it].set(w_new)
+                if config.track_coefficients
+                else s.w_hist
+            ),
         )
 
     out = jax.lax.while_loop(cond, body, init)
@@ -250,4 +262,5 @@ def owlqn_solve(
         iterations=out.it,
         reason=reason,
         value_history=out.history,
+        w_history=out.w_hist if config.track_coefficients else None,
     )

@@ -10,6 +10,8 @@ int32 code (types.ConvergenceReason).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -28,6 +30,10 @@ class SolveResult:
     iterations: jax.Array     # int32 number of outer iterations performed
     reason: jax.Array         # int32 ConvergenceReason code
     value_history: jax.Array  # [max_iterations+1] objective per iteration, NaN-padded
+    # [max_iterations+1, d] per-iteration coefficients, NaN-padded — only
+    # when OptimizerConfig.track_coefficients (reference ModelTracker /
+    # OptimizationStatesTracker keeps per-iteration coefficients)
+    w_history: Optional[jax.Array] = None
 
     def converged(self) -> jax.Array:
         return self.reason != ConvergenceReason.NOT_CONVERGED.value

@@ -109,6 +109,7 @@ class _TronState(NamedTuple):
     failures: jax.Array
     reason: jax.Array
     history: jax.Array
+    w_hist: jax.Array     # [max_iter+1, d] coefficients (or [0] when off)
 
 
 def tron_solve(
@@ -131,6 +132,11 @@ def tron_solve(
     abs_f_tol, abs_g_tol = absolute_tolerances(f0, g0_norm, config.tolerance)
 
     history0 = jnp.full((max_iter + 1,), jnp.nan, dtype=dtype).at[0].set(f0)
+    w_hist0 = (
+        jnp.full((max_iter + 1,) + w0.shape, jnp.nan, dtype=dtype).at[0].set(w0)
+        if config.track_coefficients
+        else jnp.zeros((0,), dtype=dtype)
+    )
     init = _TronState(
         w=w0,
         f=f0,
@@ -144,6 +150,7 @@ def tron_solve(
             jnp.int32(ConvergenceReason.NOT_CONVERGED.value),
         ),
         history=history0,
+        w_hist=w_hist0,
     )
 
     def cond(s: _TronState):
@@ -224,6 +231,11 @@ def tron_solve(
             failures=failures,
             reason=reason,
             history=s.history.at[it].set(f_new),
+            w_hist=(
+                s.w_hist.at[it].set(w_new)
+                if config.track_coefficients
+                else s.w_hist
+            ),
         )
 
     out = jax.lax.while_loop(cond, body, init)
@@ -239,4 +251,5 @@ def tron_solve(
         iterations=out.it,
         reason=reason,
         value_history=out.history,
+        w_history=out.w_hist if config.track_coefficients else None,
     )

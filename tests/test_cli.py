@@ -699,6 +699,54 @@ class TestLegacyGlmDriver:
         assert (w <= 0.5 + 1e-6).all() and (w >= -0.5 - 1e-6).all()
 
 
+class TestLegacyGlmParityFlags:
+    def test_validate_per_iteration_and_delete_dirs(
+        self, glmix_avro, tmp_path, caplog
+    ):
+        """--validate-per-iteration logs a metric for every tracked
+        iteration's model (reference VALIDATE_PER_ITERATION + ModelTracker);
+        --delete-output-dirs-if-exist clears stale outputs; --no-warm-start
+        still converges."""
+        import logging
+        import re
+
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        out = tmp_path / "glm_out"
+        out.mkdir()
+        stale = out / "stale-marker"
+        stale.write_text("old")
+        with caplog.at_level(logging.INFO):
+            result = run(parse_args([
+                "--training-data-dirs", str(glmix_avro["train"]),
+                "--validation-data-dirs", str(glmix_avro["test"]),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(out),
+                "--regularization-weights", "0.1", "10.0",
+                "--validate-per-iteration",
+                "--delete-output-dirs-if-exist",
+                "--no-warm-start",
+            ]))
+        assert not stale.exists()
+        assert result["best_lambda"] in (0.1, 10.0)
+        per_iter = re.findall(r"lambda=[\d.]+ iteration=(\d+)", caplog.text)
+        assert len(per_iter) >= 4  # several iterations logged per lambda
+        assert per_iter[0] == "0"
+
+    def test_validate_per_iteration_requires_validation(
+        self, glmix_avro, tmp_path
+    ):
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        with pytest.raises(ValueError, match="validation-data-dirs"):
+            run(parse_args([
+                "--training-data-dirs", str(glmix_avro["train"]),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(tmp_path / "o"),
+                "--validate-per-iteration",
+            ]))
+
+
 class TestBuildIndexDriver:
     def test_build_and_use_offheap_index(self, glmix_avro, tmp_path):
         from photon_ml_tpu.cli.build_index import parse_args, run

@@ -240,6 +240,72 @@ def test_warm_start_lambda_sweep_no_recompile(rng):
 _CENTROID = 4.0
 
 
+@pytest.mark.parametrize("name", ["lbfgs", "tron", "owlqn"])
+def test_track_coefficients_history(rng, name):
+    """OptimizerConfig.track_coefficients records the per-iteration w path
+    (reference ModelTracker): last recorded iterate == final w, the path is
+    finite up to `iterations`, NaN-padded after, and off by default."""
+    data, _ = _logreg_problem(rng)
+    obj = make_glm_objective(LogisticLoss)
+    l2 = jnp.float32(0.1)
+    cfg = OptimizerConfig(max_iterations=40, track_coefficients=True)
+    if name == "lbfgs":
+        res = lbfgs_solve(obj, jnp.zeros(6), data, l2, cfg)
+        res_off = lbfgs_solve(obj, jnp.zeros(6), data, l2)
+    elif name == "tron":
+        res = tron_solve(obj, jnp.zeros(6), data, l2, cfg)
+        res_off = tron_solve(obj, jnp.zeros(6), data, l2)
+    else:
+        res = owlqn_solve(obj, jnp.zeros(6), data, l2, jnp.float32(0.01), cfg)
+        res_off = owlqn_solve(obj, jnp.zeros(6), data, l2, jnp.float32(0.01))
+    assert res_off.w_history is None
+    assert res.w_history is not None
+    hist = np.asarray(res.w_history)
+    iters = int(res.iterations)
+    assert hist.shape == (41, 6)
+    assert np.isfinite(hist[: iters + 1]).all()
+    np.testing.assert_allclose(hist[iters], np.asarray(res.w), rtol=1e-6)
+    if iters < 40:
+        assert np.isnan(hist[iters + 1 :]).all()
+    # the recorded start is the initial point
+    np.testing.assert_allclose(hist[0], 0.0)
+
+
+def test_track_models_through_train_glm(rng):
+    """train_glm(track_models=True) yields per-iteration models whose last
+    entry equals the fit model, mapped back through normalization."""
+    from photon_ml_tpu.estimators.model_training import train_glm
+    from photon_ml_tpu.normalization import build_normalization_context
+    from photon_ml_tpu.stat.summary import summarize
+    from photon_ml_tpu.types import NormalizationType, TaskType
+
+    data, _ = _logreg_problem(rng)
+    labeled = data
+    summary = summarize(labeled)
+    norm = build_normalization_context(
+        NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+        mean=summary.mean,
+        variance=summary.variance,
+        max_magnitude=summary.max_abs,
+        intercept_index=None,
+    )
+    labeled = labeled.replace(norm=norm)
+    cfg = GlmOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(max_iterations=30),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=0.1,
+    )
+    fit = train_glm(labeled, TaskType.LOGISTIC_REGRESSION, cfg,
+                    track_models=True)[0]
+    assert fit.tracked_models is not None
+    assert len(fit.tracked_models) == int(fit.result.iterations) + 1
+    np.testing.assert_allclose(
+        np.asarray(fit.tracked_models[-1].coefficients.means),
+        np.asarray(fit.model.coefficients.means),
+        rtol=2e-4, atol=1e-6,
+    )
+
+
 def _centroid_objective():
     def value(w, data, l2):
         d = w - _CENTROID
