@@ -99,6 +99,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="attach per-coefficient variances ~ 1/(H_jj+eps) to "
                         "FE and RE models; saved in the BayesianLinearModel"
                         "Avro variances field (reference --compute-variance)")
+    p.add_argument("--num-output-files-for-random-effect-model", type=int,
+                   default=1, metavar="N",
+                   help="partition each random-effect coordinate's "
+                        "coefficients across N part files (reference "
+                        "NUM_OUTPUT_FILES_FOR_RANDOM_EFFECT_MODEL)")
     p.add_argument("--model-output-mode", default="BEST",
                    choices=["ALL", "BEST", "NONE"],
                    help="BEST saves the selected model under <output>/best; "
@@ -606,6 +611,9 @@ def run(args: argparse.Namespace) -> GameFit:
                     index_maps=index_maps,
                     model_name=args.model_name,
                     configurations=_config_with_overrides(best_overrides),
+                    num_output_files_per_random_effect=(
+                        args.num_output_files_for_random_effect_model
+                    ),
                 )
                 if args.model_output_mode == "ALL":
                     # reference Driver.scala:416-433: every swept
@@ -620,6 +628,9 @@ def run(args: argparse.Namespace) -> GameFit:
                             index_maps=index_maps,
                             model_name=args.model_name,
                             configurations=_config_with_overrides(ovr),
+                            num_output_files_per_random_effect=(
+                                args.num_output_files_for_random_effect_model
+                            ),
                         )
             logger.info("model saved to %s", os.path.join(args.output_dir, "best"))
         emitter.send_event(TrainingFinishEvent(

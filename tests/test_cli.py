@@ -416,6 +416,33 @@ class TestGameTrainingParityFlags:
                 "--check-data",
             ]))
 
+    def test_num_output_files_for_random_effect_model(
+        self, glmix_avro, tmp_path
+    ):
+        """--num-output-files-for-random-effect-model N partitions the RE
+        coefficients into N part files, and the partitioned model still
+        loads (reference NUM_OUTPUT_FILES_FOR_RANDOM_EFFECT_MODEL)."""
+        from photon_ml_tpu.cli.train_game import parse_args, run
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        out = tmp_path / "re_parts"
+        run(parse_args([
+            "--train-data-dirs", str(glmix_avro["train"]),
+            "--coordinate-config", str(glmix_avro["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--num-output-files-for-random-effect-model", "3",
+        ]))
+        parts = sorted(
+            p.name
+            for p in (
+                out / "best" / "random-effect" / "per_user" / "coefficients"
+            ).glob("part-*.avro")
+        )
+        assert len(parts) == 3, parts
+        model, _ = load_game_model(str(out / "best"))
+        assert model.models["per_user"].num_entities == 8
+
     def test_validation_date_range(self, glmix_avro, tmp_path):
         """--validation-date-range expands validation dirs to daily
         yyyy/MM/dd subdirs like the train-side flag."""

@@ -45,6 +45,18 @@ def _worker_env(n_local_devices: int) -> dict:
 
 
 
+def _cluster_timeout(n_procs: int, base: int = 240) -> int:
+    """N cluster processes time-share the visible cores; on a core-starved
+    box (e.g. a 1-core CI runner) everything — XLA compiles included — runs
+    serially, so the wall-clock budget must scale with the oversubscription
+    factor."""
+    try:
+        cores = len(os.sched_getaffinity(0))  # honors cgroup/affinity limits
+    except AttributeError:  # non-Linux
+        cores = os.cpu_count() or 1
+    return base * max(1, -(-n_procs // max(cores, 1)))
+
+
 def _run_cluster(cmds, logs, env, timeout=240):
     """Launch one process per command with file-backed logs, wait for all,
     kill the stragglers on timeout. Returns (timed_out, outputs)."""
@@ -150,7 +162,9 @@ def test_cli_cluster_training(tmp_path):
         ]
         for i in range(2)
     ]
-    timed_out, procs, outs = _run_cluster(cmds, logs, env)
+    timed_out, procs, outs = _run_cluster(
+        cmds, logs, env, timeout=_cluster_timeout(2)
+    )
     if timed_out:
         pytest.fail("CLI cluster timed out:\n" + "\n".join(outs))
     for i, (p, o) in enumerate(zip(procs, outs)):
@@ -184,7 +198,9 @@ def test_cli_cluster_training(tmp_path):
         ]
         for i in range(2)
     ]
-    timed_out, sprocs, souts = _run_cluster(scmds, slogs, env)
+    timed_out, sprocs, souts = _run_cluster(
+        scmds, slogs, env, timeout=_cluster_timeout(2)
+    )
     if timed_out:
         pytest.fail("score CLI cluster timed out:\n" + "\n".join(souts))
     for i, (p, o) in enumerate(zip(sprocs, souts)):
@@ -219,7 +235,9 @@ def test_cluster_end_to_end(tmp_path, n_procs):
         [sys.executable, _WORKER, str(i), str(n_procs), str(port)]
         for i in range(n_procs)
     ]
-    timed_out, procs, outs = _run_cluster(cmds, logs, env)
+    timed_out, procs, outs = _run_cluster(
+        cmds, logs, env, timeout=_cluster_timeout(n_procs)
+    )
     if timed_out:
         pytest.fail("multi-process cluster timed out:\n" + "\n".join(outs))
     for i, (p, out) in enumerate(zip(procs, outs)):
