@@ -44,30 +44,64 @@ class DataValidationError(ValueError):
 _SAMPLE_FRACTION = 0.10  # VALIDATE_SAMPLE fraction
 
 
-def _feature_values(data: LabeledData) -> np.ndarray:
-    """Per-row explicit feature values as [n, *] (padding slots are 0.0 and
-    vacuously finite, so they never mask a NaN/Inf)."""
-    feats = data.features
+def _spill_values_matrix(feats, n: int) -> Optional[np.ndarray]:
+    """KP-cap spill entries as a row-aligned [n, k] padded matrix."""
+    if getattr(feats, "spill_rows", None) is None:
+        return None
+    sr = np.asarray(feats.spill_rows)
+    sv = np.asarray(feats.spill_vals)
+    order = np.argsort(sr, kind="stable")
+    sr, sv = sr[order], sv[order]
+    counts = np.bincount(sr, minlength=n)
+    k = max(int(counts.max()), 1)
+    starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    slots = np.arange(sr.size, dtype=np.int64) - starts[sr]
+    out = np.zeros((n, k), dtype=np.float32)
+    out[sr, slots] = sv
+    return out
+
+
+def _engine_values(feats) -> np.ndarray:
+    """Per-row explicit feature values of one engine as [n, *] (padding
+    slots are 0.0 and vacuously finite, so they never mask a NaN/Inf)."""
     if isinstance(feats, DenseFeatures):
         return np.asarray(feats.matrix)
     if isinstance(feats, EllFeatures):
         return np.asarray(feats.values)
-    from photon_ml_tpu.ops.sparse_perm import BenesSparseFeatures
+    from photon_ml_tpu.ops.sparse_perm import (
+        BenesSparseFeatures,
+        ColumnSplitFeatures,
+        _ZeroColumnsBlock,
+    )
 
+    if isinstance(feats, _ZeroColumnsBlock):
+        return np.zeros((feats.num_rows_, 1), dtype=np.float32)
+    if isinstance(feats, ColumnSplitFeatures):
+        parts = [_engine_values(blk) for blk in feats.blocks]
+        if feats.hot_matrix is not None:
+            parts.append(np.asarray(feats.hot_matrix))
+        return np.concatenate(parts, axis=1)
     if isinstance(feats, BenesSparseFeatures):
-        cold = np.asarray(feats.ell_values)
-        if feats.hot_matrix is None:
-            return cold
-        return np.concatenate([cold, np.asarray(feats.hot_matrix)], axis=1)
-    from photon_ml_tpu.ops.fused_perm import FusedBenesFeatures
+        parts = [np.asarray(feats.ell_values)]
+        n = feats.num_rows_
+    else:
+        from photon_ml_tpu.ops.fused_perm import FusedBenesFeatures
 
-    if isinstance(feats, FusedBenesFeatures):
-        cold = np.asarray(feats.ell_flat).reshape(-1, feats.ell_k)
-        cold = cold[: feats.num_rows_]
-        if feats.hot_matrix is None:
-            return cold
-        return np.concatenate([cold, np.asarray(feats.hot_matrix)], axis=1)
-    raise TypeError(f"unknown feature matrix type {type(feats)!r}")
+        if not isinstance(feats, FusedBenesFeatures):
+            raise TypeError(f"unknown feature matrix type {type(feats)!r}")
+        n = feats.num_rows_
+        parts = [np.asarray(feats.ell_flat).reshape(-1, feats.ell_k)[:n]]
+    if feats.hot_matrix is not None:
+        parts.append(np.asarray(feats.hot_matrix))
+    spill = _spill_values_matrix(feats, n)
+    if spill is not None:
+        parts.append(spill)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+
+
+def _feature_values(data: LabeledData) -> np.ndarray:
+    return _engine_values(data.features)
 
 
 def validate_labeled_data(

@@ -558,6 +558,11 @@ class FusedBenesFeatures:
     num_cols_: int = struct.field(pytree_node=False)
     ell_k: int = struct.field(pytree_node=False)   # K
     csc_k: int = struct.field(pytree_node=False)   # KP
+    # Spill side (KP cap, sparse_perm.auto_kp_cap): over-cap entries
+    # evaluated by gather/scatter-add; bounded by max(nnz/128, 4096)
+    spill_rows: Optional[jax.Array] = None   # [M] int32
+    spill_cols: Optional[jax.Array] = None   # [M] int32
+    spill_vals: Optional[jax.Array] = None   # [M] float32
 
     @property
     def num_rows(self) -> int:
@@ -589,6 +594,8 @@ class FusedBenesFeatures:
         )[: self.num_rows_]
         if self.hot_matrix is not None:
             z = z + self.hot_matrix @ w[self.hot_cols]
+        if self.spill_rows is not None:
+            z = z.at[self.spill_rows].add(self.spill_vals * w[self.spill_cols])
         return z
 
     def rmatvec(self, c: jax.Array) -> jax.Array:
@@ -610,6 +617,9 @@ class FusedBenesFeatures:
         if self.hot_matrix is not None:
             hot = _apply_transform(self.hot_matrix, transform)
             g = g.at[self.hot_cols].add(hot.T @ c)
+        if self.spill_rows is not None:
+            sv = _apply_transform(self.spill_vals, transform)
+            g = g.at[self.spill_cols].add(sv * c[self.spill_rows])
         return g
 
     def csc_view(self, flat_ell: jax.Array) -> jax.Array:
@@ -630,6 +640,8 @@ class FusedBenesFeatures:
         sq = sq[: self.num_rows_]
         if self.hot_matrix is not None:
             sq = sq + jnp.sum(self.hot_matrix * self.hot_matrix, axis=-1)
+        if self.spill_rows is not None:
+            sq = sq.at[self.spill_rows].add(self.spill_vals * self.spill_vals)
         return sq
 
     def to_dense(self) -> DenseFeatures:
@@ -650,17 +662,25 @@ def from_coo(
     size_floor: int = 0,
     pin_k: int = 0,
     pin_kp: int = 0,
-) -> FusedBenesFeatures:
+    kp_cap="auto",
+    col_split="auto",
+):
     """Build from COO triplets; same contract as ``sparse_perm.from_coo``
-    (including the default per-uid routing-plan cache — see that docstring).
+    (including the default per-uid routing-plan cache and the ``kp_cap``
+    spill side — see that docstring).
 
     ``pin_k`` / ``pin_kp`` / ``size_floor`` force common paddings across
     shards of one dataset (the grid builder stacks tiles under one compiled
     program); pins must be powers of two and at least the shard's actual
     degree (a too-small pin raises rather than silently diverging from the
-    sibling shards).
+    sibling shards). An explicit ``pin_kp`` disables the auto cap.
     """
-    from photon_ml_tpu.ops.sparse_perm import prepare_cold_entries
+    from photon_ml_tpu.ops.sparse_perm import (
+        build_column_split,
+        prepare_cold_entries,
+        resolve_layout,
+        split_spill_entries,
+    )
 
     n, d = shape
     rows, cols, vals, hot_matrix, hot_ids, row_counts, col_counts = (
@@ -675,6 +695,27 @@ def from_coo(
         1,
     )
     KP = max(_next_pow2(int(col_counts.max()) if nnz else 1), 1)
+    spill = (None, None, None)
+    # pinned paddings promise shape stability across sibling shards: the
+    # layout planner must not replace the flat layout behind them
+    if nnz and not pin_k and not pin_kp:
+        cap, t = resolve_layout(
+            kp_cap, col_split, col_counts, n, d, K, KP,
+            size_floor=size_floor,
+        )
+        if t > 1:
+            return build_column_split(
+                from_coo, rows, cols, vals, n, d, t, cap,
+                hot_matrix, hot_ids, plan_cache,
+            )
+        if cap is not None:
+            rows, cols, vals, sr, sc, sv = split_spill_entries(
+                rows, cols, vals, col_counts, cap
+            )
+            spill = (sr, sc, sv)
+            row_counts = np.bincount(rows, minlength=n)
+            col_counts = np.minimum(col_counts, cap)
+            KP = cap
     for name, pin, needed in (("pin_k", pin_k, K), ("pin_kp", pin_kp, KP)):
         if not pin:
             continue
@@ -687,6 +728,7 @@ def from_coo(
     return assemble(
         rows, cols, vals, n, d, K, KP, hot_matrix, hot_ids, plan_cache,
         size_floor=size_floor, row_counts=row_counts, col_counts=col_counts,
+        spill=spill,
     )
 
 
@@ -704,6 +746,7 @@ def assemble(
     size_floor: int = 0,
     row_counts: Optional[np.ndarray] = None,
     col_counts: Optional[np.ndarray] = None,
+    spill=(None, None, None),
 ) -> FusedBenesFeatures:
     """Route + lay out prepared cold entries with pinned power-of-two
     paddings — the fused twin of ``sparse_perm._assemble`` (the grid builder
@@ -727,6 +770,9 @@ def assemble(
     ell_flat = np.zeros(S, dtype=np.float32)
     ell_flat[ell_pos] = vals
 
+    from photon_ml_tpu.ops.sparse_perm import _spill_arrays
+
+    sr, sc, sv = _spill_arrays(*spill)
     return FusedBenesFeatures(
         ell_flat=jnp.asarray(ell_flat),
         plan=device_plan(plan),
@@ -737,4 +783,7 @@ def assemble(
         num_cols_=int(d),
         ell_k=int(K),
         csc_k=int(KP),
+        spill_rows=sr,
+        spill_cols=sc,
+        spill_vals=sv,
     )

@@ -69,6 +69,13 @@ class BenesSparseFeatures:
     hot_cols: Optional[jax.Array]    # [H] int32 original column ids
     num_rows_: int = struct.field(pytree_node=False)
     num_cols_: int = struct.field(pytree_node=False)
+    # Spill side (KP cap, see auto_kp_cap): entries beyond each column's
+    # ``cap`` routed slots, evaluated by gather/scatter-add. Bounded by
+    # max(nnz/128, 4096) at build time, so the scalar ops never dominate
+    # at scale (small shards may spill proportionally more — cheap there).
+    spill_rows: Optional[jax.Array] = None   # [M] int32
+    spill_cols: Optional[jax.Array] = None   # [M] int32
+    spill_vals: Optional[jax.Array] = None   # [M] float32
 
     @property
     def num_rows(self) -> int:
@@ -106,17 +113,29 @@ class BenesSparseFeatures:
         z = jnp.sum(self.ell_values * w_ell, axis=-1)
         if self.hot_matrix is not None:
             z = z + self.hot_matrix @ w[self.hot_cols]
+        if self.spill_rows is not None:
+            z = z.at[self.spill_rows].add(self.spill_vals * w[self.spill_cols])
         return z
 
     def rmatvec(self, c: jax.Array) -> jax.Array:
-        return self._rmatvec_impl(self.ell_values, self.hot_matrix, c)
+        return self._rmatvec_impl(
+            self.ell_values, self.hot_matrix, c, self.spill_vals
+        )
 
     def rmatvec_sq(self, c: jax.Array) -> jax.Array:
         hot_sq = None if self.hot_matrix is None else self.hot_matrix * self.hot_matrix
-        return self._rmatvec_impl(self.ell_values * self.ell_values, hot_sq, c)
+        return self._rmatvec_impl(
+            self.ell_values * self.ell_values, hot_sq, c,
+            None if self.spill_vals is None
+            else self.spill_vals * self.spill_vals,
+        )
 
     def _rmatvec_impl(
-        self, vals: jax.Array, hot: Optional[jax.Array], c: jax.Array
+        self,
+        vals: jax.Array,
+        hot: Optional[jax.Array],
+        c: jax.Array,
+        spill_vals: Optional[jax.Array] = None,
     ) -> jax.Array:
         n, k = vals.shape
         d, kp = self.csc_values.shape
@@ -126,12 +145,16 @@ class BenesSparseFeatures:
         g = jnp.sum(t_csc, axis=-1)
         if hot is not None:
             g = g.at[self.hot_cols].add(hot.T @ c)
+        if spill_vals is not None:
+            g = g.at[self.spill_cols].add(spill_vals * c[self.spill_rows])
         return g
 
     def row_norms_sq(self) -> jax.Array:
         sq = jnp.sum(self.ell_values * self.ell_values, axis=-1)
         if self.hot_matrix is not None:
             sq = sq + jnp.sum(self.hot_matrix * self.hot_matrix, axis=-1)
+        if self.spill_rows is not None:
+            sq = sq.at[self.spill_rows].add(self.spill_vals * self.spill_vals)
         return sq
 
     def to_dense(self):
@@ -143,6 +166,265 @@ class BenesSparseFeatures:
         return DenseFeatures(matrix=cols)
 
 
+@struct.dataclass
+class ColumnSplitFeatures:
+    """Sparse [n, d] matrix as independent column-block engines.
+
+    The routed network's valid sizes step c*128^k with c in {1,2,4,8}
+    (routing.valid_size), so a shard whose d*KP lands just past 8*128^k pays
+    up to 16x slot padding (the 1B-coefficient layout's 2^24-column chip
+    tile: d*KP = 2^26 rounds to 2^28). Splitting the column space into B
+    blocks gives B networks of total size ~B * valid_size(d*KP/B) — back on
+    the ladder — at the cost of B kernel dispatches per linear map inside
+    one jit program. Every block is a full engine (own hot/spill sides);
+    results are exact sums/concats of block results.
+    """
+
+    blocks: tuple                      # sub-engines (pytree node)
+    # global hot-column dense side (ids in GLOBAL column space) — kept
+    # outside the blocks so one [n, H] matmul serves the whole matrix
+    hot_matrix: Optional[jax.Array]
+    hot_cols: Optional[jax.Array]
+    col_bounds: tuple = struct.field(pytree_node=False)  # len(blocks)+1 ints
+    num_rows_: int = struct.field(pytree_node=False)
+    num_cols_: int = struct.field(pytree_node=False)
+
+    @property
+    def num_rows(self) -> int:
+        return self.num_rows_
+
+    @property
+    def dim(self) -> int:
+        return self.num_cols_
+
+    def matvec(self, w: jax.Array) -> jax.Array:
+        z = None
+        for b, blk in enumerate(self.blocks):
+            zb = blk.matvec(w[self.col_bounds[b]: self.col_bounds[b + 1]])
+            z = zb if z is None else z + zb
+        if self.hot_matrix is not None:
+            z = z + self.hot_matrix @ w[self.hot_cols]
+        return z
+
+    def rmatvec(self, c: jax.Array) -> jax.Array:
+        g = jnp.concatenate([blk.rmatvec(c) for blk in self.blocks])
+        if self.hot_matrix is not None:
+            g = g.at[self.hot_cols].add(self.hot_matrix.T @ c)
+        return g
+
+    def rmatvec_sq(self, c: jax.Array) -> jax.Array:
+        g = jnp.concatenate([blk.rmatvec_sq(c) for blk in self.blocks])
+        if self.hot_matrix is not None:
+            hm2 = self.hot_matrix * self.hot_matrix
+            g = g.at[self.hot_cols].add(hm2.T @ c)
+        return g
+
+    def row_norms_sq(self) -> jax.Array:
+        sq = None
+        for blk in self.blocks:
+            sb = blk.row_norms_sq()
+            sq = sb if sq is None else sq + sb
+        if self.hot_matrix is not None:
+            sq = sq + jnp.sum(self.hot_matrix * self.hot_matrix, axis=-1)
+        return sq
+
+    def to_dense(self):
+        from photon_ml_tpu.ops.features import DenseFeatures
+
+        mats = [np.asarray(blk.to_dense().matrix) for blk in self.blocks]
+        dense = np.concatenate(mats, axis=1)
+        if self.hot_matrix is not None:
+            dense[:, np.asarray(self.hot_cols)] += np.asarray(self.hot_matrix)
+        return DenseFeatures(matrix=jnp.asarray(dense))
+
+
+@struct.dataclass
+class _ZeroColumnsBlock:
+    """A column block with no entries: all maps are exact zeros."""
+
+    num_rows_: int = struct.field(pytree_node=False)
+    num_cols_: int = struct.field(pytree_node=False)
+
+    def matvec(self, w: jax.Array) -> jax.Array:
+        return jnp.zeros((self.num_rows_,), dtype=w.dtype)
+
+    def rmatvec(self, c: jax.Array) -> jax.Array:
+        return jnp.zeros((self.num_cols_,), dtype=c.dtype)
+
+    rmatvec_sq = rmatvec
+
+    def row_norms_sq(self) -> jax.Array:
+        return jnp.zeros((self.num_rows_,), dtype=jnp.float32)
+
+    def to_dense(self):
+        from photon_ml_tpu.ops.features import DenseFeatures
+
+        return DenseFeatures(
+            matrix=jnp.zeros((self.num_rows_, self.num_cols_), jnp.float32)
+        )
+
+
+def plan_column_layout(
+    col_counts: np.ndarray,
+    n: int,
+    d: int,
+    K: int,
+    kp_full: int,
+    max_blocks: int = 16,
+    size_floor: int = 0,
+):
+    """Jointly pick (kp_cap, n_col_blocks) minimizing total routed slots.
+
+    The two levers interact through the coarse valid-size ladder
+    (c*128^k, c in {1,2,4,8}): capping KP alone may not cross a ladder
+    step, and splitting alone multiplies the uncapped d*KP. Candidates:
+    every power-of-two cap whose spill fits the nnz/128 budget (plus
+    "no cap"), crossed with block counts {1,2,...,max_blocks}. Returns
+    ``(cap_or_None, n_blocks)``; a layout must beat the plain one by >= 2x
+    in total slots to justify extra dispatches (and any cap must shrink S).
+    """
+    nnz = int(col_counts.sum())
+    s_plain = routing.valid_size(max(n * K, d * kp_full, size_floor, 1))
+    if not nnz or (kp_full <= 1 and d <= 1):
+        return None, 1
+    # nnz/128 keeps the scatter side negligible at scale; the 4096 floor
+    # lets small shards (where every op is cheap anyway) still benefit
+    budget = max(nnz // 128, 4096)
+    caps = [kp_full]
+    p = 1
+    while p < kp_full:
+        if int(np.maximum(col_counts - p, 0).sum()) <= budget:
+            caps.append(p)
+            if 2 * p < kp_full:
+                caps.append(2 * p)  # a gentler cap: less spill, maybe same S
+            break
+        p *= 2
+    best = (None, 1, s_plain)
+    for cap in caps:
+        t = 1
+        while t <= max_blocks:
+            d_b = -(-d // t)
+            s_t = t * routing.valid_size(max(n * K, d_b * cap, size_floor, 1))
+            if s_t < best[2]:
+                best = (None if cap == kp_full else cap, t, s_t)
+            t *= 2
+    cap, t, s_best = best
+    if t > 1 and s_best * 2 > s_plain:
+        # a multi-block layout must be a clear (2x) win; fall back to the
+        # best single-block layout if capping alone still shrinks S
+        best_cap = None
+        for cap in caps[1:]:
+            if routing.valid_size(max(n * K, d * cap, size_floor, 1)) < s_plain:
+                best_cap = cap if best_cap is None else max(best_cap, cap)
+        return best_cap, 1
+    return cap, t
+
+
+def resolve_kp_cap(
+    kp_cap,
+    col_counts: np.ndarray,
+    n: int,
+    d: int,
+    K: int,
+    kp_full: int,
+    size_floor: int = 0,
+) -> Optional[int]:
+    """Normalize a ``kp_cap`` argument ("auto" | int | None/0) to an
+    effective cap strictly below ``kp_full``, or None."""
+    if not kp_cap:
+        return None
+    if kp_cap == "auto":
+        return auto_kp_cap(col_counts, n, d, K, kp_full, size_floor)
+    cap = int(kp_cap)
+    if cap <= 0 or cap >= kp_full:
+        return None
+    if cap & (cap - 1):
+        raise ValueError(f"kp_cap={cap} must be a power of two (or 'auto')")
+    return cap
+
+
+def build_column_split(
+    builder,
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n: int,
+    d: int,
+    t: int,
+    cap: Optional[int],
+    hot_matrix: Optional[np.ndarray],
+    hot_ids: Optional[np.ndarray],
+    plan_cache: Optional[str],
+) -> ColumnSplitFeatures:
+    """Partition COLD entries into ``t`` column blocks and build each with
+    ``builder`` (a from_coo-compatible callable); the hot side stays global.
+    Shared by the stage-by-stage and fused engines."""
+    d_b = -(-d // t)
+    bounds = [min(b * d_b, d) for b in range(t + 1)]
+    blk_of = cols // d_b
+    blocks = []
+    for b in range(t):
+        width = bounds[b + 1] - bounds[b]
+        m = blk_of == b
+        if width <= 0 or not m.any():
+            blocks.append(_ZeroColumnsBlock(num_rows_=n, num_cols_=max(width, 0)))
+            continue
+        blocks.append(
+            builder(
+                rows[m], cols[m] - bounds[b], vals[m], (n, width),
+                plan_cache=plan_cache, max_hot_cols=0,
+                kp_cap=cap, col_split=1,
+            )
+        )
+    return ColumnSplitFeatures(
+        blocks=tuple(blocks),
+        hot_matrix=None if hot_matrix is None else jnp.asarray(hot_matrix),
+        hot_cols=(
+            None if hot_ids is None else jnp.asarray(hot_ids, dtype=jnp.int32)
+        ),
+        col_bounds=tuple(bounds),
+        num_rows_=int(n),
+        num_cols_=int(d),
+    )
+
+
+def _best_split(
+    n: int, d: int, K: int, kp_eff: int, max_blocks: int = 16,
+    size_floor: int = 0,
+) -> int:
+    """Best block count for a FIXED effective KP (2x-win hysteresis)."""
+    s_one = routing.valid_size(max(n * K, d * kp_eff, size_floor, 1))
+    best_t, best_s = 1, s_one
+    t = 2
+    while t <= max_blocks:
+        s_t = t * routing.valid_size(
+            max(n * K, -(-d // t) * kp_eff, size_floor, 1)
+        )
+        if s_t < best_s:
+            best_t, best_s = t, s_t
+        t *= 2
+    return best_t if best_s * 2 <= s_one else 1
+
+
+def resolve_layout(kp_cap, col_split, col_counts, n, d, K, kp_full,
+                   size_floor: int = 0):
+    """Normalize (kp_cap, col_split) arguments to an effective
+    ``(cap_or_None, n_blocks)`` layout. "auto"/"auto" runs the joint
+    planner; manual values are validated and used as-is."""
+    if kp_cap == "auto" and col_split == "auto":
+        return plan_column_layout(
+            col_counts, n, d, K, kp_full, size_floor=size_floor
+        )
+    cap = resolve_kp_cap(kp_cap, col_counts, n, d, K, kp_full, size_floor)
+    if col_split == "auto":
+        t = _best_split(n, d, K, cap or kp_full, size_floor=size_floor)
+    else:
+        t = max(int(col_split or 1), 1)
+        if t > 1 and t & (t - 1):
+            raise ValueError(f"col_split={t} must be a power of two")
+    return cap, t
+
+
 def from_coo(
     rows,
     cols,
@@ -152,7 +434,9 @@ def from_coo(
     plan_cache: Optional[str] = None,
     hot_col_threshold: Optional[int] = None,
     max_hot_cols: int = 128,
-) -> BenesSparseFeatures:
+    kp_cap="auto",
+    col_split="auto",
+):
     """Build from COO triplets (host, vectorized numpy + one Benes routing).
 
     Duplicates are coalesced by summation (scipy COO semantics). The routing
@@ -168,6 +452,14 @@ def from_coo(
     at the ``max_hot_cols`` highest-degree columns. Without the split an
     intercept column (degree n) would pad every CSC column to n slots. Pass
     ``max_hot_cols=0`` to disable.
+
+    ``kp_cap`` ("auto" default) additionally bounds the CSC padding KP when
+    the column-degree tail is thin, spilling the few over-cap entries to a
+    scatter-add side (see :func:`auto_kp_cap`); pass None/0 to disable or a
+    power of two to pin the cap. ``col_split`` ("auto" default) may
+    partition the column space into independent sub-networks when the
+    valid-size ladder would otherwise overshoot (see
+    :class:`ColumnSplitFeatures`); the result then is a ColumnSplitFeatures.
     """
     n, d = shape
     rows, cols, vals, hot_matrix, hot_ids, row_counts, col_counts = (
@@ -182,9 +474,28 @@ def from_coo(
     K = max(k_needed, int(max_nnz_row) if max_nnz_row is not None else 1, 1)
     KP = max(int(col_counts.max()) if nnz else 1, 1)
 
+    cap, t = (None, 1)
+    if nnz:
+        cap, t = resolve_layout(kp_cap, col_split, col_counts, n, d, K, KP)
+    if t > 1:
+        return build_column_split(
+            from_coo, rows, cols, vals, n, d, t, cap,
+            hot_matrix, hot_ids, plan_cache,
+        )
+
+    spill = (None, None, None)
+    if cap is not None:
+        rows, cols, vals, sr, sc, sv = split_spill_entries(
+            rows, cols, vals, col_counts, cap
+        )
+        spill = (sr, sc, sv)
+        row_counts = np.bincount(rows, minlength=n)
+        col_counts = np.minimum(col_counts, cap)
+        KP = cap
+
     return _assemble(
         rows, cols, vals, n, d, K, KP, hot_matrix, hot_ids, plan_cache,
-        row_counts=row_counts, col_counts=col_counts,
+        row_counts=row_counts, col_counts=col_counts, spill=spill,
     )
 
 
@@ -226,6 +537,73 @@ def prepare_cold_entries(
     row_counts = np.bincount(rows, minlength=n) if nnz else np.zeros(n, np.int64)
     col_counts = np.bincount(cols, minlength=d) if nnz else np.zeros(d, np.int64)
     return rows, cols, vals, hot_matrix, hot_ids, row_counts, col_counts
+
+
+def auto_kp_cap(
+    col_counts: np.ndarray,
+    n: int,
+    d: int,
+    K: int,
+    kp_full: int,
+    size_floor: int = 0,
+) -> Optional[int]:
+    """Pick a power-of-two cap on the CSC slot-group size KP, or None.
+
+    The routed network is sized S = valid_size(max(n*K, d*KP, floor)). When
+    column degrees have a thin tail (e.g. the 1B-coefficient grid shard:
+    mean degree ~1, max ~12), KP = max degree pads the network by the
+    max/mean ratio. Capping KP and spilling each column's entries beyond the
+    cap to a tiny COO side (scatter-add at evaluation) shrinks S by that
+    ratio. The cap is the smallest power of two whose spill stays under
+    nnz/128 (scatter cost negligible next to the routed passes), applied
+    only when it actually shrinks S.
+    """
+    nnz = int(col_counts.sum())
+    if not nnz or kp_full <= 1:
+        return None
+    s_now = routing.valid_size(max(n * K, d * kp_full, size_floor, 1))
+    budget = max(nnz // 128, 4096)
+    p = 1
+    while p < kp_full:
+        spill = int(np.maximum(col_counts - p, 0).sum())
+        if spill <= budget:
+            s_new = routing.valid_size(max(n * K, d * p, size_floor, 1))
+            return p if s_new < s_now else None
+        p *= 2
+    return None
+
+
+def split_spill_entries(rows, cols, vals, col_counts: np.ndarray, cap: int):
+    """Split entries so every column keeps at most ``cap`` routed entries.
+
+    Returns ``(cold_rows, cold_cols, cold_vals, spill_rows, spill_cols,
+    spill_vals)``. Kept entries are each column's first ``cap`` in (col,
+    row) order — deterministic for plan-cache stability.
+    """
+    nnz = rows.size
+    corder = lexsort_pairs(cols, rows)
+    col_starts = np.zeros(col_counts.size + 1, dtype=np.int64)
+    np.cumsum(col_counts, out=col_starts[1:])
+    rank = np.arange(nnz, dtype=np.int64) - col_starts[cols[corder]]
+    spill_sorted = rank >= cap
+    spill = np.zeros(nnz, dtype=bool)
+    spill[corder] = spill_sorted
+    keep = ~spill
+    return (
+        rows[keep], cols[keep], vals[keep],
+        rows[spill], cols[spill], vals[spill],
+    )
+
+
+def _spill_arrays(spill_rows, spill_cols, spill_vals):
+    """Device arrays for a spill side (None when empty)."""
+    if spill_rows is None or spill_rows.size == 0:
+        return None, None, None
+    return (
+        jnp.asarray(spill_rows, dtype=jnp.int32),
+        jnp.asarray(spill_cols, dtype=jnp.int32),
+        jnp.asarray(spill_vals, dtype=jnp.float32),
+    )
 
 
 def coalesce_coo(rows, cols, vals, n: int, d: int):
@@ -391,13 +769,15 @@ def _assemble(
     size_floor: int = 0,
     row_counts: Optional[np.ndarray] = None,
     col_counts: Optional[np.ndarray] = None,
+    spill=(None, None, None),
 ) -> BenesSparseFeatures:
     """Route + lay out one (cold-entries, hot-side) pair with pinned paddings.
 
     K/KP/size_floor are caller-pinned so independent shards of one dataset
     can be forced onto identical network shapes (the sharded builder stacks
     them under one compiled program). Callers that already hold the degree
-    bincounts pass them to skip a recount.
+    bincounts pass them to skip a recount. ``spill`` is an optional
+    (rows, cols, vals) COO side of over-cap entries (see auto_kp_cap).
     """
     ell_pos, csc_pos, plan, plan_inv, S = route_layout(
         rows, cols, n, d, K, KP, plan_cache, size_floor, row_counts, col_counts
@@ -408,6 +788,7 @@ def _assemble(
     csc_values = np.zeros((d, KP), dtype=np.float32)
     csc_values.reshape(-1)[csc_pos] = vals
 
+    sr, sc, sv = _spill_arrays(*spill)
     return BenesSparseFeatures(
         ell_values=jnp.asarray(ell_values),
         csc_values=jnp.asarray(csc_values),
@@ -417,6 +798,9 @@ def _assemble(
         hot_cols=None if hot_ids is None else jnp.asarray(hot_ids, dtype=jnp.int32),
         num_rows_=int(n),
         num_cols_=int(d),
+        spill_rows=sr,
+        spill_cols=sc,
+        spill_vals=sv,
     )
 
 

@@ -163,6 +163,7 @@ def grid_from_coo(
     plan_cache: Optional[str] = None,
     hot_col_threshold: Optional[int] = None,
     max_hot_cols: int = 128,
+    kp_cap="auto",
 ) -> GridShardedFeatures:
     """Tile COO entries over the (data, feat) mesh and route each tile
     identically.
@@ -177,6 +178,33 @@ def grid_from_coo(
     n_dd = mesh.shape[DATA_AXIS]
     n_df = mesh.shape[FEAT_AXIS]
     rows, cols, vals = coalesce_coo(rows, cols, vals, n, d)
+
+    if n_dd == 1 and n_df == 1 and engine in ("benes", "fused"):
+        # Single-tile grid: delegate to the full single-device builder so
+        # the automatic KP-cap + column-split layout planner applies (the
+        # 1B-coef chip tile's d*KP would otherwise overshoot the valid-size
+        # ladder by up to 16x). Multi-tile grids pin shapes across tiles
+        # and keep the flat layout below.
+        if engine == "benes":
+            from photon_ml_tpu.ops.sparse_perm import from_coo as _single
+        else:
+            from photon_ml_tpu.ops.fused_perm import from_coo as _single
+
+        tile = _single(
+            rows, cols, vals, (n, d), plan_cache=plan_cache,
+            hot_col_threshold=hot_col_threshold, max_hot_cols=max_hot_cols,
+            kp_cap=kp_cap,
+        )
+        stacked = jax.tree.map(
+            lambda a: place_global(
+                np.asarray(a)[None, None], mesh,
+                P(DATA_AXIS, FEAT_AXIS, *([None] * np.asarray(a).ndim)),
+            ),
+            tile,
+        )
+        return GridShardedFeatures(
+            shards=stacked, mesh=mesh, num_rows_=int(n), num_cols_=int(d)
+        )
 
     n_loc = -(-n // n_dd)
     d_loc = -(-d // n_df)
@@ -216,6 +244,7 @@ def grid_from_coo(
     K = 1
     KP = 1
     tiles_cold = {}
+    tile_col_counts = {}
     for key, (tr, tc, tv) in tile_entries.items():
         hot = tile_hot[key]
         hm = None
@@ -234,9 +263,13 @@ def grid_from_coo(
             hot_full[: hot.size] = hot
             tile_hot[key] = hot_full
         tiles_cold[key] = (tr, tc, tv, hm)
+        tile_col_counts[key] = (
+            np.bincount(tc, minlength=d_loc) if tr.size
+            else np.zeros(d_loc, np.int64)
+        )
         if tr.size:
             K = max(K, int(np.bincount(tr).max()))
-            KP = max(KP, int(np.bincount(tc).max()))
+            KP = max(KP, int(tile_col_counts[key].max()))
 
     if engine == "fused":
         # fused kernels need power-of-two slot groups
@@ -244,6 +277,50 @@ def grid_from_coo(
 
         K = _next_pow2(K)
         KP = _next_pow2(KP)
+
+    # KP cap + spill (sparse_perm.auto_kp_cap, evaluated over the WHOLE
+    # grid's degree distribution so every tile keeps the pinned KP): thin
+    # column-degree tails — the 1B-coef layout's ~1 nnz/col shards — would
+    # otherwise pad every tile's network by max/mean degree.
+    tile_spill = {key: (None, None, None) for key in tiles_cold}
+    if engine in ("benes", "fused") and kp_cap and KP > 1:
+        from photon_ml_tpu.ops.sparse_perm import (
+            resolve_kp_cap,
+            split_spill_entries,
+        )
+
+        all_counts = np.concatenate(
+            [tile_col_counts[key] for key in sorted(tile_col_counts)]
+        )
+        cap = resolve_kp_cap(kp_cap, all_counts, n_loc, d_loc, K, KP)
+        if cap is not None:
+            m_max = 0
+            for key, (tr, tc, tv, hm) in tiles_cold.items():
+                counts = tile_col_counts[key]
+                if tr.size and counts.max() > cap:
+                    tr, tc, tv, sr, sc, sv = split_spill_entries(
+                        tr, tc, tv, counts, cap
+                    )
+                    tiles_cold[key] = (tr, tc, tv, hm)
+                else:
+                    sr = np.zeros(0, np.int64)
+                    sc = np.zeros(0, np.int64)
+                    sv = np.zeros(0, np.float32)
+                tile_spill[key] = (sr, sc, sv)
+                m_max = max(m_max, sr.size)
+            KP = cap
+            if m_max:
+                # pad every tile's spill to one stackable length; padding
+                # entries carry value 0 at (row 0, col 0) — exact no-ops
+                for key, (sr, sc, sv) in tile_spill.items():
+                    pad = m_max - sr.size
+                    tile_spill[key] = (
+                        np.pad(sr, (0, pad)),
+                        np.pad(sc, (0, pad)),
+                        np.pad(sv, (0, pad)),
+                    )
+            else:
+                tile_spill = {key: (None, None, None) for key in tiles_cold}
 
     # In a multi-process cluster, only build (route!) the tiles whose device
     # belongs to this process — the expensive per-tile routing is O(local
@@ -277,7 +354,7 @@ def grid_from_coo(
                 assembler = fused_perm.assemble
             return assembler(
                 tr, tc, tv, n_loc, d_loc, K, KP, hm, hot_ids,
-                plan_cache, size_floor=S,
+                plan_cache, size_floor=S, spill=tile_spill[dd, df],
             )
         ell = _ell_tile(tr, tc, tv, n_loc, d_loc, K)
         if h_common:

@@ -73,15 +73,18 @@ def _benes_stats(feats, weights):
     wsum = jnp.sum(weights)
     ell = feats.ell_values
     hot = feats.hot_matrix
+    sp = feats.spill_vals
     s1 = feats.rmatvec(weights)
     s2 = feats.rmatvec_sq(weights)
     sabs = feats._rmatvec_impl(
-        jnp.abs(ell), None if hot is None else jnp.abs(hot), weights
+        jnp.abs(ell), None if hot is None else jnp.abs(hot), weights,
+        None if sp is None else jnp.abs(sp),
     )
     nnz = feats._rmatvec_impl(
         (ell != 0).astype(ell.dtype),
         None if hot is None else (hot != 0).astype(ell.dtype),
         weights,
+        None if sp is None else (sp != 0).astype(ell.dtype),
     )
     # live-row mask routed to CSC slot order: explicit entries of columns
     # are contiguous there, so per-column min/max are row reductions
@@ -98,6 +101,7 @@ def _benes_stats(feats, weights):
         jnp.where(live, feats.csc_values, jnp.inf), axis=1
     )
     mn, mx = _fold_hot_minmax(mn, mx, hot, feats.hot_cols, weights)
+    mn, mx = _fold_spill_minmax(mn, mx, feats, weights)
     return s1, s2, sabs, nnz, mn, mx, wsum
 
 
@@ -122,7 +126,63 @@ def _fused_stats(feats, weights):
     )
     hot = feats.hot_matrix
     mn, mx = _fold_hot_minmax(mn, mx, hot, feats.hot_cols, weights)
+    mn, mx = _fold_spill_minmax(mn, mx, feats, weights)
     return s1, s2, sabs, nnz, mn, mx, wsum
+
+
+def _split_stats(feats, weights):
+    """Stats for a ColumnSplitFeatures: per-block engine stats concatenated
+    on the column axis, the global hot side folded in afterwards."""
+    from photon_ml_tpu.ops.fused_perm import FusedBenesFeatures
+    from photon_ml_tpu.ops.sparse_perm import (
+        BenesSparseFeatures,
+        _ZeroColumnsBlock,
+    )
+
+    wsum = jnp.sum(weights)
+    parts = []
+    for blk in feats.blocks:
+        if isinstance(blk, _ZeroColumnsBlock):
+            d_b = blk.num_cols_
+            z = jnp.zeros((d_b,), dtype=jnp.float32)
+            parts.append((
+                z, z, z, z,
+                jnp.full((d_b,), jnp.inf, dtype=jnp.float32),
+                jnp.full((d_b,), -jnp.inf, dtype=jnp.float32),
+                wsum,
+            ))
+        elif isinstance(blk, BenesSparseFeatures):
+            parts.append(_benes_stats(blk, weights))
+        elif isinstance(blk, FusedBenesFeatures):
+            parts.append(_fused_stats(blk, weights))
+        else:
+            raise TypeError(f"unknown column block type {type(blk)!r}")
+    s1, s2, sabs, nnz, mn, mx = (
+        jnp.concatenate([p[i] for p in parts]) for i in range(6)
+    )
+    hot = feats.hot_matrix
+    if hot is not None:
+        w = weights[:, None]
+        hc = feats.hot_cols
+        s1 = s1.at[hc].add(jnp.sum(w * hot, axis=0))
+        s2 = s2.at[hc].add(jnp.sum(w * hot * hot, axis=0))
+        sabs = sabs.at[hc].add(jnp.sum(w * jnp.abs(hot), axis=0))
+        nnz = nnz.at[hc].add(jnp.sum(jnp.where(hot != 0, w, 0.0), axis=0))
+        mn, mx = _fold_hot_minmax(mn, mx, hot, hc, weights)
+    return s1, s2, sabs, nnz, mn, mx, wsum
+
+
+def _fold_spill_minmax(mn, mx, feats, weights):
+    """Fold a KP-cap spill side's values into per-column min/max — shared by
+    both permutation engines' stats paths."""
+    sv = feats.spill_vals
+    if sv is None:
+        return mn, mx
+    live = (sv != 0) & (weights[feats.spill_rows] > 0)
+    big = jnp.asarray(jnp.inf, sv.dtype)
+    mn = mn.at[feats.spill_cols].min(jnp.where(live, sv, big))
+    mx = mx.at[feats.spill_cols].max(jnp.where(live, sv, -big))
+    return mn, mx
 
 
 def _fold_hot_minmax(mn, mx, hot, hot_cols, weights):
@@ -138,12 +198,18 @@ def _fold_hot_minmax(mn, mx, hot, hot_cols, weights):
 
 def summarize(data: LabeledData) -> BasicStatisticalSummary:
     from photon_ml_tpu.ops.fused_perm import FusedBenesFeatures
-    from photon_ml_tpu.ops.sparse_perm import BenesSparseFeatures
+    from photon_ml_tpu.ops.sparse_perm import (
+        BenesSparseFeatures,
+        ColumnSplitFeatures,
+    )
 
     feats = data.features
     if isinstance(feats, DenseFeatures):
         s1, s2, sabs, nnz, mn, mx, wsum = _dense_stats(feats.matrix, data.weights)
         sparse = False
+    elif isinstance(feats, ColumnSplitFeatures):
+        s1, s2, sabs, nnz, mn, mx, wsum = _split_stats(feats, data.weights)
+        sparse = True
     elif isinstance(feats, BenesSparseFeatures):
         s1, s2, sabs, nnz, mn, mx, wsum = _benes_stats(feats, data.weights)
         sparse = True

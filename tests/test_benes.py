@@ -391,3 +391,234 @@ class TestEulerColorAtScale:
         assert np.unique(dst.astype(np.int64) * deg + c1).size == dst.size
         c2 = routing.euler_color(src, dst, deg, R, R)
         np.testing.assert_array_equal(c1, c2)
+
+
+class TestKpCapSpill:
+    """KP cap + spill-COO side (sparse_perm.auto_kp_cap): thin column-degree
+    tails — the 1B-coefficient grid shard's ~1 nnz/col — must not pad the
+    routed network by max/mean degree. Every linear map and the stats path
+    must stay exact with entries spilled to the scatter side."""
+
+    def _thin_tail_problem(self, rng, n=512, d=4096, nnz=4096):
+        rows = rng.integers(0, n, nnz).astype(np.int64)
+        cols = rng.integers(0, d, nnz).astype(np.int64)
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        dense = np.zeros((n, d), np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        return rows, cols, vals, dense
+
+    @pytest.mark.parametrize("engine", ["benes", "fused"])
+    def test_capped_maps_match_dense(self, rng, engine):
+        from photon_ml_tpu.ops import fused_perm
+
+        rows, cols, vals, dense = self._thin_tail_problem(rng)
+        n, d = dense.shape
+        builder = from_coo if engine == "benes" else fused_perm.from_coo
+        f_cap = builder(rows, cols, vals, (n, d), plan_cache="",
+                        max_hot_cols=0, kp_cap="auto")
+        f_unc = builder(rows, cols, vals, (n, d), plan_cache="",
+                        max_hot_cols=0, kp_cap=None)
+        # the cap must engage on this degree profile and shrink the network
+        assert f_cap.spill_rows is not None
+        assert f_cap.plan.size < f_unc.plan.size
+        w = rng.standard_normal(d).astype(np.float32)
+        c = rng.standard_normal(n).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f_cap.matvec(jnp.asarray(w))), dense @ w,
+            atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(f_cap.rmatvec(jnp.asarray(c))), dense.T @ c,
+            atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(f_cap.rmatvec_sq(jnp.asarray(c))),
+            (dense * dense).T @ c, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(f_cap.row_norms_sq()), (dense * dense).sum(1),
+            atol=2e-4,
+        )
+
+    @pytest.mark.parametrize("engine", ["benes", "fused"])
+    def test_capped_stats_match_dense(self, rng, engine):
+        from photon_ml_tpu.ops import fused_perm
+        from photon_ml_tpu.ops.data import LabeledData
+        from photon_ml_tpu.ops.features import DenseFeatures
+        from photon_ml_tpu.stat.summary import summarize
+
+        rows, cols, vals, dense = self._thin_tail_problem(rng)
+        n, d = dense.shape
+        builder = from_coo if engine == "benes" else fused_perm.from_coo
+        f_cap = builder(rows, cols, vals, (n, d), plan_cache="",
+                        max_hot_cols=0, kp_cap="auto")
+        assert f_cap.spill_rows is not None
+        wts = rng.random(n).astype(np.float32)
+        y = rng.random(n).astype(np.float32)
+        ref = summarize(LabeledData.create(
+            DenseFeatures(matrix=jnp.asarray(dense)), jnp.asarray(y),
+            weights=jnp.asarray(wts),
+        ))
+        got = summarize(LabeledData.create(
+            f_cap, jnp.asarray(y), weights=jnp.asarray(wts),
+        ))
+        for fld in ("mean", "variance", "num_nonzeros", "max_abs",
+                    "min_val", "max_val", "mean_abs"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, fld)),
+                np.asarray(getattr(ref, fld)),
+                atol=3e-4, err_msg=fld,
+            )
+
+    def test_explicit_cap_and_disable(self, rng):
+        rows, cols, vals, dense = self._thin_tail_problem(rng)
+        n, d = dense.shape
+        f2 = from_coo(rows, cols, vals, (n, d), plan_cache="",
+                      max_hot_cols=0, kp_cap=2)
+        assert f2.csc_values.shape[1] == 2
+        w = rng.standard_normal(d).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f2.matvec(jnp.asarray(w))), dense @ w, atol=2e-4
+        )
+        f_off = from_coo(rows, cols, vals, (n, d), plan_cache="",
+                         max_hot_cols=0, kp_cap=None)
+        assert f_off.spill_rows is None
+        with pytest.raises(ValueError, match="power of two"):
+            from_coo(rows, cols, vals, (n, d), plan_cache="",
+                     max_hot_cols=0, kp_cap=3)
+
+    def test_cap_composes_with_hot_columns(self, rng):
+        """Hot-column dense split and the spill side together: a matrix with
+        an intercept-like full column AND a thin tail."""
+        rows, cols, vals, dense = self._thin_tail_problem(rng, n=256, d=2048)
+        n, d = dense.shape
+        icpt_rows = np.arange(n, dtype=np.int64)
+        rows = np.concatenate([rows, icpt_rows])
+        cols = np.concatenate([cols, np.full(n, d - 1, dtype=np.int64)])
+        ones = np.ones(n, dtype=np.float32)
+        vals = np.concatenate([vals, ones])
+        dense[:, d - 1] += 1.0
+        f = from_coo(rows, cols, vals, (n, d), plan_cache="", kp_cap="auto")
+        assert f.hot_matrix is not None
+        w = rng.standard_normal(d).astype(np.float32)
+        c = rng.standard_normal(n).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f.matvec(jnp.asarray(w))), dense @ w, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(f.rmatvec(jnp.asarray(c))), dense.T @ c, atol=2e-4
+        )
+
+    def test_grid_cap_engages_and_matches_dense(self, rng):
+        from photon_ml_tpu.parallel.grid_features import (
+            grid_from_coo,
+            grid_mesh,
+            shard_vector_data,
+            shard_vector_feat,
+        )
+
+        rows, cols, vals, dense = self._thin_tail_problem(
+            rng, n=512, d=2048, nnz=3000
+        )
+        n, d = dense.shape
+        mesh = grid_mesh(2, 4)
+        gf = grid_from_coo(rows, cols, vals, (n, d), mesh, engine="benes",
+                           plan_cache="")
+        gf_unc = grid_from_coo(rows, cols, vals, (n, d), mesh,
+                               engine="benes", plan_cache="", kp_cap=None)
+        tile = jax.tree.map(lambda a: a[0, 0], gf.shards)
+        tile_unc = jax.tree.map(lambda a: a[0, 0], gf_unc.shards)
+        assert tile.plan.size <= tile_unc.plan.size
+        w = rng.standard_normal(gf.dim).astype(np.float32)
+        w[d:] = 0
+        c = rng.standard_normal(gf.num_rows).astype(np.float32)
+        c[n:] = 0
+        z = np.asarray(gf.matvec(shard_vector_feat(jnp.asarray(w), mesh)))[:n]
+        g = np.asarray(gf.rmatvec(shard_vector_data(jnp.asarray(c), mesh)))[:d]
+        np.testing.assert_allclose(z, dense @ w[:d], atol=3e-4)
+        np.testing.assert_allclose(g, dense.T @ c[:n], atol=3e-4)
+
+    @pytest.mark.parametrize("engine", ["benes", "fused"])
+    def test_column_split_engages_and_matches_dense(self, rng, engine):
+        """The 1B-coef chip-tile profile (n*K ~ d, ~1 nnz/col): the joint
+        layout planner must land under the plain network's slot count and
+        stay exact (ColumnSplitFeatures or cap-only, whichever wins)."""
+        from photon_ml_tpu.ops import fused_perm
+        from photon_ml_tpu.ops.sparse_perm import ColumnSplitFeatures
+
+        n, d, k = 1024, 16384, 16
+        rows = np.repeat(np.arange(n, dtype=np.int64), k)
+        cols = rng.integers(0, d, n * k).astype(np.int64)
+        vals = rng.standard_normal(n * k).astype(np.float32)
+        dense = np.zeros((n, d), np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        builder = from_coo if engine == "benes" else fused_perm.from_coo
+        f = builder(rows, cols, vals, (n, d), plan_cache="", max_hot_cols=0)
+        f_plain = builder(rows, cols, vals, (n, d), plan_cache="",
+                          max_hot_cols=0, kp_cap=None, col_split=1)
+        if isinstance(f, ColumnSplitFeatures):
+            tot = sum(
+                b.plan.size for b in f.blocks if hasattr(b, "plan")
+            )
+        else:
+            tot = f.plan.size
+        assert tot < f_plain.plan.size
+        w = rng.standard_normal(d).astype(np.float32)
+        c = rng.standard_normal(n).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f.matvec(jnp.asarray(w))), dense @ w, atol=3e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(f.rmatvec(jnp.asarray(c))), dense.T @ c, atol=3e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(f.rmatvec_sq(jnp.asarray(c))), (dense * dense).T @ c,
+            atol=3e-4,
+        )
+
+    def test_explicit_column_split(self, rng):
+        from photon_ml_tpu.ops.sparse_perm import ColumnSplitFeatures
+
+        rows, cols, vals, dense = self._thin_tail_problem(rng)
+        n, d = dense.shape
+        f = from_coo(rows, cols, vals, (n, d), plan_cache="",
+                     max_hot_cols=0, kp_cap=None, col_split=4)
+        assert isinstance(f, ColumnSplitFeatures)
+        assert len(f.blocks) == 4
+        w = rng.standard_normal(d).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f.matvec(jnp.asarray(w))), dense @ w, atol=2e-4
+        )
+        with pytest.raises(ValueError, match="power of two"):
+            from_coo(rows, cols, vals, (n, d), plan_cache="",
+                     max_hot_cols=0, col_split=3)
+
+    def test_column_split_stats_and_validation(self, rng):
+        from photon_ml_tpu.data.validators import validate_labeled_data
+        from photon_ml_tpu.ops.data import LabeledData
+        from photon_ml_tpu.ops.features import DenseFeatures
+        from photon_ml_tpu.ops.sparse_perm import ColumnSplitFeatures
+        from photon_ml_tpu.stat.summary import summarize
+        from photon_ml_tpu.types import TaskType
+
+        rows, cols, vals, dense = self._thin_tail_problem(rng)
+        n, d = dense.shape
+        f = from_coo(rows, cols, vals, (n, d), plan_cache="",
+                     max_hot_cols=0, col_split=4)
+        assert isinstance(f, ColumnSplitFeatures)
+        wts = rng.random(n).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        ld = LabeledData.create(f, jnp.asarray(y), weights=jnp.asarray(wts))
+        got = summarize(ld)
+        ref = summarize(LabeledData.create(
+            DenseFeatures(matrix=jnp.asarray(dense)), jnp.asarray(y),
+            weights=jnp.asarray(wts),
+        ))
+        for fld in ("mean", "variance", "num_nonzeros", "max_abs",
+                    "min_val", "max_val", "mean_abs"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, fld)),
+                np.asarray(getattr(ref, fld)),
+                atol=3e-4, err_msg=fld,
+            )
+        validate_labeled_data(ld, TaskType.LOGISTIC_REGRESSION)

@@ -86,7 +86,25 @@ class TestUnfusedFallback:
         _check_against_dense(feats, dense, rng)
 
     def test_kp_above_128(self, rng):
-        # one column with degree > 128 and the hot split disabled: KP = 256
+        # one column with degree > 128 and the hot split disabled: KP = 512
+        # (kp_cap=None + col_split=1 keep the big slot group this test
+        # exercises; the auto layout would legitimately spill/split instead)
+        n, d = 300, 12
+        rows = np.arange(n)
+        cols = np.full(n, 3)
+        vals = rng.standard_normal(n).astype(np.float32)
+        dense = np.zeros((n, d), dtype=np.float32)
+        dense[rows, cols] = vals
+        feats = from_coo(rows, cols, vals, (n, d), max_hot_cols=0,
+                         kp_cap=None, col_split=1)
+        assert feats.csc_k == 512
+        _check_against_dense(feats, dense, rng)
+
+    def test_kp_above_128_auto_layout_stays_exact(self, rng):
+        # same matrix with the default auto layout: the heavy column spills
+        # and/or the columns split, and results stay exact
+        from photon_ml_tpu.ops.sparse_perm import ColumnSplitFeatures
+
         n, d = 300, 12
         rows = np.arange(n)
         cols = np.full(n, 3)
@@ -94,7 +112,10 @@ class TestUnfusedFallback:
         dense = np.zeros((n, d), dtype=np.float32)
         dense[rows, cols] = vals
         feats = from_coo(rows, cols, vals, (n, d), max_hot_cols=0)
-        assert feats.csc_k == 512
+        assert (
+            isinstance(feats, ColumnSplitFeatures)
+            or feats.spill_rows is not None
+        )
         _check_against_dense(feats, dense, rng)
 
     def test_empty(self):
