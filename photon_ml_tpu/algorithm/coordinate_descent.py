@@ -42,6 +42,9 @@ class CoordinateDescent:
         num_rows: int,
         update_order: Optional[Sequence[str]] = None,
         training_objective: Optional[Callable[[np.ndarray], float]] = None,
+        regularization_term: Optional[
+            Callable[[Dict[str, object]], float]
+        ] = None,
         validate: Optional[Callable[[Dict[str, object]], float]] = None,
         validation_better_than: Optional[Callable[[float, float], bool]] = None,
     ) -> None:
@@ -54,6 +57,10 @@ class CoordinateDescent:
         if unknown:
             raise ValueError(f"unknown coordinates in update order: {unknown}")
         self.training_objective = training_objective
+        # optional Σ per-coordinate regularization over the current models:
+        # the reference logs the objective decomposed into loss +
+        # regularization per update (CoordinateDescent.scala:247-258)
+        self.regularization_term = regularization_term
         self.validate = validate
         # Evaluator.better_than semantics (larger/smaller-is-better + NaN
         # policy) come from the evaluator itself; default: larger is better.
@@ -104,12 +111,25 @@ class CoordinateDescent:
                 scores[cid] = coord.score(model)
 
                 if self.training_objective is not None:
-                    obj = float(self.training_objective(total_score()))
-                    objective_history.append((cid, obj))
-                    logger.info(
-                        "CD iter %d coordinate %s: training objective %.6f",
-                        outer, cid, obj,
-                    )
+                    loss_val = float(self.training_objective(total_score()))
+                    if self.regularization_term is not None:
+                        # objective = loss + regularization (reference
+                        # CoordinateDescent.scala:247-258); the history and
+                        # the log agree on what "objective" means
+                        reg = float(self.regularization_term(models))
+                        obj = loss_val + reg
+                        objective_history.append((cid, obj))
+                        logger.info(
+                            "CD iter %d coordinate %s: loss %.6f + "
+                            "regularization %.6f = objective %.6f",
+                            outer, cid, loss_val, reg, obj,
+                        )
+                    else:
+                        objective_history.append((cid, loss_val))
+                        logger.info(
+                            "CD iter %d coordinate %s: training objective %.6f",
+                            outer, cid, loss_val,
+                        )
                 if self.validate is not None:
                     metric = float(self.validate(models))
                     validation_history.append((cid, metric))

@@ -138,6 +138,16 @@ class FactoredRandomEffectModel:
     def num_latent_factors(self) -> int:
         return int(self.projection_matrix.shape[1])
 
+    def to_summary_string(self) -> str:
+        """Reference Summarizable.toSummaryString (FactoredRandomEffectModel)."""
+        return (
+            f"factored random effect '{self.random_effect_type}': "
+            f"{self.latent.num_entities} entities x "
+            f"{self.num_latent_factors} latent factors, projection matrix "
+            f"[{int(self.projection_matrix.shape[0])}, "
+            f"{self.num_latent_factors}]"
+        )
+
     def coefficients_for(self, entity_id: str) -> Optional[dict]:
         """Dense original-space coefficients w = B @ latent for one entity."""
         loc = self.latent.entity_to_loc.get(str(entity_id))

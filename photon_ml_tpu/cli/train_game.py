@@ -546,6 +546,7 @@ def run(args: argparse.Namespace) -> GameFit:
             logger.info("objective [%s]: %.6f", cid, value)
         if fit.validation_metric is not None:
             logger.info("validation metric: %.6f", fit.validation_metric)
+        logger.info("%s", fit.model.to_summary_string())
 
         best = fit
         best_overrides: Dict[str, object] = fit_overrides

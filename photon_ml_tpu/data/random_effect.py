@@ -128,6 +128,30 @@ class RandomEffectDataset:
     def num_entities(self) -> int:
         return sum(len(ids) for ids in self.entity_ids)
 
+    def to_summary_string(self) -> str:
+        """Reference RandomEffectDataSet.toSummaryString
+        (RandomEffectDataSet.scala:204-228): active/passive sample counts
+        plus this layout's padding accounting."""
+        from photon_ml_tpu.parallel.mesh import fetch_global
+
+        active = 0
+        cells = 0
+        for b in self.buckets:
+            wt = np.asarray(fetch_global(b.weights))
+            active += int((wt > 0).sum())
+            cells += int(wt.size)
+        passive = sum(
+            0 if p is None else int(p.sample_pos.shape[0])
+            for p in self.passive
+        )
+        pad = cells / active if active else float("nan")
+        return (
+            f"random-effect dataset '{self.config.random_effect_type}': "
+            f"{self.num_entities} entities in {len(self.buckets)} buckets, "
+            f"{active} active samples (padding {pad:.2f}x), "
+            f"{passive} passive samples, global dim {self.global_dim}"
+        )
+
     def update_offsets(self, offsets: np.ndarray) -> "RandomEffectDataset":
         """Rebuild the per-bucket offset blocks from a full-data offset vector
         (the residual trick: Coordinate.updateModel / addScoresToOffsets)."""

@@ -47,6 +47,47 @@ from photon_ml_tpu.types import TaskType
 logger = logging.getLogger("photon_ml_tpu")
 
 
+def _model_regularization(model, cfg: "CoordinateConfiguration") -> float:
+    """One coordinate's regularization term 0.5*l2*||w||^2 + l1*||w||_1
+    over its current model (reference getRegularizationTermValue)."""
+    from photon_ml_tpu.algorithm.factored_random_effect import (
+        FactoredRandomEffectModel,
+    )
+    from photon_ml_tpu.models.glm import GeneralizedLinearModel
+    from photon_ml_tpu.models.random_effect import RandomEffectModel
+
+    def norms(a):
+        # device-side reductions: only the two scalars reach the host
+        # (sharded arrays reduce with XLA-inserted collectives; no gather)
+        return float(jnp.sum(a * a)), float(jnp.sum(jnp.abs(a)))
+
+    def term(sq: float, ab: float, opt) -> float:
+        return 0.5 * opt.l2_weight * sq + opt.l1_weight * ab
+
+    opt = cfg.optimizer
+    if isinstance(model, GeneralizedLinearModel):
+        sq, ab = norms(model.coefficients.means)
+        return term(sq, ab, opt)
+    if isinstance(model, FactoredRandomEffectModel):
+        sq = ab = 0.0
+        for c in model.latent.coefficients:
+            s, a = norms(c)
+            sq += s
+            ab += a
+        total = term(sq, ab, opt)
+        matrix_opt = getattr(cfg, "matrix_optimizer", None) or opt
+        s, a = norms(model.projection_matrix)
+        return total + term(s, a, matrix_opt)
+    if isinstance(model, RandomEffectModel):
+        sq = ab = 0.0
+        for c in model.coefficients:
+            s, a = norms(c)
+            sq += s
+            ab += a
+        return term(sq, ab, opt)
+    return 0.0
+
+
 def _describe_config(cfg: GlmOptimizationConfiguration) -> str:
     return (
         f"{cfg.optimizer_config.optimizer.name}"
@@ -210,6 +251,9 @@ class GameEstimator:
             offsets=data.offsets,
             weights=data.weights,
         )
+        if logger.isEnabledFor(logging.INFO):
+            # the summary gathers bucket weights; skip entirely when unheard
+            logger.info("[%s] %s", cid, re_ds.to_summary_string())
         mesh = None
         mesh_axes = None
         if self.parallel is not None:
@@ -514,6 +558,18 @@ class GameEstimator:
             terms = loss.value(z, labels)
             return float(jnp.sum(jnp.where(weights > 0, weights * terms, 0.0)))
 
+        def regularization_term(models: Dict[str, object]) -> float:
+            """Σ per-coordinate 0.5*l2*||w||^2 + l1*||w||_1 over the current
+            models (reference getRegularizationTermValue, logged per update
+            CoordinateDescent.scala:247-258)."""
+            total = 0.0
+            for cid, m in models.items():
+                cfg = self.coordinate_configs.get(cid)
+                if cfg is None:
+                    continue
+                total += _model_regularization(m, cfg)
+            return total
+
         validate = None
         if validation_data is not None:
             def validate(models: Dict[str, object]) -> float:
@@ -546,6 +602,7 @@ class GameEstimator:
             num_rows=data.num_rows,
             update_order=self.update_order,
             training_objective=training_objective,
+            regularization_term=regularization_term,
             validate=validate,
             validation_better_than=self.evaluator.better_than,
         )

@@ -51,6 +51,19 @@ class GameModel:
             if cid not in self.meta:
                 raise ValueError(f"coordinate {cid} missing metadata")
 
+    def to_summary_string(self) -> str:
+        """Reference GameModel.toSummaryString: one line per coordinate."""
+        lines = [f"GAME model ({self.task.value}), {len(self.models)} coordinates:"]
+        for cid in self.models:
+            sub = self.models[cid]
+            detail = (
+                sub.to_summary_string()
+                if hasattr(sub, "to_summary_string")
+                else type(sub).__name__
+            )
+            lines.append(f"  [{cid}] {detail}")
+        return "\n".join(lines)
+
     def score_coordinate(self, cid: str, data: GameData) -> np.ndarray:
         """Raw scores of one sub-model over arbitrary GameData rows."""
         model = self.models[cid]

@@ -40,6 +40,23 @@ class GeneralizedLinearModel:
             z = z + offsets
         return mean_function(self.task, z)
 
+    def to_summary_string(self) -> str:
+        """Reference Summarizable.toSummaryString (GeneralizedLinearModel)."""
+        import numpy as np
+
+        from photon_ml_tpu.parallel.mesh import fetch_global
+
+        w = np.asarray(fetch_global(self.coefficients.means))
+        nnz = int(np.count_nonzero(w))
+        head = (
+            f"{self.task.value} GLM: {w.shape[0]} coefficients ({nnz} nonzero)"
+        )
+        if w.size:
+            head += f", |w| max {np.abs(w).max():.4g} mean {np.abs(w).mean():.4g}"
+        if self.coefficients.variances is not None:
+            head += ", with variances"
+        return head
+
     def predict_class(
         self, features, offsets=None, threshold: float = POSITIVE_RESPONSE_THRESHOLD
     ) -> jax.Array:

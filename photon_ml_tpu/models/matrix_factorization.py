@@ -30,6 +30,15 @@ class MatrixFactorizationModel:
     def num_latent_factors(self) -> int:
         return int(self.row_factors.shape[1])
 
+    def to_summary_string(self) -> str:
+        """Reference Summarizable.toSummaryString (MatrixFactorizationModel)."""
+        return (
+            f"matrix factorization '{self.row_effect_type}' x "
+            f"'{self.col_effect_type}': {self.row_factors.shape[0]} x "
+            f"{self.col_factors.shape[0]} entities, "
+            f"{self.num_latent_factors} latent factors"
+        )
+
     def __post_init__(self) -> None:
         if self.row_factors.shape[1] != self.col_factors.shape[1]:
             raise ValueError(

@@ -54,6 +54,20 @@ class RandomEffectModel:
     def num_entities(self) -> int:
         return sum(len(ids) for ids in self.entity_ids)
 
+    def to_summary_string(self) -> str:
+        """Reference Summarizable.toSummaryString (RandomEffectModel)."""
+        dims = [int(c.shape[1]) for c in self.coefficients]
+        return (
+            f"random effect '{self.random_effect_type}': "
+            f"{self.num_entities} entities in {len(self.coefficients)} "
+            f"buckets (local dims {min(dims)}-{max(dims)}), "
+            f"global dim {self.global_dim}, "
+            f"projector {self.projector_type.value}"
+            + (", with variances" if any(
+                v is not None for v in self.variances
+            ) else "")
+        )
+
     def coefficients_for(self, entity_id: str) -> Optional[Dict[int, float]]:
         """Global-space sparse coefficients {feature_index: value} for one
         entity (host-side; model export / serving by id)."""

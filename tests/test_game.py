@@ -249,3 +249,46 @@ def test_fit_multiple_configs_and_best_selection(rng):
     # reduceOption on empty evaluations)
     fits_nv = est.fit_multiple(data, configs=[{"fixed": L2(0.01)}])
     assert est.select_best_fit(fits_nv) is None
+
+
+def test_objective_decomposition_and_model_summaries(rng, caplog):
+    """The CD loop logs loss + regularization = objective per coordinate
+    update (reference CoordinateDescent.scala:247-258), and every model /
+    dataset exposes a toSummaryString equivalent."""
+    import logging
+    import re
+
+    data, _ = _glmix_problem(rng, n_users=8, rows_per_user=30)
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinates={
+            "fixed": FixedEffectCoordinateConfiguration("global", L2(0.5)),
+            "per-user": RandomEffectCoordinateConfiguration(
+                "per_user",
+                data=RandomEffectDataConfiguration("userId", num_buckets=1),
+                optimizer=L2(1.0),
+            ),
+        },
+        update_order=["fixed", "per-user"],
+    )
+    with caplog.at_level(logging.INFO, logger="photon_ml_tpu"):
+        fit = est.fit(data)
+    decomp = re.findall(
+        r"loss ([\d.eE+-]+) \+ regularization ([\d.eE+-]+) = objective "
+        r"([\d.eE+-]+)",
+        caplog.text,
+    )
+    assert len(decomp) >= 2  # one per coordinate update
+    for loss_s, reg_s, obj_s in decomp:
+        assert abs(float(loss_s) + float(reg_s) - float(obj_s)) < 1e-4
+    # a trained model has nonzero coefficients -> positive L2 term
+    assert float(decomp[-1][1]) > 0
+    # the history stores the SAME objective the log line names (loss + reg)
+    assert abs(fit.objective_history[-1][1] - float(decomp[-1][2])) < 1e-4
+    # dataset summary logged at build time (RandomEffectDataSet.scala:204-228)
+    assert "random-effect dataset 'userId'" in caplog.text
+    assert "active samples" in caplog.text
+
+    s = fit.model.to_summary_string()
+    assert "GAME model" in s and "[fixed]" in s and "[per-user]" in s
+    assert "GLM" in s and "random effect 'userId'" in s
