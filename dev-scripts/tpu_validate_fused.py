@@ -87,6 +87,35 @@ def main():
     print(f"phase 1b: L-BFGS solves agree, max|dw| = {dw:.2e}", flush=True)
     assert dw < 5e-3
 
+    # ---- phase 1c: KP-cap spill + column-split layout on hardware --------
+    # thin-column-tail profile (the 1B-coef chip-tile shape): the joint
+    # layout planner must engage AND stay exact under real Mosaic lowering
+    # + the scatter-add spill side
+    n2, d2, k2 = 4096, 65536, 16
+    rows2 = np.repeat(np.arange(n2, dtype=np.int64), k2)
+    cols2 = rng.integers(0, d2, n2 * k2).astype(np.int64)
+    vals2 = rng.standard_normal(n2 * k2).astype(np.float32)
+    for eng_name, mod in (("benes", sparse_perm), ("fused", fused_perm)):
+        f2 = mod.from_coo(rows2, cols2, vals2, (n2, d2), max_hot_cols=0)
+        from photon_ml_tpu.ops.sparse_perm import ColumnSplitFeatures
+
+        layout = (
+            f"{len(f2.blocks)} column blocks"
+            if isinstance(f2, ColumnSplitFeatures)
+            else f"flat, spill={f2.spill_rows is not None}"
+        )
+        w2 = rng.standard_normal(d2).astype(np.float32)
+        c2 = rng.standard_normal(n2).astype(np.float32)
+        z2 = np.asarray(jax.jit(f2.matvec)(jnp.asarray(w2)))
+        g2 = np.asarray(jax.jit(f2.rmatvec)(jnp.asarray(c2)))
+        z_ref = (vals2.reshape(n2, k2) * w2[cols2.reshape(n2, k2)]).sum(-1)
+        g_ref = np.zeros(d2, np.float64)
+        np.add.at(g_ref, cols2, vals2 * np.repeat(c2, k2))
+        assert np.abs(z2 - z_ref).max() < 2e-3, eng_name
+        assert np.abs(g2 - g_ref).max() < 2e-3, eng_name
+        print(f"phase 1c: {eng_name} auto layout ({layout}) exact on "
+              "hardware", flush=True)
+
     # ---- phase 2: timings at bench scale ---------------------------------
     import bench as B
 

@@ -20,7 +20,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -332,6 +332,7 @@ def run(args: argparse.Namespace) -> dict:
         # (reference Driver.validate + ModelSelection.selectBestModel)
         evaluator = default_evaluator(task)
         metrics = {}
+        per_iter_metrics: Dict[float, List[float]] = {}
         best_lambda = None
         if args.validation_data_dirs:
             with timer.time("validate"):
@@ -364,6 +365,7 @@ def run(args: argparse.Namespace) -> dict:
                     if args.validate_per_iteration and fit.tracked_models:
                         # metric-vs-iteration curve from the per-iteration
                         # tracked models (reference validatePerIteration)
+                        curve = []
                         for i, tm in enumerate(fit.tracked_models):
                             s_i = np.asarray(
                                 tm.compute_score(vfeats)
@@ -371,11 +373,13 @@ def run(args: argparse.Namespace) -> dict:
                             m_i = evaluator.evaluate(
                                 s_i, vdata.labels, vdata.weights
                             )
+                            curve.append(float(m_i))
                             logger.info(
                                 "lambda=%g iteration=%d %s=%.6f",
                                 fit.regularization_weight, i,
                                 evaluator.name, m_i,
                             )
+                        per_iter_metrics[fit.regularization_weight] = curve
             best_lambda = None
             for lam, m in metrics.items():
                 # nan-aware comparison (NaN never wins; reference
@@ -435,6 +439,8 @@ def run(args: argparse.Namespace) -> dict:
                     args, task, data, labeled, fits, best_lambda, imap,
                     intercept_index, configuration, logger,
                     val_data=vdata if args.validation_data_dirs else None,
+                    metric_vs_iteration=per_iter_metrics or None,
+                    metric_name=evaluator.name,
                 )
 
         emitter.send_event(TrainingFinishEvent(
@@ -450,7 +456,8 @@ def run(args: argparse.Namespace) -> dict:
 
 def _diagnose(
     args, task, data, labeled, fits, best_lambda, imap, intercept_index,
-    configuration, logger, val_data=None,
+    configuration, logger, val_data=None, metric_vs_iteration=None,
+    metric_name="metric",
 ) -> None:
     """Reference Driver diagnose() stage (Driver.scala:612-638): the mode
     splits the report — TRAIN|ALL runs the training-data diagnostics
@@ -565,6 +572,8 @@ def _diagnose(
         independence=independence,
         importance=importance,
         importance_variance=importance_var,
+        metric_vs_iteration=metric_vs_iteration,
+        metric_name=metric_name,
     )
     out = write_diagnostic_report(args.output_dir, doc)
     logger.info("diagnostic report: %s", out)

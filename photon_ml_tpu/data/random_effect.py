@@ -131,15 +131,16 @@ class RandomEffectDataset:
     def to_summary_string(self) -> str:
         """Reference RandomEffectDataSet.toSummaryString
         (RandomEffectDataSet.scala:204-228): active/passive sample counts
-        plus this layout's padding accounting."""
-        from photon_ml_tpu.parallel.mesh import fetch_global
+        plus this layout's padding accounting. Device-side reductions only
+        (collective-safe on sharded buckets — callers must invoke this
+        symmetrically on every process, never behind per-process branches)."""
+        import jax.numpy as jnp
 
         active = 0
         cells = 0
         for b in self.buckets:
-            wt = np.asarray(fetch_global(b.weights))
-            active += int((wt > 0).sum())
-            cells += int(wt.size)
+            active += int(jnp.sum(b.weights > 0))
+            cells += int(np.prod(b.weights.shape))
         passive = sum(
             0 if p is None else int(p.sample_pos.shape[0])
             for p in self.passive

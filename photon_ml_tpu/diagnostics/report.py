@@ -9,7 +9,7 @@ feature-importance diagnostics per λ and renders one HTML document
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -40,8 +40,26 @@ def build_diagnostic_document(
     independence: Optional[KendallTauReport] = None,
     importance: Optional[FeatureImportanceReport] = None,
     importance_variance: Optional[FeatureImportanceReport] = None,
+    metric_vs_iteration: Optional[Dict[float, List[float]]] = None,
+    metric_name: str = "metric",
 ) -> Document:
     doc = Document(title=title)
+
+    if metric_vs_iteration:
+        # reference validatePerIteration: the per-iteration tracked models'
+        # validation metric, one series per regularization weight
+        doc.chapters.append(Chapter("Metric vs iteration", [Section(
+            "Validation metric of each tracked iteration's model",
+            [Plot(
+                title=f"{metric_name} vs optimizer iteration",
+                x_label="iteration", y_label=metric_name,
+                series=[
+                    (f"lambda={lam:g}",
+                     list(range(len(curve))), list(curve))
+                    for lam, curve in sorted(metric_vs_iteration.items())
+                ],
+            )],
+        )]))
 
     if metrics:
         doc.chapters.append(Chapter("Model metrics", [Section("Summary", [

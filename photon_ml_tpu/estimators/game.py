@@ -47,44 +47,40 @@ from photon_ml_tpu.types import TaskType
 logger = logging.getLogger("photon_ml_tpu")
 
 
-def _model_regularization(model, cfg: "CoordinateConfiguration") -> float:
+def _coordinate_regularization(model, coord) -> float:
     """One coordinate's regularization term 0.5*l2*||w||^2 + l1*||w||_1
-    over its current model (reference getRegularizationTermValue)."""
+    over its current model (reference getRegularizationTermValue). The
+    weights come from the COORDINATE object (which carries any sweep/tuning
+    overrides), not the estimator's base configs. All reductions run on
+    device (sharded arrays reduce with XLA-inserted collectives); exactly
+    one scalar reaches the host per call."""
     from photon_ml_tpu.algorithm.factored_random_effect import (
+        FactoredRandomEffectCoordinate,
         FactoredRandomEffectModel,
     )
     from photon_ml_tpu.models.glm import GeneralizedLinearModel
     from photon_ml_tpu.models.random_effect import RandomEffectModel
 
-    def norms(a):
-        # device-side reductions: only the two scalars reach the host
-        # (sharded arrays reduce with XLA-inserted collectives; no gather)
-        return float(jnp.sum(a * a)), float(jnp.sum(jnp.abs(a)))
+    def term(a, opt):
+        return 0.5 * opt.l2_weight * jnp.sum(a * a) + opt.l1_weight * jnp.sum(
+            jnp.abs(a)
+        )
 
-    def term(sq: float, ab: float, opt) -> float:
-        return 0.5 * opt.l2_weight * sq + opt.l1_weight * ab
-
-    opt = cfg.optimizer
-    if isinstance(model, GeneralizedLinearModel):
-        sq, ab = norms(model.coefficients.means)
-        return term(sq, ab, opt)
     if isinstance(model, FactoredRandomEffectModel):
-        sq = ab = 0.0
-        for c in model.latent.coefficients:
-            s, a = norms(c)
-            sq += s
-            ab += a
-        total = term(sq, ab, opt)
-        matrix_opt = getattr(cfg, "matrix_optimizer", None) or opt
-        s, a = norms(model.projection_matrix)
-        return total + term(s, a, matrix_opt)
+        assert isinstance(coord, FactoredRandomEffectCoordinate)
+        total = sum(
+            term(c, coord.re_configuration)
+            for c in model.latent.coefficients
+        )
+        total = total + term(model.projection_matrix, coord.matrix_configuration)
+        return float(total)
+    opt = getattr(coord, "configuration", None)
+    if opt is None:
+        return 0.0
+    if isinstance(model, GeneralizedLinearModel):
+        return float(term(model.coefficients.means, opt))
     if isinstance(model, RandomEffectModel):
-        sq = ab = 0.0
-        for c in model.coefficients:
-            s, a = norms(c)
-            sq += s
-            ab += a
-        return term(sq, ab, opt)
+        return float(sum(term(c, opt) for c in model.coefficients))
     return 0.0
 
 
@@ -251,9 +247,10 @@ class GameEstimator:
             offsets=data.offsets,
             weights=data.weights,
         )
-        if logger.isEnabledFor(logging.INFO):
-            # the summary gathers bucket weights; skip entirely when unheard
-            logger.info("[%s] %s", cid, re_ds.to_summary_string())
+        # computed unconditionally: the summary's device reductions are
+        # collectives on sharded buckets, so they must run on every process
+        # regardless of per-process log levels
+        logger.info("[%s] %s", cid, re_ds.to_summary_string())
         mesh = None
         mesh_axes = None
         if self.parallel is not None:
@@ -558,16 +555,25 @@ class GameEstimator:
             terms = loss.value(z, labels)
             return float(jnp.sum(jnp.where(weights > 0, weights * terms, 0.0)))
 
+        # per-coordinate cache keyed by model identity (strong ref, so an id
+        # is never reused while cached): only the coordinate that just
+        # updated recomputes its term
+        reg_cache: Dict[str, Tuple[object, float]] = {}
+
         def regularization_term(models: Dict[str, object]) -> float:
             """Σ per-coordinate 0.5*l2*||w||^2 + l1*||w||_1 over the current
             models (reference getRegularizationTermValue, logged per update
-            CoordinateDescent.scala:247-258)."""
+            CoordinateDescent.scala:247-258). Weights come from the built
+            Coordinate objects, which carry sweep/tuning overrides."""
             total = 0.0
             for cid, m in models.items():
-                cfg = self.coordinate_configs.get(cid)
-                if cfg is None:
+                coord = coordinates.get(cid)
+                if coord is None:
                     continue
-                total += _model_regularization(m, cfg)
+                cached = reg_cache.get(cid)
+                if cached is None or cached[0] is not m:
+                    reg_cache[cid] = (m, _coordinate_regularization(m, coord))
+                total += reg_cache[cid][1]
             return total
 
         validate = None

@@ -760,6 +760,26 @@ class TestLegacyGlmParityFlags:
         assert len(per_iter) >= 4  # several iterations logged per lambda
         assert per_iter[0] == "0"
 
+    def test_validate_per_iteration_plot_in_report(self, glmix_avro, tmp_path):
+        """--validate-per-iteration + diagnostics: the HTML report carries
+        the metric-vs-iteration chapter (reference validatePerIteration
+        feeding the report engine)."""
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        out = tmp_path / "glm_report"
+        run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--validation-data-dirs", str(glmix_avro["test"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "0.1",
+            "--validate-per-iteration",
+            "--diagnostic-mode", "VALIDATE",
+        ]))
+        html = (out / "model-diagnostic.html").read_text()
+        assert "Metric vs iteration" in html
+        assert "lambda=0.1" in html
+
     def test_validate_per_iteration_requires_validation(
         self, glmix_avro, tmp_path
     ):

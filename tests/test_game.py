@@ -292,3 +292,29 @@ def test_objective_decomposition_and_model_summaries(rng, caplog):
     s = fit.model.to_summary_string()
     assert "GAME model" in s and "[fixed]" in s and "[per-user]" in s
     assert "GLM" in s and "random effect 'userId'" in s
+
+
+def test_sweep_override_weights_in_objective_decomposition(rng, caplog):
+    """fit_multiple's logged loss+regularization decomposition must use the
+    SWEPT configuration's lambda, not the estimator's base config."""
+    import logging
+    import re
+
+    data, _ = _glmix_problem(rng, n_users=6, rows_per_user=25)
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinates={
+            "fixed": FixedEffectCoordinateConfiguration("global", L2(0.5)),
+        },
+    )
+    with caplog.at_level(logging.INFO, logger="photon_ml_tpu"):
+        fits = est.fit_multiple(data, configs=[{"fixed": L2(0.0)}])
+    assert len(fits) == 1
+    decomp = re.findall(
+        r"loss [\d.eE+-]+ \+ regularization ([\d.eE+-]+) = objective",
+        caplog.text,
+    )
+    assert decomp, caplog.text
+    # lambda=0 trained this fit: the logged regularization term must be 0
+    # (the base config's 0.5 would give a clearly positive term)
+    assert float(decomp[-1]) == 0.0
