@@ -154,10 +154,17 @@ class GameData:
         if engine == "auto":
             import jax
 
-            on_tpu = jax.default_backend() == "tpu"
-            engine = (
-                "benes" if on_tpu and shard.rows.size >= (1 << 20) else "ell"
-            )
+            on_accel = jax.default_backend() != "cpu"
+            if on_accel and shard.rows.size >= (1 << 20):
+                # the measured on-hardware winner (TPU_MEASUREMENTS.json /
+                # dev-scripts/tpu_validate_fused.py: fused ~2x benes at the
+                # headline workload); the probe degrades to stage-by-stage
+                # if the fused kernels fail to lower on this backend
+                from photon_ml_tpu.ops.fused_perm import fused_engine_works
+
+                engine = "fused" if fused_engine_works() else "benes"
+            else:
+                engine = "ell"
         key = (shard_name, engine)
         if key not in cache:
             if engine in ("benes", "fused"):

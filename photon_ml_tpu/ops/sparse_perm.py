@@ -197,23 +197,37 @@ class ColumnSplitFeatures:
     def dim(self) -> int:
         return self.num_cols_
 
+    def _block_w(self, w: jax.Array, b: int) -> jax.Array:
+        """w slice for block b, zero-padded to the block's width (pinned
+        grid layouts give every block a uniform width that may overhang
+        the true column count at the end)."""
+        wb = w[self.col_bounds[b]: self.col_bounds[b + 1]]
+        width = self.blocks[b].dim
+        if wb.shape[0] < width:
+            wb = jnp.pad(wb, (0, width - wb.shape[0]))
+        return wb
+
     def matvec(self, w: jax.Array) -> jax.Array:
         z = None
         for b, blk in enumerate(self.blocks):
-            zb = blk.matvec(w[self.col_bounds[b]: self.col_bounds[b + 1]])
+            zb = blk.matvec(self._block_w(w, b))
             z = zb if z is None else z + zb
         if self.hot_matrix is not None:
             z = z + self.hot_matrix @ w[self.hot_cols]
         return z
 
     def rmatvec(self, c: jax.Array) -> jax.Array:
-        g = jnp.concatenate([blk.rmatvec(c) for blk in self.blocks])
+        g = jnp.concatenate(
+            [blk.rmatvec(c) for blk in self.blocks]
+        )[: self.num_cols_]
         if self.hot_matrix is not None:
             g = g.at[self.hot_cols].add(self.hot_matrix.T @ c)
         return g
 
     def rmatvec_sq(self, c: jax.Array) -> jax.Array:
-        g = jnp.concatenate([blk.rmatvec_sq(c) for blk in self.blocks])
+        g = jnp.concatenate(
+            [blk.rmatvec_sq(c) for blk in self.blocks]
+        )[: self.num_cols_]
         if self.hot_matrix is not None:
             hm2 = self.hot_matrix * self.hot_matrix
             g = g.at[self.hot_cols].add(hm2.T @ c)
@@ -232,7 +246,9 @@ class ColumnSplitFeatures:
         from photon_ml_tpu.ops.features import DenseFeatures
 
         mats = [np.asarray(blk.to_dense().matrix) for blk in self.blocks]
-        dense = np.concatenate(mats, axis=1)
+        # pinned grid layouts give uniform block widths that may overhang
+        # the true column count; trim like rmatvec does
+        dense = np.concatenate(mats, axis=1)[:, : self.num_cols_]
         if self.hot_matrix is not None:
             dense[:, np.asarray(self.hot_cols)] += np.asarray(self.hot_matrix)
         return DenseFeatures(matrix=jnp.asarray(dense))
@@ -244,6 +260,14 @@ class _ZeroColumnsBlock:
 
     num_rows_: int = struct.field(pytree_node=False)
     num_cols_: int = struct.field(pytree_node=False)
+
+    @property
+    def num_rows(self) -> int:
+        return self.num_rows_
+
+    @property
+    def dim(self) -> int:
+        return self.num_cols_
 
     def matvec(self, w: jax.Array) -> jax.Array:
         return jnp.zeros((self.num_rows_,), dtype=w.dtype)

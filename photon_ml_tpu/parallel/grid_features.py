@@ -164,6 +164,7 @@ def grid_from_coo(
     hot_col_threshold: Optional[int] = None,
     max_hot_cols: int = 128,
     kp_cap="auto",
+    col_split="auto",
 ) -> GridShardedFeatures:
     """Tile COO entries over the (data, feat) mesh and route each tile
     identically.
@@ -193,7 +194,7 @@ def grid_from_coo(
         tile = _single(
             rows, cols, vals, (n, d), plan_cache=plan_cache,
             hot_col_threshold=hot_col_threshold, max_hot_cols=max_hot_cols,
-            kp_cap=kp_cap,
+            kp_cap=kp_cap, col_split=col_split,
         )
         stacked = jax.tree.map(
             lambda a: place_global(
@@ -278,22 +279,68 @@ def grid_from_coo(
         K = _next_pow2(K)
         KP = _next_pow2(KP)
 
-    # KP cap + spill (sparse_perm.auto_kp_cap, evaluated over the WHOLE
-    # grid's degree distribution so every tile keeps the pinned KP): thin
-    # column-degree tails — the 1B-coef layout's ~1 nnz/col shards — would
-    # otherwise pad every tile's network by max/mean degree.
+    # Layout planning (sparse_perm.plan_column_layout) evaluated over the
+    # WHOLE grid's degree distribution so every tile keeps pinned shapes:
+    # thin column-degree tails — the 1B-coef layout's ~1 nnz/col shards —
+    # would otherwise pad every tile's network by max/mean degree AND the
+    # valid-size ladder. A KP cap spills per-tile over-cap entries; a
+    # column split turns each tile into a ColumnSplitFeatures of
+    # identically-shaped sub-blocks.
     tile_spill = {key: (None, None, None) for key in tiles_cold}
-    if engine in ("benes", "fused") and kp_cap and KP > 1:
+    col_blocks = 1
+    block_spill: dict = {}
+    if engine in ("benes", "fused") and (kp_cap or col_split != 1):
         from photon_ml_tpu.ops.sparse_perm import (
-            resolve_kp_cap,
+            resolve_layout,
             split_spill_entries,
         )
 
         all_counts = np.concatenate(
             [tile_col_counts[key] for key in sorted(tile_col_counts)]
         )
-        cap = resolve_kp_cap(kp_cap, all_counts, n_loc, d_loc, K, KP)
-        if cap is not None:
+        cap, col_blocks = resolve_layout(
+            kp_cap, col_split, all_counts, n_loc, d_loc, K, KP
+        )
+        if col_blocks > 1:
+            # partition each tile's cold entries into column blocks; apply
+            # the cap per (tile, block); pad spills to ONE stackable length
+            d_bb = -(-d_loc // col_blocks)
+            m_max = 0
+            tile_blocks = {}
+            for key, (tr, tc, tv, hm) in tiles_cold.items():
+                blocks = []
+                blk_of = tc // d_bb
+                for b in range(col_blocks):
+                    m = blk_of == b
+                    btr, btc, btv = tr[m], tc[m] - b * d_bb, tv[m]
+                    counts_b = (
+                        np.bincount(btc, minlength=d_bb) if btr.size
+                        else np.zeros(d_bb, np.int64)
+                    )
+                    if cap is not None and btr.size and counts_b.max() > cap:
+                        btr, btc, btv, sr, sc, sv = split_spill_entries(
+                            btr, btc, btv, counts_b, cap
+                        )
+                    else:
+                        sr = np.zeros(0, np.int64)
+                        sc = np.zeros(0, np.int64)
+                        sv = np.zeros(0, np.float32)
+                    blocks.append((btr, btc, btv, sr, sc, sv))
+                    m_max = max(m_max, sr.size)
+                tile_blocks[key] = blocks
+            for key, blocks in tile_blocks.items():
+                block_spill[key] = []
+                for b, (btr, btc, btv, sr, sc, sv) in enumerate(blocks):
+                    pad = m_max - sr.size
+                    spill = (
+                        (np.pad(sr, (0, pad)), np.pad(sc, (0, pad)),
+                         np.pad(sv, (0, pad)))
+                        if m_max else (None, None, None)
+                    )
+                    block_spill[key].append((btr, btc, btv, spill))
+            if cap is not None:
+                KP = cap
+        elif cap is not None:
             m_max = 0
             for key, (tr, tc, tv, hm) in tiles_cold.items():
                 counts = tile_col_counts[key]
@@ -346,12 +393,40 @@ def grid_from_coo(
         tr, tc, tv, hm = tiles_cold[dd, df]
         hot_ids = tile_hot[dd, df] if h_common else None
         if engine in ("benes", "fused"):
-            S = routing.valid_size(max(n_loc * K, d_loc * KP, 1))
             assembler = _assemble
             if engine == "fused":
                 from photon_ml_tpu.ops import fused_perm
 
                 assembler = fused_perm.assemble
+            if col_blocks > 1:
+                # pinned per-block layout: every (tile, block) shares
+                # (K, KP, S_b, spill length), so tiles stack leaf-by-leaf
+                from photon_ml_tpu.ops.sparse_perm import ColumnSplitFeatures
+
+                d_bb = -(-d_loc // col_blocks)
+                S_b = routing.valid_size(max(n_loc * K, d_bb * KP, 1))
+                blocks = []
+                for b, (btr, btc, btv, spill) in enumerate(
+                    block_spill[dd, df]
+                ):
+                    blocks.append(assembler(
+                        btr, btc, btv, n_loc, d_bb, K, KP, None, None,
+                        plan_cache, size_floor=S_b, spill=spill,
+                    ))
+                return ColumnSplitFeatures(
+                    blocks=tuple(blocks),
+                    hot_matrix=None if hm is None else jnp.asarray(hm),
+                    hot_cols=(
+                        None if hot_ids is None
+                        else jnp.asarray(hot_ids, dtype=jnp.int32)
+                    ),
+                    col_bounds=tuple(
+                        min(b * d_bb, d_loc) for b in range(col_blocks + 1)
+                    ),
+                    num_rows_=int(n_loc),
+                    num_cols_=int(d_loc),
+                )
+            S = routing.valid_size(max(n_loc * K, d_loc * KP, 1))
             return assembler(
                 tr, tc, tv, n_loc, d_loc, K, KP, hm, hot_ids,
                 plan_cache, size_floor=S, spill=tile_spill[dd, df],

@@ -157,8 +157,11 @@ def _split_stats(feats, weights):
             parts.append(_fused_stats(blk, weights))
         else:
             raise TypeError(f"unknown column block type {type(blk)!r}")
+    d = feats.num_cols_
+    # pinned grid layouts give uniform block widths that may overhang the
+    # true column count; trim like ColumnSplitFeatures.rmatvec does
     s1, s2, sabs, nnz, mn, mx = (
-        jnp.concatenate([p[i] for p in parts]) for i in range(6)
+        jnp.concatenate([p[i] for p in parts])[:d] for i in range(6)
     )
     hot = feats.hot_matrix
     if hot is not None:
