@@ -202,6 +202,93 @@ def load_game_config(path: str) -> Tuple[
     return shards, coordinates, update_order, raw
 
 
+def parse_box_constraints(
+    spec: Optional[str], index_map, dim: int,
+    intercept_index: Optional[int] = None,
+):
+    """``--coefficient-box-constraints`` → (scalar_lower, scalar_upper,
+    per_feature_box_or_None).
+
+    Two accepted payloads:
+    - ``{"lower": s, "upper": s}`` — global scalar bounds (shorthand).
+    - the reference's JSON array of ``{"name", "term", "lowerBound",
+      "upperBound"}`` maps (GLMSuite.createConstraintFeatureMap): every map
+      names both name and term; '*' wildcards in term (or name+term) apply
+      a bound to all features; a wildcard name requires a wildcard term;
+      lower <= upper; overlapping constraints are rejected.
+    """
+    if not spec:
+        return None, None, None
+    import numpy as np
+
+    payload = json.loads(spec)
+    if isinstance(payload, dict):
+        return payload.get("lower"), payload.get("upper"), None
+    if not isinstance(payload, list):
+        raise ValueError(
+            "--coefficient-box-constraints expects a JSON object with "
+            "lower/upper or the reference's JSON array of per-feature maps"
+        )
+    from photon_ml_tpu.indexmap import feature_key
+
+    WILD = "*"
+    lower = np.full(dim, -np.inf, dtype=np.float32)
+    upper = np.full(dim, np.inf, dtype=np.float32)
+    assigned = np.zeros(dim, dtype=bool)
+    wildcard_all = False
+    for entry in payload:
+        if "name" not in entry or "term" not in entry:
+            raise ValueError(
+                f"constraint map {entry!r} must name both 'name' and 'term'"
+            )
+        # JSON null == missing: unbounded on that side
+        lo_raw = entry.get("lowerBound")
+        hi_raw = entry.get("upperBound")
+        lo = float(lo_raw) if lo_raw is not None else -np.inf
+        hi = float(hi_raw) if hi_raw is not None else np.inf
+        if lo > hi:
+            raise ValueError(
+                f"constraint lower bound {lo} exceeds upper bound {hi} "
+                f"for {entry['name']!r}/{entry['term']!r}"
+            )
+        name, term = str(entry["name"]), str(entry["term"])
+        if name == WILD and term != WILD:
+            raise ValueError(
+                "a wildcard name requires a wildcard term (reference "
+                "GLMSuite constraint rule 3)"
+            )
+        if term == WILD:
+            if wildcard_all or assigned.any():
+                raise ValueError(
+                    "overlapping constraints (reference GLMSuite constraint "
+                    "rule 4): a wildcard constraint cannot combine with "
+                    "other constraints"
+                )
+            lower[:] = lo
+            upper[:] = hi
+            if intercept_index is not None:
+                # the reference's wildcard bounds never pin the intercept
+                # (it must stay free to absorb the base rate)
+                lower[intercept_index] = -np.inf
+                upper[intercept_index] = np.inf
+            wildcard_all = True
+            continue
+        idx = index_map.get_index(feature_key(name, term))
+        if idx < 0:
+            continue  # feature absent from the training index
+        if wildcard_all or assigned[idx]:
+            raise ValueError(
+                f"overlapping constraints for feature {name!r}/{term!r} "
+                "(reference GLMSuite constraint rule 4)"
+            )
+        lower[idx] = lo
+        upper[idx] = hi
+        assigned[idx] = True
+    if not wildcard_all and not assigned.any():
+        return None, None, None
+    return None, None, (lower, upper)
+
+
 def delete_dirs_if_exist(*dirs: Optional[str]) -> None:
     """Single-writer removal of stale output dirs (reference
     DELETE_OUTPUT_DIR_IF_EXISTS). Process 0 only; None entries skipped."""

@@ -81,7 +81,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--normalization-type", default="NONE",
                    choices=[n.name for n in NormalizationType])
     p.add_argument("--coefficient-box-constraints", default=None,
-                   help='JSON: {"lower": -1.0, "upper": 1.0}')
+                   help='JSON: global {"lower": -1.0, "upper": 1.0}, or the '
+                        "reference's per-feature array "
+                        '[{"name": "age", "term": "", "lowerBound": 0.0, '
+                        '"upperBound": 1.0}, ...] with "*" wildcards '
+                        "(GLMSuite constraint-map rules)")
     p.add_argument("--offheap-indexmap-dir", default=None,
                    help="read features through prebuilt off-heap index "
                         "stores (reference --offheap-indexmap-dir; AVRO "
@@ -301,10 +305,16 @@ def run(args: argparse.Namespace) -> dict:
             opt_cfg["max_iterations"] = args.max_iterations
         if args.tolerance is not None:
             opt_cfg["tolerance"] = args.tolerance
-        if args.coefficient_box_constraints:
-            box = json.loads(args.coefficient_box_constraints)
-            opt_cfg["constraint_lower"] = box.get("lower")
-            opt_cfg["constraint_upper"] = box.get("upper")
+        from photon_ml_tpu.cli.common import parse_box_constraints
+
+        scalar_lo, scalar_hi, box_constraints = parse_box_constraints(
+            args.coefficient_box_constraints, imap, len(imap),
+            intercept_index=intercept_index,
+        )
+        if scalar_lo is not None:
+            opt_cfg["constraint_lower"] = scalar_lo
+        if scalar_hi is not None:
+            opt_cfg["constraint_upper"] = scalar_hi
         configuration = parse_optimizer_config(opt_cfg)
 
         emitter.send_event(TrainingStartEvent(task=task.name))
@@ -318,6 +328,7 @@ def run(args: argparse.Namespace) -> dict:
                 compute_variances=args.compute_variances,
                 track_models=args.validate_per_iteration,
                 intercept_index=intercept_index,
+                box_constraints=box_constraints,
             )
         for fit in fits:
             emitter.send_event(PhotonOptimizationLogEvent(
@@ -441,6 +452,7 @@ def run(args: argparse.Namespace) -> dict:
                     val_data=vdata if args.validation_data_dirs else None,
                     metric_vs_iteration=per_iter_metrics or None,
                     metric_name=evaluator.name,
+                    box_constraints=box_constraints,
                 )
 
         emitter.send_event(TrainingFinishEvent(
@@ -457,7 +469,7 @@ def run(args: argparse.Namespace) -> dict:
 def _diagnose(
     args, task, data, labeled, fits, best_lambda, imap, intercept_index,
     configuration, logger, val_data=None, metric_vs_iteration=None,
-    metric_name="metric",
+    metric_name="metric", box_constraints=None,
 ) -> None:
     """Reference Driver diagnose() stage (Driver.scala:612-638): the mode
     splits the report — TRAIN|ALL runs the training-data diagnostics
@@ -500,6 +512,7 @@ def _diagnose(
             sub_labeled, task, configuration,
             regularization_weights=weights,
             intercept_index=intercept_index,
+            box_constraints=box_constraints,
         )
 
     fitting = None

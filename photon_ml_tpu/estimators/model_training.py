@@ -53,6 +53,7 @@ def train_glm(
     compute_variances: bool = False,
     track_models: bool = False,
     intercept_index: Optional[int] = None,
+    box_constraints=None,
 ) -> List[GlmFit]:
     """Train one GLM per regularization weight, warm-starting down the sorted
     sweep. Returns fits in the caller's requested order.
@@ -88,6 +89,30 @@ def train_glm(
     # An explicit 0.0 l1_weight pins the solver to LBFGS/TRON even when the
     # configuration's own regularization_weight would imply L1 (the sweep
     # weights are authoritative).
+    # box_constraints arrive in the ORIGINAL feature space (the reference's
+    # per-feature constraint map, GLMSuite); training may run in normalized
+    # space, where w_orig = factor .* w_norm (componentwise, factor > 0), so
+    # the bounds map by the same positive diagonal. Shift normalization
+    # mixes the intercept non-componentwise — an explicitly-bounded
+    # intercept cannot be honored there and is rejected.
+    if box_constraints is not None and data.norm is not None:
+        lo, hi = box_constraints
+        if data.norm.shift is not None and intercept_index is not None:
+            import numpy as np
+
+            if (np.isfinite(np.asarray(lo)[intercept_index])
+                    or np.isfinite(np.asarray(hi)[intercept_index])):
+                raise ValueError(
+                    "an intercept box constraint cannot be combined with "
+                    "shift normalization (the intercept mixes all "
+                    "coefficients there); constrain only non-intercept "
+                    "features or use a factor-only normalization"
+                )
+        factor = data.norm.factor
+        if factor is not None:
+            lo = jnp.asarray(lo) / factor
+            hi = jnp.asarray(hi) / factor
+        box_constraints = (lo, hi)
     solver = jax.jit(
         lambda w0, dd, l2, l1: solve(
             objective,
@@ -96,6 +121,7 @@ def train_glm(
             configuration,
             l2_weight=l2,
             l1_weight=l1 if use_l1 else 0.0,
+            box=box_constraints,
         )
     )
     hess_diag = jax.jit(objective.hessian_diag) if compute_variances else None

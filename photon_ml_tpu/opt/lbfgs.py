@@ -131,19 +131,35 @@ def _project_box(w: jax.Array, lower, upper) -> jax.Array:
     return w
 
 
+def resolve_box(box, config: OptimizerConfig):
+    """(lower, upper, has_box) from a per-coefficient ``box`` override or
+    the config's scalar bounds — shared by all three solvers."""
+    lo, hi = box if box is not None else (
+        config.constraint_lower, config.constraint_upper
+    )
+    return lo, hi, lo is not None or hi is not None
+
+
 def lbfgs_solve(
     objective: GlmObjective,
     w0: jax.Array,
     data,
     l2_weight: jax.Array,
     config: OptimizerConfig = OptimizerConfig(),
+    box: Optional[Tuple] = None,
 ) -> SolveResult:
     """Minimize objective over w starting from w0. Pure function of its
-    inputs; jit/vmap/shard_map-safe."""
+    inputs; jit/vmap/shard_map-safe.
+
+    ``box`` = (lower, upper) per-coefficient arrays (either side may be
+    None) — the reference's per-feature constraint map
+    (GLMSuite.createConstraintFeatureMap); scalar bounds come from the
+    config."""
     m = config.history_length
     max_iter = config.max_iterations
     dim = w0.shape[-1]
     dtype = w0.dtype
+    box_lo, box_hi, has_box = resolve_box(box, config)
 
     f0, g0 = objective.value_and_grad(w0, data, l2_weight)
     g0_norm = jnp.linalg.norm(g0)
@@ -197,10 +213,10 @@ def lbfgs_solve(
         )
 
         w_new = s.w + ls.t * d
-        w_new = _project_box(w_new, config.constraint_lower, config.constraint_upper)
+        w_new = _project_box(w_new, box_lo, box_hi)
         # Projection may have changed the point; recompute f/g only if a box
         # is configured (static branch — no cost otherwise).
-        if config.constraint_lower is not None or config.constraint_upper is not None:
+        if has_box:
             f_new, g_new = objective.value_and_grad(w_new, data, l2_weight)
         else:
             f_new, g_new = ls.f, ls.g

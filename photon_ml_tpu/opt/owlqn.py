@@ -30,6 +30,7 @@ from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import OptimizerConfig
 from photon_ml_tpu.opt.lbfgs import (
     _project_box,
+    resolve_box,
     resolve_history_dtype,
     two_loop_direction,
     update_history,
@@ -80,10 +81,9 @@ def owlqn_solve(
     l2_weight: jax.Array,
     l1_weight: jax.Array,
     config: OptimizerConfig = OptimizerConfig(),
+    box=None,
 ) -> SolveResult:
-    has_box = (
-        config.constraint_lower is not None or config.constraint_upper is not None
-    )
+    box_lo, box_hi, has_box = resolve_box(box, config)
     m = config.history_length
     max_iter = config.max_iterations
     dim = w0.shape[-1]
@@ -185,9 +185,7 @@ def owlqn_solve(
             # projection actually clipped something (bounds inactive or a
             # failed line search leave w unchanged, and the line-search
             # f/g are already exact there)
-            w_proj = _project_box(
-                w_new, config.constraint_lower, config.constraint_upper
-            )
+            w_proj = _project_box(w_new, box_lo, box_hi)
             clipped = jnp.any(w_proj != w_new)
 
             def _recompute(_):

@@ -26,6 +26,7 @@ def solve(
     configuration: GlmOptimizationConfiguration,
     l2_weight=None,
     l1_weight=None,
+    box=None,
 ) -> SolveResult:
     """Run the configured solver. The optimizer CHOICE is static (python
     branch, resolved at trace time); the regularization WEIGHTS are traced.
@@ -51,7 +52,7 @@ def solve(
         l1 = jnp.asarray(l1_value, dtype=w0.dtype)
         if cfg.optimizer is OptimizerType.TRON:
             raise ValueError("TRON does not support L1 regularization (use LBFGS/OWL-QN)")
-        return owlqn_solve(objective, w0, data, l2, l1, cfg)
+        return owlqn_solve(objective, w0, data, l2, l1, cfg, box=box)
     if cfg.optimizer is OptimizerType.TRON:
-        return tron_solve(objective, w0, data, l2, cfg)
-    return lbfgs_solve(objective, w0, data, l2, cfg)
+        return tron_solve(objective, w0, data, l2, cfg, box=box)
+    return lbfgs_solve(objective, w0, data, l2, cfg, box=box)

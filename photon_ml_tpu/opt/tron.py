@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import OptimizerConfig
-from photon_ml_tpu.opt.lbfgs import _project_box
+from photon_ml_tpu.opt.lbfgs import _project_box, resolve_box
 from photon_ml_tpu.opt.state import (
     SolveResult,
     absolute_tolerances,
@@ -118,6 +118,7 @@ def tron_solve(
     data,
     l2_weight: jax.Array,
     config: OptimizerConfig = OptimizerConfig.tron(),
+    box=None,
 ) -> SolveResult:
     if not objective.has_hessian:
         raise ValueError(
@@ -126,6 +127,7 @@ def tron_solve(
         )
     max_iter = config.max_iterations
     dtype = w0.dtype
+    box_lo, box_hi, has_box = resolve_box(box, config)
 
     f0, g0 = objective.value_and_grad(w0, data, l2_weight)
     g0_norm = jnp.linalg.norm(g0)
@@ -162,8 +164,8 @@ def tron_solve(
             hv, s.g, s.delta, config.max_cg_iterations, config.cg_tolerance
         )
         w_try = s.w + step
-        if config.constraint_lower is not None or config.constraint_upper is not None:
-            w_try = _project_box(w_try, config.constraint_lower, config.constraint_upper)
+        if has_box:
+            w_try = _project_box(w_try, box_lo, box_hi)
             step = w_try - s.w
         f_try, g_try = objective.value_and_grad(w_try, data, l2_weight)
 

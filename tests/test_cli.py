@@ -760,6 +760,123 @@ class TestLegacyGlmParityFlags:
         assert len(per_iter) >= 4  # several iterations logged per lambda
         assert per_iter[0] == "0"
 
+    def test_per_feature_box_constraints(self, glmix_avro, tmp_path):
+        """The reference's per-feature constraint-map format
+        (GLMSuite.createConstraintFeatureMap): a JSON array of
+        name/term/lowerBound/upperBound maps pins individual coefficients;
+        the trained model must respect exactly those bounds."""
+        import json as _json
+
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        out = tmp_path / "boxed"
+        constraints = _json.dumps([
+            {"name": "g", "term": "0", "lowerBound": -0.01, "upperBound": 0.01},
+            {"name": "g", "term": "1", "lowerBound": 0.0},
+        ])
+        result = run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "0.01",
+            "--coefficient-box-constraints", constraints,
+        ]))
+        assert result["fits"], result
+        # the saved model text carries name/term per coefficient
+        text = (out / "model-lambda-0.01.txt").read_text()
+        coefs = {}
+        for line in text.splitlines():
+            parts = line.split("\t")
+            if len(parts) >= 3:
+                coefs[(parts[0], parts[1])] = float(parts[2])
+        assert -0.01 - 1e-6 <= coefs[("g", "0")] <= 0.01 + 1e-6
+        assert coefs[("g", "1")] >= -1e-6
+        # an unconstrained coefficient escapes those bounds (data has strong
+        # signal), proving the constraint was per-feature, not global
+        others = [v for (nm, t), v in coefs.items()
+                  if nm == "g" and t not in ("0", "1")]
+        assert max(abs(v) for v in others) > 0.011, others
+
+    def test_per_feature_box_with_normalization_original_space(
+        self, glmix_avro, tmp_path
+    ):
+        """Bounds are stated in the ORIGINAL feature space; with
+        normalization on, the solver maps them through the factor so the
+        saved original-space model still honors them. Wildcard bounds must
+        leave the intercept free (reference GLMSuite semantics); null
+        bounds mean unbounded."""
+        import json as _json
+
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        out = tmp_path / "boxed_norm"
+        constraints = _json.dumps([
+            {"name": "*", "term": "*", "lowerBound": -0.05,
+             "upperBound": 0.05},
+        ])
+        run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "0.01",
+            "--normalization-type", "SCALE_WITH_STANDARD_DEVIATION",
+            "--coefficient-box-constraints", constraints,
+        ]))
+        text = (out / "model-lambda-0.01.txt").read_text()
+        coefs = {}
+        for line in text.splitlines():
+            parts = line.split("\t")
+            if len(parts) >= 3:
+                coefs[(parts[0], parts[1])] = float(parts[2])
+        g_vals = [v for (nm, _t), v in coefs.items() if nm == "g"]
+        assert g_vals and all(-0.0501 <= v <= 0.0501 for v in g_vals), coefs
+        # the intercept stays free of the wildcard bound
+        icpt = [v for (nm, _t), v in coefs.items() if nm != "g"]
+        assert icpt  # present (may or may not exceed the bound)
+
+        # null bound == unbounded on that side
+        out2 = tmp_path / "boxed_null"
+        run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out2),
+            "--regularization-weights", "0.01",
+            "--coefficient-box-constraints", _json.dumps([
+                {"name": "g", "term": "0", "lowerBound": None,
+                 "upperBound": 0.01},
+            ]),
+        ]))
+        text2 = (out2 / "model-lambda-0.01.txt").read_text()
+        for line in text2.splitlines():
+            parts = line.split("\t")
+            if parts[0] == "g" and parts[1] == "0":
+                assert float(parts[2]) <= 0.0101
+
+    def test_box_constraint_map_validation_errors(self, glmix_avro, tmp_path):
+        import json as _json
+
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        def _run(payload):
+            return run(parse_args([
+                "--training-data-dirs", str(glmix_avro["train"]),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(tmp_path / "o"),
+                "--coefficient-box-constraints", _json.dumps(payload),
+            ]))
+
+        with pytest.raises(ValueError, match="name.*term|must name"):
+            _run([{"name": "g", "lowerBound": 0}])
+        with pytest.raises(ValueError, match="exceeds upper"):
+            _run([{"name": "g", "term": "0", "lowerBound": 2, "upperBound": 1}])
+        with pytest.raises(ValueError, match="wildcard term"):
+            _run([{"name": "*", "term": "0", "lowerBound": 0}])
+        with pytest.raises(ValueError, match="[Oo]verlap"):
+            _run([
+                {"name": "*", "term": "*", "lowerBound": -1, "upperBound": 1},
+                {"name": "g", "term": "0", "lowerBound": 0, "upperBound": 1},
+            ])
+
     def test_validate_per_iteration_plot_in_report(self, glmix_avro, tmp_path):
         """--validate-per-iteration + diagnostics: the HTML report carries
         the metric-vs-iteration chapter (reference validatePerIteration
