@@ -42,6 +42,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument("--data-dirs", nargs="+", required=True)
+    p.add_argument("--date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd; expands each data dir to its "
+                        "daily yyyy/MM/dd subdirs (reference --date-range)")
+    p.add_argument("--date-days-ago", default=None,
+                   help="start-end days ago, e.g. 90-1 (reference "
+                        "--date-range-days-ago)")
     p.add_argument("--output-dir", required=True)
     p.add_argument("--feature-shard", action="append", required=True,
                    dest="feature_shards", metavar="SHARD=BAG[,BAG...]")
@@ -57,9 +63,14 @@ def run(args: argparse.Namespace) -> Dict[str, int]:
     logger = setup_logger(args.log_file)
     timer = Timer()
     shards = parse_shard_spec(args.feature_shards)
+    from photon_ml_tpu.cli.common import expand_data_dirs
+
+    data_dirs = expand_data_dirs(
+        args.data_dirs, args.date_range, args.date_days_ago
+    )
     names: Dict[str, set] = {sid: set() for sid in shards}
     with timer.time("scan"):
-        for path in args.data_dirs:
+        for path in data_dirs:
             for record in read_avro_dir(path):
                 for sid, bags in shards.items():
                     bucket = names[sid]

@@ -912,6 +912,28 @@ class TestLegacyGlmParityFlags:
 
 
 class TestBuildIndexDriver:
+    def test_date_range_expansion(self, glmix_avro, tmp_path):
+        """--date-range expands each data dir to daily yyyy/MM/dd subdirs
+        (reference FeatureIndexingJob --date-range)."""
+        import shutil
+
+        from photon_ml_tpu.cli.build_index import parse_args, run
+
+        dated = tmp_path / "dated"
+        day = dated / "2024" / "03" / "05"
+        day.mkdir(parents=True)
+        shutil.copy(
+            str(glmix_avro["train"] / "part-00000.avro"),
+            str(day / "part-00000.avro"),
+        )
+        sizes = run(parse_args([
+            "--data-dirs", str(dated),
+            "--date-range", "20240304-20240306",
+            "--output-dir", str(tmp_path / "idx"),
+            "--feature-shard", "global=features",
+        ]))
+        assert sizes["global"] > 1  # features + intercept found via the range
+
     def test_build_and_use_offheap_index(self, glmix_avro, tmp_path):
         from photon_ml_tpu.cli.build_index import parse_args, run
 
