@@ -265,9 +265,13 @@ def _plan_cache_dir():
 def _routed_fe_data(fe_np, engine: str):
     """The same fixed-effect problem through a permutation-routed sparse
     engine: ``"benes"`` = stage-by-stage (ops/sparse_perm.py), ``"fused"`` =
-    2m+1 fused kernels per linear map (ops/fused_perm.py). The one-time host
-    routing prep is excluded from the timed region, like the reference's RDD
-    dataset build; plans are pattern-keyed and cached across runs."""
+    2m+1 fused kernels per linear map (ops/fused_perm.py), ``"fused_bf16"``
+    = fused with bfloat16 network payload (half the stage traffic; entry
+    rounding only). The one-time host routing prep is excluded from the
+    timed region, like the reference's RDD dataset build; plans are
+    pattern-keyed and cached across runs."""
+    import functools
+
     import jax.numpy as jnp
 
     from photon_ml_tpu.ops.data import LabeledData
@@ -275,7 +279,13 @@ def _routed_fe_data(fe_np, engine: str):
 
     ell_vals, ell_idx, y = fe_np
     rows = np.repeat(np.arange(N_FE, dtype=np.int64), K_NNZ)
-    builder = {"benes": sparse_perm.from_coo, "fused": fused_perm.from_coo}[engine]
+    builder = {
+        "benes": sparse_perm.from_coo,
+        "fused": fused_perm.from_coo,
+        "fused_bf16": functools.partial(
+            fused_perm.from_coo, payload_dtype="bfloat16"
+        ),
+    }[engine]
     feats = builder(rows, ell_idx.ravel().astype(np.int64), ell_vals.ravel(),
                     (N_FE, D_FE), plan_cache=_plan_cache_dir())
     return LabeledData.create(feats, jnp.asarray(y))
@@ -322,7 +332,7 @@ def _tpu_run(fe_data, re_data, use_pallas: bool = False):
     # rows touched per objective evaluation x evaluations (1 eval/iter is a
     # lower bound; line-search extras are free upside not counted)
     passes = N_FE * fe_iters + N_ENT * S_ENT * re_iters
-    return passes, best, fe_iters, re_iters
+    return passes, best, fe_iters, re_iters, float(fe_res.value)
 
 
 def _cpu_baseline(fe_np, re_np, fe_iters, re_iters):
@@ -493,7 +503,7 @@ def main():
     fe_np, fe_data, re_np, re_data, fe_val, re_val = _build()
     engine_results = {}
     if args.engine in ("all", "ell"):
-        passes, tpu_time, fe_iters, re_iters = _tpu_run(fe_data, re_data)
+        passes, tpu_time, fe_iters, re_iters, _ = _tpu_run(fe_data, re_data)
         engine_results["ell"] = round(passes / tpu_time, 1)
         best_fe_data = fe_data
         _PARTIAL.update(
@@ -507,11 +517,14 @@ def main():
     # XLA gather/scatter; keep the fastest. Prep (host routing) is one-time
     # and untimed; failures fall back silently to the best path so far.
     routed = [e for e in ("benes", "fused") if args.engine in ("all", e)]
+    fused_final = None  # f32 fused final objective: the bf16 quality anchor
     for engine in routed:
         try:
             e_data = _routed_fe_data(fe_np, engine)
-            e_passes, e_time, e_fe, e_re = _tpu_run(e_data, re_data)
+            e_passes, e_time, e_fe, e_re, e_val = _tpu_run(e_data, re_data)
             engine_results[engine] = round(e_passes / e_time, 1)
+            if engine == "fused":
+                fused_final = e_val
             print(
                 f"{engine} A/B: {e_passes / e_time:.0f} passes/s",
                 file=sys.stderr,
@@ -527,13 +540,42 @@ def main():
     if tpu_time is None:
         _emit_failure(f"engine {args.engine} produced no measurement")
 
+    # bfloat16 network payload: half the routed stage traffic at one entry
+    # rounding. Eligible for the headline ONLY when it converges to the
+    # same optimum as the exact fused engine (relative final-objective
+    # tolerance 1e-4 — measured agreement is ~1e-5); always recorded.
+    if fused_final is not None and args.engine in ("all", "fused"):
+        try:
+            b_data = _routed_fe_data(fe_np, "fused_bf16")
+            b_passes, b_time, b_fe, b_re, b_val = _tpu_run(b_data, re_data)
+            engine_results["fused_bf16"] = round(b_passes / b_time, 1)
+            quality_ok = (
+                abs(b_val - fused_final) <= 1e-4 * abs(fused_final)
+            )
+            print(
+                f"fused_bf16 A/B: {b_passes / b_time:.0f} passes/s "
+                f"(final {b_val:.6g} vs f32 {fused_final:.6g}, "
+                f"quality_ok={quality_ok})",
+                file=sys.stderr,
+            )
+            if quality_ok and b_passes / b_time > passes / tpu_time:
+                passes, tpu_time, fe_iters, re_iters = (
+                    b_passes, b_time, b_fe, b_re
+                )
+                best_fe_data = b_data
+            _PARTIAL.update(
+                value=round(passes / tpu_time, 1), engines=dict(engine_results)
+            )
+        except Exception as e:  # pragma: no cover
+            print(f"fused_bf16 path failed: {e}", file=sys.stderr)
+
     # A/B the fused pallas kernels (dense RE inner loop) on real TPU over the
     # best FE engine; keep whichever is faster. Pallas failures fall back.
     from photon_ml_tpu.ops.pallas_kernels import pallas_available
 
     if pallas_available() and args.engine == "all":
         try:
-            p_passes, p_time, p_fe, p_re = _tpu_run(
+            p_passes, p_time, p_fe, p_re, _ = _tpu_run(
                 best_fe_data, re_data, use_pallas=True
             )
             engine_results["pallas_re"] = round(p_passes / p_time, 1)

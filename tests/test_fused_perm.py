@@ -584,3 +584,66 @@ class TestInSolver:
         if l1:
             # OWL-QN must produce an actually-sparse solution on both engines
             assert (np.abs(np.asarray(res_fused.w)) < 1e-8).any()
+
+
+class TestBf16Payload:
+    def test_bf16_kernels_interpret(self, rng, interpret_kernels):
+        """The fused kernels' bf16 load/store + f32 in-VMEM shuffle paths,
+        via the Pallas interpreter."""
+        n, d = 1024, 600
+        rows, cols, vals, dense = _random_coo(rng, n, d, 6000)
+        feats = from_coo(
+            rows, cols, vals, (n, d), max_hot_cols=0, size_floor=128 * 128,
+            kp_cap=None, col_split=1, payload_dtype="bfloat16",
+        )
+        assert feats._fused_ok()
+        w = rng.standard_normal(d).astype(np.float32)
+        c = rng.standard_normal(n).astype(np.float32)
+        z_ref, g_ref = dense @ w, dense.T @ c
+        z = np.asarray(feats.matvec(jnp.asarray(w)))
+        g = np.asarray(feats.rmatvec(jnp.asarray(c)))
+        assert np.abs(z - z_ref).max() / (np.abs(z_ref).max() + 1e-6) < 2e-2
+        assert np.abs(g - g_ref).max() / (np.abs(g_ref).max() + 1e-6) < 2e-2
+
+    def test_bf16_payload_close_and_f32_exact(self, rng):
+        """payload_dtype='bfloat16' halves the permuted intermediates: the
+        maps stay within bf16 entry-rounding error (~2^-8 relative) while
+        the default f32 path is untouched."""
+        rows, cols, vals, dense = _random_coo(rng, n=256, d=512, nnz=4096)
+        w = rng.standard_normal(512).astype(np.float32)
+        c = rng.standard_normal(256).astype(np.float32)
+        fb = from_coo(rows, cols, vals, (256, 512), max_hot_cols=0,
+                      kp_cap=None, col_split=1, payload_dtype="bfloat16")
+        z = np.asarray(fb.matvec(jnp.asarray(w)))
+        g = np.asarray(fb.rmatvec(jnp.asarray(c)))
+        z_ref, g_ref = dense @ w, dense.T @ c
+        scale_z = np.abs(z_ref).max() + 1e-6
+        scale_g = np.abs(g_ref).max() + 1e-6
+        assert np.abs(z - z_ref).max() / scale_z < 2e-2
+        assert np.abs(g - g_ref).max() / scale_g < 2e-2
+        # f32 default still exact
+        f32 = from_coo(rows, cols, vals, (256, 512), max_hot_cols=0,
+                       kp_cap=None, col_split=1)
+        np.testing.assert_allclose(
+            np.asarray(f32.matvec(jnp.asarray(w))), z_ref, atol=2e-4
+        )
+
+    def test_bf16_payload_through_auto_layout(self, rng):
+        """bf16 payload composes with the KP-cap/column-split planner."""
+        from photon_ml_tpu.ops.sparse_perm import ColumnSplitFeatures
+
+        n, d, k = 512, 8192, 8
+        rows = np.repeat(np.arange(n, dtype=np.int64), k)
+        cols = rng.integers(0, d, n * k).astype(np.int64)
+        vals = rng.standard_normal(n * k).astype(np.float32)
+        dense = np.zeros((n, d), np.float32)
+        np.add.at(dense, (rows, cols), vals)
+        f = from_coo(rows, cols, vals, (n, d), max_hot_cols=0,
+                     payload_dtype="bfloat16")
+        w = rng.standard_normal(d).astype(np.float32)
+        z = np.asarray(f.matvec(jnp.asarray(w)))
+        z_ref = dense @ w
+        assert np.abs(z - z_ref).max() / (np.abs(z_ref).max() + 1e-6) < 2e-2
+        if isinstance(f, ColumnSplitFeatures):
+            for blk in f.blocks:
+                assert getattr(blk, "payload_dtype", "float32") == "bfloat16"
