@@ -123,6 +123,23 @@ def _auc(scores: np.ndarray, labels: np.ndarray) -> float:
     return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
 
 
+def _settle_dispatch(fn) -> None:
+    """Run ``fn`` once more and host-fetch its result leaves.
+
+    On the remote backend, jax.block_until_ready can return prematurely on
+    the FIRST dispatch after a compile-cache load (measured: 0.2 ms "ready"
+    while the execution takes seconds, completing during a later fetch).
+    Fetching the warm-up result does NOT clear that state — it is the next
+    dispatch whose completion signal is broken — so the barrier must be a
+    fresh dispatch force-fetched to host. Call after the compile warm-up,
+    before trusting any block_until_ready-based timer.
+    """
+    import jax
+
+    for x in jax.tree.leaves(fn()):
+        np.asarray(x)
+
+
 def _wallclock_to_auc(fe_data, re_data, fe_val, re_val):
     """MLPerf-style time-to-accuracy on held-out data: run warm-started CD
     passes, record (elapsed, AUC) after each, and report the first elapsed
@@ -162,6 +179,8 @@ def _wallclock_to_auc(fe_data, re_data, fe_val, re_val):
     w_re = jnp.zeros((N_ENT, D_RE), dtype=jnp.float32)
     jax.block_until_ready(fe_solver(w_fe, fe_data).w)
     jax.block_until_ready(re_solver(w_re, re_data).w)
+    _settle_dispatch(lambda: fe_solver(w_fe, fe_data).w)
+    _settle_dispatch(lambda: re_solver(w_re, re_data).w)
 
     trace = []  # (training elapsed_s, auc) per CD pass
     trained = 0.0  # training-only clock: host-side AUC evaluation excluded
@@ -186,11 +205,11 @@ def _wallclock_to_auc(fe_data, re_data, fe_val, re_val):
     return secs, target, final
 
 
-def _grid_northstar(engine: str = "benes"):
+def _grid_northstar(engine: str = "benes", payload_dtype: str = "float32"):
     """Single-chip shard of the 1B-coef layout: N_GRID rows x D_GRID
     feature-sharded coefficients through parallel/grid_features on a 1x1
     mesh (the per-chip tile of the production data x feat grid). Returns
-    passes/sec over an L-BFGS solve."""
+    (passes/sec, final objective) over an L-BFGS solve."""
     import jax
     import jax.numpy as jnp
 
@@ -224,7 +243,7 @@ def _grid_northstar(engine: str = "benes"):
     mesh = grid_mesh(1, 1)
     gf = grid_from_coo(
         rows, cols, vals, (N_GRID, D_GRID), mesh, engine=engine,
-        plan_cache=_plan_cache_dir(),
+        plan_cache=_plan_cache_dir(), payload_dtype=payload_dtype,
     )
     y_pad = np.zeros(gf.num_rows, np.float32)
     y_pad[:N_GRID] = y
@@ -245,6 +264,7 @@ def _grid_northstar(engine: str = "benes"):
     w0 = shard_vector_feat(jnp.zeros(gf.dim, jnp.float32), mesh)
     res = solver(w0, data)
     jax.block_until_ready(res.w)  # compile warm-up
+    _settle_dispatch(lambda: solver(w0, data).w)
     best = np.inf
     for _ in range(2):
         t0 = time.perf_counter()
@@ -252,7 +272,7 @@ def _grid_northstar(engine: str = "benes"):
         jax.block_until_ready(res.w)
         best = min(best, time.perf_counter() - t0)
     iters = int(res.iterations)
-    return N_GRID * max(iters, 1) / best
+    return N_GRID * max(iters, 1) / best, float(res.value)
 
 
 def _plan_cache_dir():
@@ -321,6 +341,7 @@ def _tpu_run(fe_data, re_data, use_pallas: bool = False):
         return fe_res, re_res
 
     fe_res, re_res = one_pass()  # compile warm-up
+    _settle_dispatch(lambda: [r.w for r in one_pass()])
     best = np.inf
     for _ in range(3):
         t0 = time.perf_counter()
@@ -630,14 +651,36 @@ def main():
             grid_engines = [args.engine]
         for grid_engine in grid_engines:
             try:
-                extras["grid16m_passes_per_s"] = round(
-                    _grid_northstar(grid_engine), 1
-                )
+                g_pps, g_val = _grid_northstar(grid_engine)
+                extras["grid16m_passes_per_s"] = round(g_pps, 1)
                 extras["grid16m_engine"] = grid_engine
                 extras["grid16m_dim"] = D_GRID
                 _PARTIAL.update(
                     {k: dict(v) if isinstance(v, dict) else v for k, v in extras.items()}
                 )
+                if grid_engine == "fused":
+                    # bf16 payload at the grid, same quality gate as the
+                    # headline: adopted only when faster AND converged to
+                    # the same optimum as the exact engine
+                    try:
+                        b_pps, b_val = _grid_northstar(
+                            "fused", payload_dtype="bfloat16"
+                        )
+                        print(
+                            f"grid16m bf16: {b_pps:.0f} vs {g_pps:.0f} "
+                            f"passes/s (final {b_val:.6g} vs {g_val:.6g})",
+                            file=sys.stderr,
+                        )
+                        if (b_pps > g_pps
+                                and abs(b_val - g_val) <= 1e-4 * abs(g_val)):
+                            extras["grid16m_passes_per_s"] = round(b_pps, 1)
+                            extras["grid16m_engine"] = "fused_bf16"
+                            _PARTIAL.update(
+                                {k: dict(v) if isinstance(v, dict) else v
+                                 for k, v in extras.items()}
+                            )
+                    except Exception as e:  # pragma: no cover
+                        print(f"grid bf16 failed: {e}", file=sys.stderr)
                 break
             except Exception as e:  # pragma: no cover
                 print(f"grid north-star ({grid_engine}) failed: {e}", file=sys.stderr)

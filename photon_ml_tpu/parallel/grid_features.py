@@ -165,6 +165,7 @@ def grid_from_coo(
     max_hot_cols: int = 128,
     kp_cap="auto",
     col_split="auto",
+    payload_dtype: str = "float32",
 ) -> GridShardedFeatures:
     """Tile COO entries over the (data, feat) mesh and route each tile
     identically.
@@ -175,6 +176,11 @@ def grid_from_coo(
     """
     if engine not in ("benes", "ell", "fused"):
         raise ValueError(f"unknown engine {engine!r}; expected benes/ell/fused")
+    if payload_dtype != "float32" and engine != "fused":
+        raise ValueError(
+            "payload_dtype applies to the fused engine only (the stage-by-"
+            "stage and ELL engines have no half-width payload path)"
+        )
     n, d = shape
     n_dd = mesh.shape[DATA_AXIS]
     n_df = mesh.shape[FEAT_AXIS]
@@ -191,10 +197,13 @@ def grid_from_coo(
         else:
             from photon_ml_tpu.ops.fused_perm import from_coo as _single
 
+        single_kw = (
+            {"payload_dtype": payload_dtype} if engine == "fused" else {}
+        )
         tile = _single(
             rows, cols, vals, (n, d), plan_cache=plan_cache,
             hot_col_threshold=hot_col_threshold, max_hot_cols=max_hot_cols,
-            kp_cap=kp_cap, col_split=col_split,
+            kp_cap=kp_cap, col_split=col_split, **single_kw,
         )
         stacked = jax.tree.map(
             lambda a: place_global(
@@ -394,10 +403,12 @@ def grid_from_coo(
         hot_ids = tile_hot[dd, df] if h_common else None
         if engine in ("benes", "fused"):
             assembler = _assemble
+            asm_kw = {}
             if engine == "fused":
                 from photon_ml_tpu.ops import fused_perm
 
                 assembler = fused_perm.assemble
+                asm_kw = {"payload_dtype": payload_dtype}
             if col_blocks > 1:
                 # pinned per-block layout: every (tile, block) shares
                 # (K, KP, S_b, spill length), so tiles stack leaf-by-leaf
@@ -411,7 +422,7 @@ def grid_from_coo(
                 ):
                     blocks.append(assembler(
                         btr, btc, btv, n_loc, d_bb, K, KP, None, None,
-                        plan_cache, size_floor=S_b, spill=spill,
+                        plan_cache, size_floor=S_b, spill=spill, **asm_kw,
                     ))
                 return ColumnSplitFeatures(
                     blocks=tuple(blocks),
@@ -429,7 +440,7 @@ def grid_from_coo(
             S = routing.valid_size(max(n_loc * K, d_loc * KP, 1))
             return assembler(
                 tr, tc, tv, n_loc, d_loc, K, KP, hm, hot_ids,
-                plan_cache, size_floor=S, spill=tile_spill[dd, df],
+                plan_cache, size_floor=S, spill=tile_spill[dd, df], **asm_kw,
             )
         ell = _ell_tile(tr, tc, tv, n_loc, d_loc, K)
         if h_common:
