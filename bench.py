@@ -123,6 +123,22 @@ def _auc(scores: np.ndarray, labels: np.ndarray) -> float:
     return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
 
 
+def _f32_objective_value(w, fe_data_f32) -> float:
+    """The exact (f32-engine) FE objective at ``w`` — the quality anchor for
+    reduced-precision engines: their own reported objective rides the same
+    rounded operator, so a systematic payload bias could hide there."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.losses.objective import make_glm_objective
+    from photon_ml_tpu.losses.pointwise import LogisticLoss
+
+    objective = make_glm_objective(LogisticLoss)
+    return float(
+        jax.jit(objective.value)(w, fe_data_f32, jnp.float32(1.0))
+    )
+
+
 def _settle_dispatch(fn) -> None:
     """Run ``fn`` once more and host-fetch its result leaves.
 
@@ -353,7 +369,7 @@ def _tpu_run(fe_data, re_data, use_pallas: bool = False):
     # rows touched per objective evaluation x evaluations (1 eval/iter is a
     # lower bound; line-search extras are free upside not counted)
     passes = N_FE * fe_iters + N_ENT * S_ENT * re_iters
-    return passes, best, fe_iters, re_iters, float(fe_res.value)
+    return passes, best, fe_iters, re_iters, fe_res
 
 
 def _cpu_baseline(fe_np, re_np, fe_iters, re_iters):
@@ -523,6 +539,12 @@ def main():
         )
     fe_np, fe_data, re_np, re_data, fe_val, re_val = _build()
     engine_results = {}
+    def _record_extras(extras_map):
+        _PARTIAL.update(
+            {k: dict(v) if isinstance(v, dict) else v
+             for k, v in extras_map.items()}
+        )
+
     if args.engine in ("all", "ell"):
         passes, tpu_time, fe_iters, re_iters, _ = _tpu_run(fe_data, re_data)
         engine_results["ell"] = round(passes / tpu_time, 1)
@@ -538,14 +560,16 @@ def main():
     # XLA gather/scatter; keep the fastest. Prep (host routing) is one-time
     # and untimed; failures fall back silently to the best path so far.
     routed = [e for e in ("benes", "fused") if args.engine in ("all", e)]
-    fused_final = None  # f32 fused final objective: the bf16 quality anchor
+    fused_final = None   # f32 fused final objective: the bf16 quality anchor
+    fused_f32_data = None
     for engine in routed:
         try:
             e_data = _routed_fe_data(fe_np, engine)
-            e_passes, e_time, e_fe, e_re, e_val = _tpu_run(e_data, re_data)
+            e_passes, e_time, e_fe, e_re, e_res = _tpu_run(e_data, re_data)
             engine_results[engine] = round(e_passes / e_time, 1)
             if engine == "fused":
-                fused_final = e_val
+                fused_final = float(e_res.value)
+                fused_f32_data = e_data
             print(
                 f"{engine} A/B: {e_passes / e_time:.0f} passes/s",
                 file=sys.stderr,
@@ -562,21 +586,23 @@ def main():
         _emit_failure(f"engine {args.engine} produced no measurement")
 
     # bfloat16 network payload: half the routed stage traffic at one entry
-    # rounding. Eligible for the headline ONLY when it converges to the
-    # same optimum as the exact fused engine (relative final-objective
-    # tolerance 1e-4 — measured agreement is ~1e-5); always recorded.
+    # rounding. Eligible for the headline ONLY when its SOLUTION evaluates
+    # to the same optimum under the EXACT f32 objective (its own reported
+    # value rides the rounded operator and could hide a systematic bias);
+    # relative tolerance 1e-4 — measured agreement is ~1e-5. Always recorded.
     if fused_final is not None and args.engine in ("all", "fused"):
         try:
             b_data = _routed_fe_data(fe_np, "fused_bf16")
-            b_passes, b_time, b_fe, b_re, b_val = _tpu_run(b_data, re_data)
+            b_passes, b_time, b_fe, b_re, b_res = _tpu_run(b_data, re_data)
             engine_results["fused_bf16"] = round(b_passes / b_time, 1)
+            b_val = _f32_objective_value(b_res.w, fused_f32_data)
             quality_ok = (
                 abs(b_val - fused_final) <= 1e-4 * abs(fused_final)
             )
             print(
                 f"fused_bf16 A/B: {b_passes / b_time:.0f} passes/s "
-                f"(final {b_val:.6g} vs f32 {fused_final:.6g}, "
-                f"quality_ok={quality_ok})",
+                f"(f32 objective at bf16 solution {b_val:.6g} vs "
+                f"{fused_final:.6g}, quality_ok={quality_ok})",
                 file=sys.stderr,
             )
             if quality_ok and b_passes / b_time > passes / tpu_time:
@@ -627,9 +653,7 @@ def main():
             extras["wallclock_to_auc_s"] = round(secs, 3)
             extras["auc_target"] = round(target, 4)
             extras["auc_final"] = round(achieved, 4)
-            _PARTIAL.update(
-                {k: dict(v) if isinstance(v, dict) else v for k, v in extras.items()}
-            )
+            _record_extras(extras)
         except Exception as e:  # pragma: no cover
             print(f"auc clock failed: {e}", file=sys.stderr)
     if not args.skip_grid:
@@ -649,36 +673,40 @@ def main():
                 grid_engines.append("benes")
         else:
             grid_engines = [args.engine]
+        try:
+            grid_bf16 = bool(int(os.environ.get("BENCH_GRID_BF16", "0")))
+        except ValueError:
+            print("ignoring malformed BENCH_GRID_BF16 (want 0/1)", file=sys.stderr)
+            grid_bf16 = False
         for grid_engine in grid_engines:
             try:
                 g_pps, g_val = _grid_northstar(grid_engine)
                 extras["grid16m_passes_per_s"] = round(g_pps, 1)
                 extras["grid16m_engine"] = grid_engine
                 extras["grid16m_dim"] = D_GRID
-                _PARTIAL.update(
-                    {k: dict(v) if isinstance(v, dict) else v for k, v in extras.items()}
-                )
-                if grid_engine == "fused":
-                    # bf16 payload at the grid, same quality gate as the
-                    # headline: adopted only when faster AND converged to
-                    # the same optimum as the exact engine
+                _record_extras(extras)
+                if grid_engine == "fused" and grid_bf16:
+                    # bf16 payload at the grid: RECORD-ONLY (never takes the
+                    # metric — the grid gate would compare objectives through
+                    # the rounded operator itself, and the measured number
+                    # lost anyway: 8.1M vs 13.0M passes/s, the grid blocks
+                    # being dispatch-bound, not bandwidth-bound). Opt-in via
+                    # BENCH_GRID_BF16=1; its cold compile would otherwise
+                    # risk the recorded run's watchdog.
                     try:
                         b_pps, b_val = _grid_northstar(
                             "fused", payload_dtype="bfloat16"
                         )
+                        extras["grid16m_fused_bf16_passes_per_s"] = round(
+                            b_pps, 1
+                        )
                         print(
-                            f"grid16m bf16: {b_pps:.0f} vs {g_pps:.0f} "
-                            f"passes/s (final {b_val:.6g} vs {g_val:.6g})",
+                            f"grid16m bf16 (record-only): {b_pps:.0f} vs "
+                            f"{g_pps:.0f} passes/s "
+                            f"(final {b_val:.6g} vs {g_val:.6g})",
                             file=sys.stderr,
                         )
-                        if (b_pps > g_pps
-                                and abs(b_val - g_val) <= 1e-4 * abs(g_val)):
-                            extras["grid16m_passes_per_s"] = round(b_pps, 1)
-                            extras["grid16m_engine"] = "fused_bf16"
-                            _PARTIAL.update(
-                                {k: dict(v) if isinstance(v, dict) else v
-                                 for k, v in extras.items()}
-                            )
+                        _record_extras(extras)
                     except Exception as e:  # pragma: no cover
                         print(f"grid bf16 failed: {e}", file=sys.stderr)
                 break

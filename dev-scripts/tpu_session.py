@@ -182,7 +182,8 @@ def main() -> None:
     args = ap.parse_args()
 
     _preflight()
-    results: dict = {"started_unix": time.time()}
+    started = time.time()
+    results: dict = {"started_unix": started}
     phases = [
         ("validate", _phase_validate, args.skip_validate),
         ("bench", _phase_bench, args.skip_bench),
@@ -202,9 +203,65 @@ def main() -> None:
         # persist after every phase: a mid-session tunnel death keeps
         # everything measured so far
         with open(args.out, "w") as f:
-            json.dump(results, f, indent=2)
+            json.dump(_merge_sessions(args.out, results, started), f, indent=2)
 
     print(f"session written to {args.out}", file=sys.stderr)
+
+
+def _phase_failed(results: dict, key: str, err_key: str) -> bool:
+    if err_key in results:
+        return True
+    v = results.get(key)
+    if v is None:
+        return True
+    if isinstance(v, dict) and (
+        v.get("error") or v.get("returncode") not in (None, 0)
+    ):
+        return True
+    return False
+
+
+def _merge_sessions(out_path: str, results: dict, started: float) -> dict:
+    """Keep the last SUCCESSFUL measurement per phase (timestamped).
+
+    The device tunnel flaps for hours; a fresh session with a failed or
+    watchdogged phase must not erase an earlier good measurement of that
+    phase. A degraded new result is stashed under ``<phase>_latest_partial``
+    so the record still shows the most recent attempt.
+    """
+    phase_keys = {
+        "validate": ("validate_fused", "validate_error"),
+        "bench": ("bench", "bench_error"),
+        "kernels": ("kernels", "kernels_error"),
+    }
+    try:
+        with open(out_path) as f:
+            prev = json.load(f)
+    except Exception:
+        prev = {}
+    merged = dict(results)
+    merged["note"] = (
+        "per-phase record: each phase carries its own measured_at_unix; a "
+        "phase that failed in the latest session keeps the previous "
+        "successful measurement, with the failed attempt under "
+        "<phase>_latest_partial"
+    )
+    for _, (key, err_key) in phase_keys.items():
+        if key in merged and isinstance(merged[key], dict):
+            merged[key].setdefault("measured_at_unix", started)
+        if not _phase_failed(merged, key, err_key):
+            continue
+        old = prev.get(key)
+        # previous successful measurement (possibly already merged once)
+        if isinstance(old, dict) and not (
+            old.get("error") or old.get("returncode") not in (None, 0)
+        ):
+            if key in merged:
+                merged[key + "_latest_partial"] = merged[key]
+            merged[key] = old
+        elif key not in merged and old is not None:
+            merged[key] = old
+    return merged
 
 
 if __name__ == "__main__":
