@@ -208,17 +208,22 @@ def main() -> None:
     print(f"session written to {args.out}", file=sys.stderr)
 
 
+def _result_bad(v) -> bool:
+    """A phase dict is degraded if it (or any direct sub-dict — the kernels
+    phase records per-engine errors one level down) carries an error."""
+    if not isinstance(v, dict):
+        return v is None
+    if v.get("error") or v.get("returncode") not in (None, 0):
+        return True
+    return any(
+        isinstance(sub, dict) and sub.get("error") for sub in v.values()
+    )
+
+
 def _phase_failed(results: dict, key: str, err_key: str) -> bool:
     if err_key in results:
         return True
-    v = results.get(key)
-    if v is None:
-        return True
-    if isinstance(v, dict) and (
-        v.get("error") or v.get("returncode") not in (None, 0)
-    ):
-        return True
-    return False
+    return _result_bad(results.get(key))
 
 
 def _merge_sessions(out_path: str, results: dict, started: float) -> dict:
@@ -229,10 +234,15 @@ def _merge_sessions(out_path: str, results: dict, started: float) -> dict:
     phase. A degraded new result is stashed under ``<phase>_latest_partial``
     so the record still shows the most recent attempt.
     """
+    # derived keys ride with their phase: restoring an old bench must also
+    # restore the recommendation/stderr computed FROM that bench
     phase_keys = {
-        "validate": ("validate_fused", "validate_error"),
-        "bench": ("bench", "bench_error"),
-        "kernels": ("kernels", "kernels_error"),
+        "validate": ("validate_fused", "validate_error", ()),
+        "bench": (
+            "bench", "bench_error",
+            ("bench_stderr", "recommended_auto_engine"),
+        ),
+        "kernels": ("kernels", "kernels_error", ()),
     }
     try:
         with open(out_path) as f:
@@ -246,19 +256,24 @@ def _merge_sessions(out_path: str, results: dict, started: float) -> dict:
         "successful measurement, with the failed attempt under "
         "<phase>_latest_partial"
     )
-    for _, (key, err_key) in phase_keys.items():
+    for _, (key, err_key, riders) in phase_keys.items():
         if key in merged and isinstance(merged[key], dict):
             merged[key].setdefault("measured_at_unix", started)
         if not _phase_failed(merged, key, err_key):
             continue
         old = prev.get(key)
         # previous successful measurement (possibly already merged once)
-        if isinstance(old, dict) and not (
-            old.get("error") or old.get("returncode") not in (None, 0)
-        ):
+        if isinstance(old, dict) and not _result_bad(old):
             if key in merged:
                 merged[key + "_latest_partial"] = merged[key]
             merged[key] = old
+            for rider in riders:
+                if rider in merged:
+                    merged[rider + "_latest_partial"] = merged[rider]
+                if rider in prev:
+                    merged[rider] = prev[rider]
+                else:
+                    merged.pop(rider, None)
         elif key not in merged and old is not None:
             merged[key] = old
     return merged

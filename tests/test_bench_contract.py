@@ -1,0 +1,54 @@
+"""The benchmark's machine-read contract, in smoke mode on CPU.
+
+The driver runs ``python bench.py`` at the end of every round and parses
+exactly one JSON line; this gate keeps that contract honest (keys, types,
+engine A/B recording incl. the quality-gated bf16 entry, north-star
+extras) without TPU hardware.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_contract():
+    env = dict(
+        os.environ,
+        BENCH_SMOKE="1",
+        JAX_PLATFORMS="cpu",
+        BENCH_PLAN_CACHE="",
+        PHOTON_ML_TPU_COMPILE_CACHE="",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+
+    assert payload["metric"] == "glmix_logistic_train_throughput"
+    assert payload["unit"] == "example_passes/sec/chip"
+    assert payload["value"] > 0
+    assert payload["vs_baseline"] > 0
+    assert "error" not in payload
+
+    engines = payload["engines"]
+    # every engine of the A/B is recorded, including the reduced-precision
+    # candidate; the headline is at least the best EXACT engine (fused_bf16
+    # only takes it when its quality gate passes) and always corresponds to
+    # a recorded engine measurement
+    for key in ("ell", "benes", "fused", "fused_bf16"):
+        assert key in engines and engines[key] > 0, engines
+    exact_best = max(v for k, v in engines.items() if k != "fused_bf16")
+    assert payload["value"] >= exact_best, (payload["value"], engines)
+    assert payload["value"] in engines.values(), (payload["value"], engines)
+
+    # north-star extras ride along
+    assert payload["wallclock_to_auc_s"] >= 0
+    assert payload["auc_final"] >= payload["auc_target"]
+    assert payload["grid16m_passes_per_s"] > 0
+    assert payload["grid16m_engine"] in ("ell", "benes", "fused", "fused_bf16")
