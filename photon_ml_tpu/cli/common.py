@@ -212,10 +212,14 @@ def parse_box_constraints(
     Two accepted payloads:
     - ``{"lower": s, "upper": s}`` — global scalar bounds (shorthand).
     - the reference's JSON array of ``{"name", "term", "lowerBound",
-      "upperBound"}`` maps (GLMSuite.createConstraintFeatureMap): every map
-      names both name and term; '*' wildcards in term (or name+term) apply
-      a bound to all features; a wildcard name requires a wildcard term;
-      lower <= upper; overlapping constraints are rejected.
+      "upperBound"}`` maps (GLMSuite.createConstraintFeatureMap:206-282):
+      every map names both name and term; at least one bound must be finite
+      and strictly lower < upper; ``name='*', term='*'`` bounds every
+      feature except the intercept and may not combine with any other
+      entry; ``term='*'`` alone bounds every feature whose key name-part
+      equals ``name`` (all terms) and combines with non-overlapping
+      entries; a wildcard name requires a wildcard term; bounds reaching
+      the same feature twice are rejected.
     """
     if not spec:
         return None, None, None
@@ -229,13 +233,45 @@ def parse_box_constraints(
             "--coefficient-box-constraints expects a JSON object with "
             "lower/upper or the reference's JSON array of per-feature maps"
         )
-    from photon_ml_tpu.indexmap import feature_key
+    from photon_ml_tpu.indexmap import NAME_TERM_DELIMITER, feature_key
 
     WILD = "*"
     lower = np.full(dim, -np.inf, dtype=np.float32)
     upper = np.full(dim, np.inf, dtype=np.float32)
     assigned = np.zeros(dim, dtype=bool)
-    wildcard_all = False
+
+    # One forward pass over the index builds name-part -> indices for all
+    # term-wildcard entries at once (a per-entry reverse scan would be
+    # O(dim) Python-level lookups per entry — pathological on off-heap maps)
+    wild_names = {
+        str(e["name"]) for e in payload
+        if isinstance(e, dict)
+        and e.get("term") == WILD and e.get("name") not in (None, WILD)
+    }
+    by_name: Dict[str, List[int]] = {nm: [] for nm in wild_names}
+    if wild_names:
+        items = (
+            index_map.items() if hasattr(index_map, "items")
+            else ((index_map.get_feature_name(i), i) for i in range(dim))
+        )
+        for key, idx in items:
+            if key is None:
+                continue
+            # empty-term features carry the bare name as their key
+            name_part = key.split(NAME_TERM_DELIMITER, 1)[0]
+            if name_part in by_name:
+                by_name[name_part].append(idx)
+
+    def _set(idx: int, lo: float, hi: float, what: str) -> None:
+        if assigned[idx]:
+            raise ValueError(
+                f"overlapping constraints for {what} (reference GLMSuite "
+                "conflict rule: a feature may be bounded at most once)"
+            )
+        lower[idx] = lo
+        upper[idx] = hi
+        assigned[idx] = True
+
     for entry in payload:
         if "name" not in entry or "term" not in entry:
             raise ValueError(
@@ -246,45 +282,60 @@ def parse_box_constraints(
         hi_raw = entry.get("upperBound")
         lo = float(lo_raw) if lo_raw is not None else -np.inf
         hi = float(hi_raw) if hi_raw is not None else np.inf
-        if lo > hi:
-            raise ValueError(
-                f"constraint lower bound {lo} exceeds upper bound {hi} "
-                f"for {entry['name']!r}/{entry['term']!r}"
-            )
         name, term = str(entry["name"]), str(entry["term"])
+        if np.isnan(lo) or np.isnan(hi):
+            raise ValueError(
+                f"constraint for {name!r}/{term!r} has a NaN bound"
+            )
+        if not np.isfinite(lo) and not np.isfinite(hi):
+            raise ValueError(
+                f"constraint for {name!r}/{term!r} has -Inf and +Inf "
+                "bounds: a no-op entry is an invalid specification "
+                "(reference GLMSuite.scala:224)"
+            )
+        if lo >= hi:
+            raise ValueError(
+                f"constraint lower bound {lo} must be strictly below the "
+                f"upper bound {hi} for {name!r}/{term!r} (reference "
+                "GLMSuite.scala:228)"
+            )
         if name == WILD and term != WILD:
             raise ValueError(
                 "a wildcard name requires a wildcard term (reference "
-                "GLMSuite constraint rule 3)"
+                "GLMSuite.scala:245)"
             )
-        if term == WILD:
-            if wildcard_all or assigned.any():
+        if name == WILD:  # '*'/'*': every feature except the intercept
+            if assigned.any():
                 raise ValueError(
-                    "overlapping constraints (reference GLMSuite constraint "
-                    "rule 4): a wildcard constraint cannot combine with "
-                    "other constraints"
+                    "potentially conflicting constraints: the all-wildcard "
+                    "entry may not combine with any other constraint "
+                    "(reference GLMSuite.scala:234)"
                 )
             lower[:] = lo
             upper[:] = hi
+            assigned[:] = True
             if intercept_index is not None:
                 # the reference's wildcard bounds never pin the intercept
-                # (it must stay free to absorb the base rate)
+                # (it must stay free to absorb the base rate); since the
+                # intercept is then absent from the constraint map, a LATER
+                # explicit intercept entry may still bound it — exactly the
+                # reference's containsKey-then-put order dependence
                 lower[intercept_index] = -np.inf
                 upper[intercept_index] = np.inf
-            wildcard_all = True
+                assigned[intercept_index] = False
+            continue
+        if term == WILD:
+            # bounds every feature whose key name-part equals `name` (all
+            # terms, including the empty term whose key is the bare name),
+            # each conflict-checked (reference GLMSuite.scala:249)
+            for idx in by_name.get(name, ()):
+                _set(idx, lo, hi, f"{name!r} (term wildcard)")
             continue
         idx = index_map.get_index(feature_key(name, term))
         if idx < 0:
             continue  # feature absent from the training index
-        if wildcard_all or assigned[idx]:
-            raise ValueError(
-                f"overlapping constraints for feature {name!r}/{term!r} "
-                "(reference GLMSuite constraint rule 4)"
-            )
-        lower[idx] = lo
-        upper[idx] = hi
-        assigned[idx] = True
-    if not wildcard_all and not assigned.any():
+        _set(idx, lo, hi, f"{name!r}/{term!r}")
+    if not assigned.any():
         return None, None, None
     return None, None, (lower, upper)
 

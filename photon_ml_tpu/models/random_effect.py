@@ -57,10 +57,11 @@ class RandomEffectModel:
     def to_summary_string(self) -> str:
         """Reference Summarizable.toSummaryString (RandomEffectModel)."""
         dims = [int(c.shape[1]) for c in self.coefficients]
+        dims_str = f"{min(dims)}-{max(dims)}" if dims else "n/a"
         return (
             f"random effect '{self.random_effect_type}': "
             f"{self.num_entities} entities in {len(self.coefficients)} "
-            f"buckets (local dims {min(dims)}-{max(dims)}), "
+            f"buckets (local dims {dims_str}), "
             f"global dim {self.global_dim}, "
             f"projector {self.projector_type.value}"
             + (", with variances" if any(

@@ -712,6 +712,15 @@ def from_coo(
         split_spill_entries,
     )
 
+    if (pin_k or pin_kp) and (
+        kp_cap not in ("auto", None, 0)
+        or col_split not in ("auto", None, 0, 1)
+    ):
+        raise ValueError(
+            "pin_k/pin_kp force the flat layout across sibling shards; an "
+            "explicit kp_cap/col_split cannot be honored alongside them "
+            "(drop the pins or the explicit layout)"
+        )
     n, d = shape
     rows, cols, vals, hot_matrix, hot_ids, row_counts, col_counts = (
         prepare_cold_entries(

@@ -867,15 +867,104 @@ class TestLegacyGlmParityFlags:
 
         with pytest.raises(ValueError, match="name.*term|must name"):
             _run([{"name": "g", "lowerBound": 0}])
-        with pytest.raises(ValueError, match="exceeds upper"):
+        with pytest.raises(ValueError, match="strictly below"):
             _run([{"name": "g", "term": "0", "lowerBound": 2, "upperBound": 1}])
+        # lower == upper is rejected (reference GLMSuite.scala:228 strict <)
+        with pytest.raises(ValueError, match="strictly below"):
+            _run([{"name": "g", "term": "0", "lowerBound": 1, "upperBound": 1}])
+        # a no-op entry (both bounds absent/infinite) is rejected
+        # (reference GLMSuite.scala:224)
+        with pytest.raises(ValueError, match="no-op|invalid"):
+            _run([{"name": "g", "term": "0"}])
         with pytest.raises(ValueError, match="wildcard term"):
             _run([{"name": "*", "term": "0", "lowerBound": 0}])
-        with pytest.raises(ValueError, match="[Oo]verlap"):
+        with pytest.raises(ValueError, match="conflict|[Oo]verlap"):
             _run([
                 {"name": "*", "term": "*", "lowerBound": -1, "upperBound": 1},
                 {"name": "g", "term": "0", "lowerBound": 0, "upperBound": 1},
             ])
+        # a term wildcard overlapping a specific entry of the same name
+        with pytest.raises(ValueError, match="[Oo]verlap"):
+            _run([
+                {"name": "g", "term": "0", "lowerBound": 0, "upperBound": 1},
+                {"name": "g", "term": "*", "lowerBound": -1, "upperBound": 1},
+            ])
+
+    def test_parse_box_constraints_unit(self):
+        """Exact bound arrays from the parser against a known index
+        (reference GLMSuite.createConstraintFeatureMap semantics)."""
+        import json as _json
+
+        import numpy as np
+
+        from photon_ml_tpu.cli.common import parse_box_constraints
+        from photon_ml_tpu.indexmap import (
+            INTERCEPT_KEY,
+            DefaultIndexMap,
+            feature_key,
+        )
+
+        imap = DefaultIndexMap({
+            feature_key("g", "0"): 0,
+            feature_key("g", "1"): 1,
+            "g": 2,              # empty-term feature: key is the bare name
+            feature_key("h", "0"): 3,
+            INTERCEPT_KEY: 4,
+        })
+
+        # term wildcard: every term of name 'g' INCLUDING the empty term,
+        # combining with a non-overlapping explicit entry on 'h'
+        _, _, box = parse_box_constraints(_json.dumps([
+            {"name": "g", "term": "*", "lowerBound": -1, "upperBound": 1},
+            {"name": "h", "term": "0", "lowerBound": 0, "upperBound": 2},
+        ]), imap, dim=5, intercept_index=4)
+        lo, hi = box
+        np.testing.assert_allclose(lo[:4], [-1, -1, -1, 0])
+        np.testing.assert_allclose(hi[:4], [1, 1, 1, 2])
+        assert lo[4] == -np.inf and hi[4] == np.inf  # intercept untouched
+
+        # all-wildcard: every feature EXCEPT the intercept
+        _, _, box = parse_box_constraints(_json.dumps([
+            {"name": "*", "term": "*", "lowerBound": -0.5, "upperBound": 0.5},
+        ]), imap, dim=5, intercept_index=4)
+        lo, hi = box
+        np.testing.assert_allclose(lo[:4], -0.5)
+        assert lo[4] == -np.inf and hi[4] == np.inf
+
+    def test_box_constraint_name_with_wildcard_term(self, glmix_avro, tmp_path):
+        """{name, term:'*'} bounds only features whose key name-part equals
+        `name` — for ALL terms — and combines with other constraints
+        (reference GLMSuite.scala:249-262), unlike the exclusive
+        all-wildcard entry."""
+        import json as _json
+
+        from photon_ml_tpu.cli.train_glm import parse_args, run
+
+        out = tmp_path / "namewild"
+        constraints = _json.dumps([
+            {"name": "g", "term": "*", "lowerBound": -0.01,
+             "upperBound": 0.01},
+        ])
+        result = run(parse_args([
+            "--training-data-dirs", str(glmix_avro["train"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--regularization-weights", "0.01",
+            "--coefficient-box-constraints", constraints,
+        ]))
+        assert result["fits"], result
+        text = (out / "model-lambda-0.01.txt").read_text()
+        coefs = {}
+        for line in text.splitlines():
+            parts = line.split("\t")
+            if len(parts) >= 3:
+                coefs[(parts[0], parts[1])] = float(parts[2])
+        g_vals = [v for (nm, _t), v in coefs.items() if nm == "g"]
+        assert g_vals and all(-0.0101 <= v <= 0.0101 for v in g_vals), coefs
+        # the intercept (different name-part) is untouched by the name
+        # wildcard — free to absorb the base rate
+        icpt = [v for (nm, _t), v in coefs.items() if nm != "g"]
+        assert icpt
 
     def test_validate_per_iteration_plot_in_report(self, glmix_avro, tmp_path):
         """--validate-per-iteration + diagnostics: the HTML report carries
