@@ -1,0 +1,16 @@
+#!/bin/bash
+# Poll the device tunnel; the moment it answers, run ONE batched measurement
+# session (dev-scripts/tpu_session.py) and exit. Use when the tunnel is down
+# and measurements are wanted as soon as it returns.
+#   dev-scripts/tpu_watch.sh [session args...]
+cd "$(dirname "$0")/.."
+for i in $(seq 1 200); do
+  if timeout 120 python -c "import jax, jax.numpy as jnp; jax.block_until_ready(jnp.arange(4).sum())" >/dev/null 2>&1; then
+    echo "tunnel up after probe $i; starting measurement session" >&2
+    exec python dev-scripts/tpu_session.py "$@"
+  fi
+  echo "probe $i: tunnel down" >&2
+  sleep 120
+done
+echo "tunnel never came up" >&2
+exit 1

@@ -2,8 +2,9 @@
 
 The driver runs ``python bench.py`` at the end of every round and parses
 exactly one JSON line; this gate keeps that contract honest (keys, types,
-engine A/B recording incl. the quality-gated bf16 entry, north-star
-extras) without TPU hardware.
+the north-star grid tile as the headline, pinned-vs-fresh baseline
+reporting, engine A/B recording incl. the quality-gated bf16 entry, and
+the stale-fallback failure path) without TPU hardware.
 """
 
 import json
@@ -11,10 +12,14 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+pytestmark = pytest.mark.slow  # each case re-runs bench.py as a child
 
-def test_bench_smoke_contract():
+
+def _smoke_env(**extra):
     env = dict(
         os.environ,
         BENCH_SMOKE="1",
@@ -22,9 +27,15 @@ def test_bench_smoke_contract():
         BENCH_PLAN_CACHE="",
         PHOTON_ML_TPU_COMPILE_CACHE="",
     )
+    env.update(extra)
+    return env
+
+
+def test_bench_smoke_contract():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=900, env=_smoke_env(),
+        cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = proc.stdout.strip().splitlines()[-1]
@@ -35,20 +46,95 @@ def test_bench_smoke_contract():
     assert payload["value"] > 0
     assert payload["vs_baseline"] > 0
     assert "error" not in payload
+    assert "stale" not in payload
 
+    # the HEADLINE is the north-star workload: the single-chip tile of the
+    # 1B-coefficient grid layout (VERDICT r4 #4)
+    assert payload["headline_workload"] == (
+        "grid_2^24_coef_chip_tile_of_1B_layout"
+    )
+    assert payload["value"] == payload["grid16m_passes_per_s"]
+    assert payload["grid16m_engine"] in ("ell", "benes", "fused")
+    assert payload["grid16m_iterations"] >= 1
+
+    # the convergence clock runs on the headline workload
+    assert payload["wallclock_to_auc_s"] >= 0
+    assert payload["auc_final"] >= payload["auc_target"]
+
+    # both baseline ratios are reported; vs_baseline is one of them
+    assert payload["vs_baseline_fresh"] > 0
+    assert payload["vs_baseline"] in (
+        payload["vs_baseline_fresh"], payload.get("vs_baseline_pinned")
+    )
+
+    # every engine of the small-dim A/B is recorded, including the
+    # reduced-precision candidate; the small-dim best is at least the best
+    # EXACT engine (fused_bf16 only takes it when its quality gate passes)
     engines = payload["engines"]
-    # every engine of the A/B is recorded, including the reduced-precision
-    # candidate; the headline is at least the best EXACT engine (fused_bf16
-    # only takes it when its quality gate passes) and always corresponds to
-    # a recorded engine measurement
     for key in ("ell", "benes", "fused", "fused_bf16"):
         assert key in engines and engines[key] > 0, engines
     exact_best = max(v for k, v in engines.items() if k != "fused_bf16")
-    assert payload["value"] >= exact_best, (payload["value"], engines)
-    assert payload["value"] in engines.values(), (payload["value"], engines)
+    assert payload["smalldim_passes_per_s"] >= exact_best
+    assert payload["smalldim_vs_baseline"] > 0
 
-    # north-star extras ride along
-    assert payload["wallclock_to_auc_s"] >= 0
-    assert payload["auc_final"] >= payload["auc_target"]
-    assert payload["grid16m_passes_per_s"] > 0
-    assert payload["grid16m_engine"] in ("ell", "benes", "fused", "fused_bf16")
+
+def test_bench_failure_emits_stale_lastgood(tmp_path):
+    """When the backend is unreachable and nothing was measured, the bench
+    replays the repo's last good record marked stale (exit 3) instead of
+    zeroing the round — the r4 failure mode (VERDICT r4 weak #1)."""
+    # stage a bench.py copy next to a fabricated last-good record so the
+    # test cannot touch the real repo files
+    import shutil
+
+    shutil.copy(os.path.join(REPO, "bench.py"), tmp_path / "bench.py")
+    lastgood = {
+        "metric": "glmix_logistic_train_throughput",
+        "value": 12345.6,
+        "unit": "example_passes/sec/chip",
+        "vs_baseline": 11.5,
+        "headline_workload": "grid_2^24_coef_chip_tile_of_1B_layout",
+        "measured_at_unix": 1785490000.0,
+        "host": "testhost",
+    }
+    (tmp_path / "BENCH_LASTGOOD.json").write_text(json.dumps(lastgood))
+    # not smoke (so the fallback path is live), but force an unreachable
+    # backend: the preflight child import must fail fast
+    env = _smoke_env(
+        BENCH_SMOKE="0",
+        JAX_PLATFORMS="nonexistent-backend",
+        BENCH_PREFLIGHT_S="60",
+        PYTHONPATH=REPO,
+    )
+    proc = subprocess.run(
+        [sys.executable, str(tmp_path / "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=tmp_path,
+    )
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-2000:])
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["value"] == 12345.6
+    assert payload["stale"] is True
+    assert payload["error"]
+    assert payload["measured_at_unix"] == 1785490000.0
+
+
+def test_bench_failure_without_lastgood_is_zero(tmp_path):
+    """No partial, no last-good record -> the zeros line with exit 2 (the
+    caller must be able to tell 'nothing known' from 'stale known')."""
+    import shutil
+
+    shutil.copy(os.path.join(REPO, "bench.py"), tmp_path / "bench.py")
+    env = _smoke_env(
+        BENCH_SMOKE="0",
+        JAX_PLATFORMS="nonexistent-backend",
+        BENCH_PREFLIGHT_S="60",
+        PYTHONPATH=REPO,
+    )
+    proc = subprocess.run(
+        [sys.executable, str(tmp_path / "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=tmp_path,
+    )
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-2000:])
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["value"] == 0.0
+    assert payload["error"]

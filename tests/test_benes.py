@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 from photon_ml_tpu.ops import routing
 from photon_ml_tpu.ops.features import EllFeatures, from_scipy_like
 from photon_ml_tpu.ops.permute_net import apply_plan, device_plan

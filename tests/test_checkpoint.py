@@ -8,6 +8,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 from photon_ml_tpu.data import RandomEffectDataConfiguration
 from photon_ml_tpu.data.game_data import FeatureShard, GameData
 from photon_ml_tpu.estimators.game import (

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 from photon_ml_tpu.algorithm.factored_random_effect import (
     FactoredRandomEffectCoordinate,
     KronFeatures,

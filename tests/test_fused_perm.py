@@ -9,6 +9,8 @@ four prologue/epilogue fusions.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 import jax
 import jax.numpy as jnp
 

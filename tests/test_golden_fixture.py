@@ -26,6 +26,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 HERE = os.path.join(os.path.dirname(__file__), "fixtures", "ratings")
 
 FIXED = {

@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 from photon_ml_tpu.ops.sparse_perm import from_coo
 from photon_ml_tpu.parallel.grid_features import (
     GridShardedFeatures,

@@ -2,6 +2,10 @@
 forward step and the full multi-chip sharded training step must compile and
 run on the virtual 8-device mesh (conftest.py)."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 import jax
 import numpy as np
 

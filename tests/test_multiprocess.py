@@ -16,6 +16,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_multiproc_worker.py")
 
 

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 from photon_ml_tpu.losses import (
     GlmObjective,
     LogisticLoss,

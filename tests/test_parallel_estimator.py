@@ -10,6 +10,8 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 from photon_ml_tpu.data.game_data import FeatureShard, GameData
 from photon_ml_tpu.estimators.game import (
     FixedEffectCoordinateConfiguration,

@@ -13,6 +13,8 @@ dense blocks stays bounded (<2x real cells at num_buckets=8).
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast lane (pyproject markers)
+
 from photon_ml_tpu.data.random_effect import (
     RandomEffectDataConfiguration,
     build_random_effect_dataset,
