@@ -174,7 +174,16 @@ def _phase_kernels(results: dict) -> None:
         "benes": lambda: sparse_perm.from_coo(rows, cols, vals, (n, d)),
         "fused": lambda: fused_perm.from_coo(rows, cols, vals, (n, d)),
     }
+    if not smoke:
+        # tile-height A/B: same plan, taller kernel blocks — separates
+        # per-grid-step overhead from bandwidth (fused_perm._tile_cap)
+        engines["fused_u32"] = engines["fused"]
+        engines["fused_u64"] = engines["fused"]
+    cap_prior = os.environ.get("PHOTON_FUSED_TILE_U")
     for name, build in engines.items():
+        tile_cap = name.rsplit("_u", 1)[-1] if "_u" in name else None
+        if tile_cap:
+            os.environ["PHOTON_FUSED_TILE_U"] = tile_cap
         try:
             feats = build()
             mv = jax.jit(feats.matvec)
@@ -254,6 +263,12 @@ def _phase_kernels(results: dict) -> None:
             }
         except Exception as e:
             out[name] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            if tile_cap:  # restore the operator's cap (if any), not unset
+                if cap_prior is None:
+                    os.environ.pop("PHOTON_FUSED_TILE_U", None)
+                else:
+                    os.environ["PHOTON_FUSED_TILE_U"] = cap_prior
     results["kernels"] = out
 
     # profiler trace for manual xprof inspection (small, one engine each)

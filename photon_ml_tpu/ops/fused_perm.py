@@ -41,6 +41,7 @@ on CPU through the interpreter (tests set ``_INTERPRET``).
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -239,12 +240,30 @@ def _pro_specs(pro, R1: int, u: int):
 # --------------------------------------------------------------------------
 
 
+def _tile_cap() -> int:
+    """Rows-of-128 per kernel block (the pipeline tile height).
+
+    Default 8 → [1024, 128] f32 blocks (~0.5 MB payload). VMEM holds far
+    larger tiles; PHOTON_FUSED_TILE_U raises the cap (power of two) so the
+    hardware session can A/B whether per-grid-step overhead — not HBM
+    bandwidth — is what binds the kernels (VERDICT r4 weak #3)."""
+    try:
+        cap = int(os.environ.get("PHOTON_FUSED_TILE_U", "8"))
+    except ValueError:
+        return 8
+    if cap < 8 or cap & (cap - 1):
+        return 8
+    return cap
+
+
 def _tile_rows(R1: int) -> int:
     """Sublane tile count u for the 3-D entered layout [B*128, R1, 128].
 
     Mosaic's lowering requires the middle block dim be divisible by 8 or
-    equal to the full array dim R1, so u = 8 whenever 8 | R1 and u = R1
+    equal to the full array dim R1, so u is the largest power-of-two
+    divisor of R1 within the tile cap (>= 8 whenever 8 | R1), and u = R1
     below that (plans are power-of-two sized, making R1 < 8 exact)."""
+    cap = _tile_cap()
     u = 8
     while R1 % u:
         u //= 2
@@ -253,6 +272,8 @@ def _tile_rows(R1: int) -> int:
             f"R1={R1} admits no Mosaic-legal sublane tile (need 8 | u or "
             "u == R1); plan sizes must be powers of two"
         )
+    while u * 2 <= cap and R1 % (u * 2) == 0:
+        u *= 2
     return u
 
 
@@ -269,6 +290,10 @@ def _descend_call(
     """
     R1 = R // LANES
     u = _tile_rows(R1)
+    if pro is not None and pro.group > LANES:
+        # the q-path prologue builds an O(u^2) in-kernel selection matrix;
+        # keep the default tile height there regardless of the A/B cap
+        u = min(u, 8)
 
     def kernel(*refs):
         o_ref = refs[-1]
@@ -315,6 +340,10 @@ def _ascend_call(v3, idx, B: int, R: int, epi, interpret: bool):
     """
     R1 = R // LANES
     u = _tile_rows(R1)
+    if epi is not None and epi.group > LANES:
+        # the q-path epilogue builds an O(u^2) selection matrix (see
+        # _descend_call); keep the default tile height there
+        u = min(u, 8)
 
     def _shuffled(x_ref, i_ref):
         # f32 in-VMEM shuffle (see _descend_call): converts are local, the
@@ -405,7 +434,7 @@ def _ascend_call(v3, idx, B: int, R: int, epi, interpret: bool):
 def _base_call(v, idx_a, idx_s, rows: int, idx_b, interpret: bool) -> jax.Array:
     """Innermost (lane, sublane, lane) triple, row-local, one pass."""
     M = v.shape[0]
-    rb = _MAX_BASE_BLOCK
+    rb = _MAX_BASE_BLOCK * (_tile_cap() // 8)
     while M % rb or rb % max(rows, 1):
         rb //= 2
 

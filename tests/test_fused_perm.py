@@ -66,6 +66,28 @@ def _check_against_dense(feats, dense, rng, atol=1e-4, rtol=1e-7):
     )
 
 
+class TestTileCap:
+    """PHOTON_FUSED_TILE_U raises the kernel block height (the dispatch-
+    overhead A/B knob for the hardware session); results must stay exact
+    through the interpreter at any legal cap."""
+
+    @pytest.mark.parametrize("cap", ["32", "64"])
+    def test_raised_tile_cap_exact(self, rng, interpret_kernels,
+                                   monkeypatch, cap):
+        monkeypatch.setenv("PHOTON_FUSED_TILE_U", cap)
+        n, d, nnz = 4096, 512, 24000  # S = n*K >= 128^2*8: R1 large enough
+        rows, cols, vals, dense = _random_coo(rng, n, d, nnz)
+        feats = from_coo(rows, cols, vals, (n, d), max_hot_cols=0,
+                         plan_cache="")
+        _check_against_dense(feats, dense, rng)
+
+    def test_malformed_cap_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_FUSED_TILE_U", "not-a-number")
+        assert fused_perm._tile_cap() == 8
+        monkeypatch.setenv("PHOTON_FUSED_TILE_U", "12")  # not a power of two
+        assert fused_perm._tile_cap() == 8
+
+
 class TestUnfusedFallback:
     """CPU default path (pallas unavailable): unfused XLA execution."""
 
