@@ -664,7 +664,13 @@ def _main():
 
     pin = _load_pin()
     extras: dict = {}
-    headline = None  # (value, vs_fresh_ratio_fn result fields)
+    if os.environ.get("PHOTON_FUSED_TILE_U"):
+        # provenance: the fused kernels' tile-height knob shapes the
+        # numbers — record the EFFECTIVE cap (malformed env falls back)
+        from photon_ml_tpu.ops.fused_perm import _tile_cap
+
+        extras["tile_cap"] = _tile_cap()
+    headline = None  # (value, vs_baseline, workload name)
 
     # ---- HEADLINE FIRST: the north-star 2^24-coef chip tile ----
     if not args.skip_grid:

@@ -434,7 +434,11 @@ def _ascend_call(v3, idx, B: int, R: int, epi, interpret: bool):
 def _base_call(v, idx_a, idx_s, rows: int, idx_b, interpret: bool) -> jax.Array:
     """Innermost (lane, sublane, lane) triple, row-local, one pass."""
     M = v.shape[0]
-    rb = _MAX_BASE_BLOCK * (_tile_cap() // 8)
+    # base blocks grow with the tile cap but stay clamped at 4x: the
+    # sublane stage materializes [rb/rows, rows, 128] accumulators per
+    # step, and an oversized base kernel failing to compile would wipe the
+    # whole engine's A/B (the descend/ascend knob is the experiment)
+    rb = _MAX_BASE_BLOCK * min(_tile_cap() // 8, 4)
     while M % rb or rb % max(rows, 1):
         rb //= 2
 
