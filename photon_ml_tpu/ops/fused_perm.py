@@ -771,9 +771,12 @@ def from_coo(
     # pinned paddings promise shape stability across sibling shards: the
     # layout planner must not replace the flat layout behind them
     if nnz and not pin_k and not pin_kp:
+        from photon_ml_tpu.ops.sparse_perm import make_row_block_k
+
         cap, t = resolve_layout(
             kp_cap, col_split, col_counts, n, d, K, KP,
             size_floor=size_floor,
+            row_block_k=make_row_block_k(rows, cols, n, d, pow2=True),
         )
         if t > 1:
             import functools

@@ -34,6 +34,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -69,10 +71,11 @@ class BenesSparseFeatures:
     hot_cols: Optional[jax.Array]    # [H] int32 original column ids
     num_rows_: int = struct.field(pytree_node=False)
     num_cols_: int = struct.field(pytree_node=False)
-    # Spill side (KP cap, see auto_kp_cap): entries beyond each column's
-    # ``cap`` routed slots, evaluated by gather/scatter-add. Bounded by
-    # max(nnz/128, 4096) at build time, so the scalar ops never dominate
-    # at scale (small shards may spill proportionally more — cheap there).
+    # Spill side (KP cap, see plan_column_layout): entries beyond each
+    # column's ``cap`` routed slots, evaluated by gather/scatter-add. The
+    # auto planner prices each spilled entry at _spill_slot_cost() routed
+    # slots and hard-bounds spill at max(nnz/8, 4096), so the scatter side
+    # stays a small fraction of the network cost by construction.
     spill_rows: Optional[jax.Array] = None   # [M] int32
     spill_cols: Optional[jax.Array] = None   # [M] int32
     spill_vals: Optional[jax.Array] = None   # [M] float32
@@ -288,6 +291,27 @@ class _ZeroColumnsBlock:
         )
 
 
+# One spilled (over-cap) entry costs about this many routed slots. A COO
+# gather + scatter-add runs ~7-10 ns/entry on TPU (SCALING.md measurement)
+# while a routed slot moves ~45 B through ~2m+1 kernel passes — ~2 ns at
+# the currently-achieved ~25 GB/s but ~0.06 ns at peak HBM, so the right
+# ratio is bandwidth-dependent. The default 32 is conservative (prefers
+# routing over spill when in doubt); PHOTON_SPILL_SLOT_COST lets the
+# hardware measurement session calibrate it. Keeping this a COST (not a
+# hard budget) is what lets a thin-tailed 2^26-column shard take a small
+# cap + split instead of a 16x-padded flat network (the r5 planner fix).
+def _spill_slot_cost() -> int:
+    try:
+        return max(int(os.environ.get("PHOTON_SPILL_SLOT_COST", "32")), 1)
+    except ValueError:
+        return 32
+
+
+# Hard sanity bound: spill stays a small fraction of nnz so the device COO
+# arrays and the scatter remain negligible next to the routed network.
+_MAX_SPILL_FRACTION = 8  # spill <= nnz / 8
+
+
 def plan_column_layout(
     col_counts: np.ndarray,
     n: int,
@@ -296,52 +320,96 @@ def plan_column_layout(
     kp_full: int,
     max_blocks: int = 16,
     size_floor: int = 0,
+    row_block_k: Optional["callable"] = None,
 ):
-    """Jointly pick (kp_cap, n_col_blocks) minimizing total routed slots.
+    """Jointly pick (kp_cap, n_col_blocks) minimizing total cost in routed
+    slots, where over-cap (spilled) entries are priced at SPILL_SLOT_COST
+    slots each.
 
-    The two levers interact through the coarse valid-size ladder
-    (c*128^k, c in {1,2,4,8}): capping KP alone may not cross a ladder
-    step, and splitting alone multiplies the uncapped d*KP. Candidates:
-    every power-of-two cap whose spill fits the nnz/128 budget (plus
-    "no cap"), crossed with block counts {1,2,...,max_blocks}. Returns
-    ``(cap_or_None, n_blocks)``; a layout must beat the plain one by >= 2x
-    in total slots to justify extra dispatches (and any cap must shrink S).
+    The levers interact through the coarse valid-size ladder (c*128^k,
+    c in {1,2,4,8}): capping KP alone may not cross a ladder step, and
+    splitting alone multiplies the uncapped d*KP. Candidates: every
+    power-of-two cap whose spill stays under nnz/8, crossed with block
+    counts {1,2,...,max_blocks}. ``row_block_k(t)`` optionally returns the
+    true per-block row group size for a t-way column split (each block
+    holds only its columns' entries, so its K is smaller than the global
+    K); without it the global K bounds the row side. Returns
+    ``(cap_or_None, n_blocks)``; a multi-block layout must beat the plain
+    one by >= 2x in total cost to justify the extra dispatches.
     """
     nnz = int(col_counts.sum())
     s_plain = routing.valid_size(max(n * K, d * kp_full, size_floor, 1))
     if not nnz or (kp_full <= 1 and d <= 1):
         return None, 1
-    # nnz/128 keeps the scatter side negligible at scale; the 4096 floor
-    # lets small shards (where every op is cheap anyway) still benefit
-    budget = max(nnz // 128, 4096)
-    caps = [kp_full]
+    max_spill = max(nnz // _MAX_SPILL_FRACTION, 4096)
+    cands = []
     p = 1
     while p < kp_full:
-        if int(np.maximum(col_counts - p, 0).sum()) <= budget:
-            caps.append(p)
-            if 2 * p < kp_full:
-                caps.append(2 * p)  # a gentler cap: less spill, maybe same S
-            break
+        cands.append(p)
         p *= 2
+    cands.append(kp_full)  # the uncapped candidate (spill 0), ALWAYS kept
+    caps = []  # (cap, spill_cost)
+    for p in cands:
+        spill = (
+            0 if p >= kp_full
+            else int(np.maximum(col_counts - p, 0).sum())
+        )
+        if spill <= max_spill:
+            caps.append((p, spill * _spill_slot_cost()))
     best = (None, 1, s_plain)
-    for cap in caps:
+    for cap, spill_cost in caps:
         t = 1
         while t <= max_blocks:
             d_b = -(-d // t)
-            s_t = t * routing.valid_size(max(n * K, d_b * cap, size_floor, 1))
+            k_t = row_block_k(t) if (row_block_k and t > 1) else K
+            s_t = t * routing.valid_size(
+                max(n * k_t, d_b * cap, size_floor, 1)
+            ) + spill_cost
             if s_t < best[2]:
-                best = (None if cap == kp_full else cap, t, s_t)
+                best = (None if cap >= kp_full else cap, t, s_t)
             t *= 2
     cap, t, s_best = best
     if t > 1 and s_best * 2 > s_plain:
         # a multi-block layout must be a clear (2x) win; fall back to the
-        # best single-block layout if capping alone still shrinks S
-        best_cap = None
-        for cap in caps[1:]:
-            if routing.valid_size(max(n * K, d * cap, size_floor, 1)) < s_plain:
-                best_cap = cap if best_cap is None else max(best_cap, cap)
+        # best single-block layout if capping alone still helps
+        best_cap, best_cost = None, s_plain
+        for cap, spill_cost in caps:
+            if cap >= kp_full:
+                continue
+            cost = routing.valid_size(
+                max(n * K, d * cap, size_floor, 1)
+            ) + spill_cost
+            if cost < best_cost:
+                best_cap, best_cost = cap, cost
         return best_cap, 1
     return cap, t
+
+
+def make_row_block_k(rows, cols, n: int, d: int, pow2: bool = False):
+    """Per-block row group size estimator for the layout planner: for a
+    t-way column split, the max nnz any single row holds within one block
+    (each block sees only its columns' entries, so its ELL width K is
+    smaller than the global K). Memoized per t; ``pow2`` rounds up for the
+    fused engine's power-of-two slot groups."""
+    cache: dict = {}
+
+    def row_block_k(t: int) -> int:
+        if t not in cache:
+            d_b = -(-d // t)
+            key = rows * t + (cols // d_b)
+            # unique, not bincount: memory stays O(nnz) (a bincount over
+            # n*t bins would transiently allocate ~13 GB at n=1e8, t=16)
+            if key.size:
+                _, counts = np.unique(key, return_counts=True)
+                k = int(counts.max())
+            else:
+                k = 1
+            if pow2:
+                k = 1 << max(int(k) - 1, 0).bit_length()
+            cache[t] = max(k, 1)
+        return cache[t]
+
+    return row_block_k
 
 
 def resolve_kp_cap(
@@ -431,13 +499,14 @@ def _best_split(
 
 
 def resolve_layout(kp_cap, col_split, col_counts, n, d, K, kp_full,
-                   size_floor: int = 0):
+                   size_floor: int = 0, row_block_k=None):
     """Normalize (kp_cap, col_split) arguments to an effective
     ``(cap_or_None, n_blocks)`` layout. "auto"/"auto" runs the joint
     planner; manual values are validated and used as-is."""
     if kp_cap == "auto" and col_split == "auto":
         return plan_column_layout(
-            col_counts, n, d, K, kp_full, size_floor=size_floor
+            col_counts, n, d, K, kp_full, size_floor=size_floor,
+            row_block_k=row_block_k,
         )
     cap = resolve_kp_cap(kp_cap, col_counts, n, d, K, kp_full, size_floor)
     if col_split == "auto":
@@ -478,9 +547,11 @@ def from_coo(
     ``max_hot_cols=0`` to disable.
 
     ``kp_cap`` ("auto" default) additionally bounds the CSC padding KP when
-    the column-degree tail is thin, spilling the few over-cap entries to a
-    scatter-add side (see :func:`auto_kp_cap`); pass None/0 to disable or a
-    power of two to pin the cap. ``col_split`` ("auto" default) may
+    the column-degree tail is thin, spilling the over-cap entries to a
+    scatter-add side (auto/auto runs :func:`plan_column_layout`, which
+    prices spill at _spill_slot_cost() slots per entry and bounds it at
+    nnz/8); pass None/0 to disable or a power of two to pin the cap.
+    ``col_split`` ("auto" default) may
     partition the column space into independent sub-networks when the
     valid-size ladder would otherwise overshoot (see
     :class:`ColumnSplitFeatures`); the result then is a ColumnSplitFeatures.
@@ -500,7 +571,10 @@ def from_coo(
 
     cap, t = (None, 1)
     if nnz:
-        cap, t = resolve_layout(kp_cap, col_split, col_counts, n, d, K, KP)
+        cap, t = resolve_layout(
+            kp_cap, col_split, col_counts, n, d, K, KP,
+            row_block_k=make_row_block_k(rows, cols, n, d),
+        )
     if t > 1:
         return build_column_split(
             from_coo, rows, cols, vals, n, d, t, cap,

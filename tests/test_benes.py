@@ -472,6 +472,59 @@ class TestKpCapSpill:
                 atol=3e-4, err_msg=fld,
             )
 
+    def test_planner_escapes_ladder_cliff_on_thin_tails(self):
+        """The r5 planner fix: a thin-tailed wide shard whose spill at a
+        small cap slightly exceeds the old hard budget (nnz/128) must NOT
+        fall back to the flat 16x-padded network above the valid-size
+        ladder cliff — spill is a cost, not a gate. Shape mirrors the
+        2^26-column memory-envelope tile scaled down."""
+        from photon_ml_tpu.ops import routing
+        from photon_ml_tpu.ops.sparse_perm import (
+            make_row_block_k,
+            resolve_layout,
+        )
+
+        rng = np.random.default_rng(11)
+        n, k, d = 1 << 14, 16, 1 << 20  # nnz = 262144, ~0.25 nnz/col
+        rows = np.repeat(np.arange(n, dtype=np.int64), k)
+        cols = rng.integers(0, d, n * k).astype(np.int64)
+        cc = np.bincount(cols, minlength=d)
+        kp_full = 1 << int(np.ceil(np.log2(cc.max())))
+        cap, t = resolve_layout(
+            "auto", "auto", cc, n, d, k, kp_full,
+            row_block_k=make_row_block_k(rows, cols, n, d),
+        )
+        eff = cap if cap else kp_full
+        total = t * routing.valid_size(max(n * k, -(-d // t) * eff))
+        flat = routing.valid_size(max(n * k, d * kp_full))
+        nnz = n * k
+        # the flat network pads ~16x past the ladder step; the planned
+        # layout must stay within 8x of nnz and beat flat by >= 2x
+        assert total <= 8 * nnz, (cap, t, total, nnz)
+        assert total * 2 <= flat, (total, flat)
+        # spill stays within the sanity fraction
+        spill = int(np.maximum(cc - eff, 0).sum())
+        assert spill <= nnz // 8
+
+    def test_planner_keeps_uncapped_split_for_non_pow2_kp(self):
+        """kp_full is the raw max column degree (not a power of two) in
+        sparse_perm.from_coo; the uncapped candidate must still enter the
+        joint search so an uncapped multi-block split survives when every
+        pow2 cap would spill too much (r5 review regression)."""
+        from photon_ml_tpu.ops import routing
+        from photon_ml_tpu.ops.sparse_perm import plan_column_layout
+
+        n, K, d = 1024, 96, 65536
+        # 8192 columns of degree exactly 12: kp_full = 12; spill at any
+        # pow2 cap below 12 exceeds nnz/8
+        cc = np.zeros(d, dtype=np.int64)
+        cc[:8192] = 12
+        cap, t = plan_column_layout(cc, n, d, K, kp_full=12)
+        eff = cap if cap else 12
+        total = t * routing.valid_size(max(n * K, -(-d // t) * eff))
+        flat = routing.valid_size(max(n * K, d * 12))
+        assert total * 2 <= flat, (cap, t, total, flat)
+
     def test_explicit_cap_and_disable(self, rng):
         rows, cols, vals, dense = self._thin_tail_problem(rng)
         n, d = dense.shape
