@@ -740,6 +740,7 @@ def from_coo(
     """
     from photon_ml_tpu.ops.sparse_perm import (
         build_column_split,
+        make_row_block_k,
         prepare_cold_entries,
         resolve_layout,
         split_spill_entries,
@@ -771,8 +772,6 @@ def from_coo(
     # pinned paddings promise shape stability across sibling shards: the
     # layout planner must not replace the flat layout behind them
     if nnz and not pin_k and not pin_kp:
-        from photon_ml_tpu.ops.sparse_perm import make_row_block_k
-
         cap, t = resolve_layout(
             kp_cap, col_split, col_counts, n, d, K, KP,
             size_floor=size_floor,

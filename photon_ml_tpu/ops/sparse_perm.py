@@ -321,6 +321,7 @@ def plan_column_layout(
     max_blocks: int = 16,
     size_floor: int = 0,
     row_block_k: Optional["callable"] = None,
+    spill_scale: float = 1.0,
 ):
     """Jointly pick (kp_cap, n_col_blocks) minimizing total cost in routed
     slots, where over-cap (spilled) entries are priced at SPILL_SLOT_COST
@@ -333,7 +334,10 @@ def plan_column_layout(
     counts {1,2,...,max_blocks}. ``row_block_k(t)`` optionally returns the
     true per-block row group size for a t-way column split (each block
     holds only its columns' entries, so its K is smaller than the global
-    K); without it the global K bounds the row side. Returns
+    K); without it the global K bounds the row side. ``spill_scale``
+    normalizes the spill cost to the network-size units: a multi-tile grid
+    passes counts concatenated over all tiles while n/d describe ONE tile,
+    so it passes 1/num_tiles to keep both sides per-tile. Returns
     ``(cap_or_None, n_blocks)``; a multi-block layout must beat the plain
     one by >= 2x in total cost to justify the extra dispatches.
     """
@@ -355,7 +359,7 @@ def plan_column_layout(
             else int(np.maximum(col_counts - p, 0).sum())
         )
         if spill <= max_spill:
-            caps.append((p, spill * _spill_slot_cost()))
+            caps.append((p, spill * _spill_slot_cost() * spill_scale))
     best = (None, 1, s_plain)
     for cap, spill_cost in caps:
         t = 1
@@ -499,14 +503,15 @@ def _best_split(
 
 
 def resolve_layout(kp_cap, col_split, col_counts, n, d, K, kp_full,
-                   size_floor: int = 0, row_block_k=None):
+                   size_floor: int = 0, row_block_k=None,
+                   spill_scale: float = 1.0):
     """Normalize (kp_cap, col_split) arguments to an effective
     ``(cap_or_None, n_blocks)`` layout. "auto"/"auto" runs the joint
     planner; manual values are validated and used as-is."""
     if kp_cap == "auto" and col_split == "auto":
         return plan_column_layout(
             col_counts, n, d, K, kp_full, size_floor=size_floor,
-            row_block_k=row_block_k,
+            row_block_k=row_block_k, spill_scale=spill_scale,
         )
     cap = resolve_kp_cap(kp_cap, col_counts, n, d, K, kp_full, size_floor)
     if col_split == "auto":

@@ -297,6 +297,7 @@ def grid_from_coo(
     # identically-shaped sub-blocks.
     tile_spill = {key: (None, None, None) for key in tiles_cold}
     col_blocks = 1
+    k_blk = K  # per-block pinned ELL width when the columns split
     block_spill: dict = {}
     if engine in ("benes", "fused") and (kp_cap or col_split != 1):
         from photon_ml_tpu.ops.sparse_perm import (
@@ -307,9 +308,33 @@ def grid_from_coo(
         all_counts = np.concatenate(
             [tile_col_counts[key] for key in sorted(tile_col_counts)]
         )
+
+        def _grid_row_block_k(t: int) -> int:
+            """Pinned per-block ELL width for a t-way column split: the max
+            nnz any tile-local row holds within one column block, over ALL
+            tiles (blocks stack across tiles, so the pin is the global
+            max). Same refinement as sparse_perm.make_row_block_k."""
+            d_bb_t = -(-d_loc // t)
+            k_max = 1
+            for tr, tc, _tv, _hm in tiles_cold.values():
+                if not tr.size:
+                    continue
+                key2 = tr.astype(np.int64) * t + tc // d_bb_t
+                _, cnts = np.unique(key2, return_counts=True)
+                k_max = max(k_max, int(cnts.max()))
+            if engine == "fused":
+                k_max = 1 << max(k_max - 1, 0).bit_length()
+            return k_max
+
+        # all_counts spans every tile while n_loc/d_loc describe one tile:
+        # scale the spill cost to per-tile units to match the network size
         cap, col_blocks = resolve_layout(
-            kp_cap, col_split, all_counts, n_loc, d_loc, K, KP
+            kp_cap, col_split, all_counts, n_loc, d_loc, K, KP,
+            row_block_k=_grid_row_block_k,
+            spill_scale=1.0 / max(len(tiles_cold), 1),
         )
+        if col_blocks > 1:
+            k_blk = _grid_row_block_k(col_blocks)
         if col_blocks > 1:
             # partition each tile's cold entries into column blocks; apply
             # the cap per (tile, block); pad spills to ONE stackable length
@@ -411,17 +436,20 @@ def grid_from_coo(
                 asm_kw = {"payload_dtype": payload_dtype}
             if col_blocks > 1:
                 # pinned per-block layout: every (tile, block) shares
-                # (K, KP, S_b, spill length), so tiles stack leaf-by-leaf
+                # (k_blk, KP, S_b, spill length), so tiles stack
+                # leaf-by-leaf; k_blk is the per-block ELL width (each
+                # block holds only its columns' entries, so it is smaller
+                # than the full-tile K — the planner priced it this way)
                 from photon_ml_tpu.ops.sparse_perm import ColumnSplitFeatures
 
                 d_bb = -(-d_loc // col_blocks)
-                S_b = routing.valid_size(max(n_loc * K, d_bb * KP, 1))
+                S_b = routing.valid_size(max(n_loc * k_blk, d_bb * KP, 1))
                 blocks = []
                 for b, (btr, btc, btv, spill) in enumerate(
                     block_spill[dd, df]
                 ):
                     blocks.append(assembler(
-                        btr, btc, btv, n_loc, d_bb, K, KP, None, None,
+                        btr, btc, btv, n_loc, d_bb, k_blk, KP, None, None,
                         plan_cache, size_floor=S_b, spill=spill, **asm_kw,
                     ))
                 return ColumnSplitFeatures(
