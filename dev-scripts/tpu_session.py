@@ -225,6 +225,7 @@ def _phase_kernels(results: dict) -> None:
             t_eval = _time_best(eval_chain, w) / CHAIN
             if name == "ell":
                 bytes_map = (3 * nnz + n) * 4
+                S = None
             else:
                 S = feats.plan.size
                 m = sum(
@@ -272,6 +273,7 @@ def _phase_kernels(results: dict) -> None:
                 "pct_of_peak_rmatvec": round(pct_rmv, 2),
                 "peak_GBps": PEAK_HBM_GBPS,
                 "bytes_per_map": bytes_map,
+                "network_slots": S,
                 "binding": binding,
             }
         except Exception as e:
@@ -283,6 +285,52 @@ def _phase_kernels(results: dict) -> None:
                 else:
                     os.environ["PHOTON_FUSED_TILE_U"] = cap_prior
     results["kernels"] = out
+
+    # spill-cost calibration: ns/entry of an XLA scatter-add (the spill
+    # side's op) vs ns/slot of the fastest routed engine. Their ratio is
+    # the measured PHOTON_SPILL_SLOT_COST the layout planner should use
+    # (sparse_perm._spill_slot_cost; default 32 is a conservative guess).
+    try:
+        m_sp = 1 << (12 if smoke else 21)
+        sp_rows = jnp.asarray(rng.integers(0, n, m_sp).astype(np.int32))
+        sp_cols = jnp.asarray(rng.integers(0, d, m_sp).astype(np.int32))
+        sp_vals = jnp.asarray(rng.standard_normal(m_sp).astype(np.float32))
+
+        @jax.jit
+        def spill_chain(w0):
+            # the real spill op: out[rows] += vals * w[cols] (gather +
+            # multiply + scatter-add), chained through the carry
+            def body(_, wc):
+                z = jnp.zeros(n, jnp.float32).at[sp_rows].add(
+                    sp_vals * wc[sp_cols]
+                )
+                return wc + 1e-30 * jnp.sum(z)
+            return lax.fori_loop(0, CHAIN, body, w0)
+
+        t_spill = _time_best(spill_chain, jnp.zeros(d, jnp.float32)) / CHAIN
+        ns_per_entry = t_spill / m_sp * 1e9
+        # calibrate against the fastest measured routed engine — the one
+        # the planner's layouts will actually execute on
+        slot_ns = None
+        routed = [
+            e for e in out.values()
+            if "matvec_s" in e and e.get("network_slots")
+        ]
+        if routed:
+            best_e = min(routed, key=lambda e: e["matvec_s"])
+            slot_ns = best_e["matvec_s"] / best_e["network_slots"] * 1e9
+        results["spill_calibration"] = {
+            "scatter_ns_per_entry": round(ns_per_entry, 2),
+            "routed_ns_per_slot": (
+                round(slot_ns, 4) if slot_ns is not None else None
+            ),
+            "recommended_spill_slot_cost": (
+                max(int(round(ns_per_entry / slot_ns)), 1)
+                if slot_ns else None
+            ),
+        }
+    except Exception as e:
+        results["spill_calibration"] = {"error": f"{type(e).__name__}: {e}"}
 
     # profiler trace for manual xprof inspection (small, one engine each)
     trace_dir = os.path.join(REPO, "profile-traces")
