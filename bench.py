@@ -308,7 +308,7 @@ def _grid_auc_clock(built):
     final = max(a for _, a in trace)
     target = final - AUC_MARGIN
     secs = next(t for t, a in trace if a >= target)
-    return secs, target, final
+    return secs, target, final, trace
 
 
 # --------------------------------------------------------------------------
@@ -726,10 +726,15 @@ def _main():
             })
             if not args.skip_auc_clock:
                 try:
-                    secs, target, achieved = _grid_auc_clock(grid_built)
+                    secs, target, achieved, trace = _grid_auc_clock(
+                        grid_built
+                    )
                     extras["wallclock_to_auc_s"] = round(secs, 3)
                     extras["auc_target"] = round(target, 4)
                     extras["auc_final"] = round(achieved, 4)
+                    extras["auc_trace"] = [
+                        [round(t, 3), round(a, 4)] for t, a in trace
+                    ]
                     _PARTIAL.update(**{
                         k: extras[k] for k in
                         ("wallclock_to_auc_s", "auc_target", "auc_final")
