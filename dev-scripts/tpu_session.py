@@ -494,12 +494,14 @@ def main() -> None:
     _preflight()
     started = time.time()
     results: dict = {"started_unix": started}
-    # memory (child processes) runs BEFORE kernels (in-process jax): once
-    # the parent holds the device client, children could no longer acquire
-    # the chip on backends with exclusive ownership
+    # bench FIRST: it is the round-critical record and a flapping tunnel
+    # must not spend its uptime on the other phases. memory (child
+    # processes) runs BEFORE kernels (in-process jax): once the parent
+    # holds the device client, children could no longer acquire the chip
+    # on backends with exclusive ownership.
     phases = [
-        ("validate", _phase_validate, args.skip_validate),
         ("bench", _phase_bench, args.skip_bench),
+        ("validate", _phase_validate, args.skip_validate),
         ("memory", _phase_memory, args.skip_memory),
         ("kernels", _phase_kernels, args.skip_kernels),
     ]

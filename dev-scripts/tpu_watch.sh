@@ -4,7 +4,7 @@
 # and measurements are wanted as soon as it returns.
 #   dev-scripts/tpu_watch.sh [session args...]
 cd "$(dirname "$0")/.."
-for i in $(seq 1 200); do
+for i in $(seq 1 "${TPU_WATCH_PROBES:-200}"); do
   if timeout 120 python -c "import jax, jax.numpy as jnp; jax.block_until_ready(jnp.arange(4).sum())" >/dev/null 2>&1; then
     echo "tunnel up after probe $i; starting measurement session" >&2
     exec python dev-scripts/tpu_session.py "$@"
