@@ -1,0 +1,121 @@
+"""Summarize TPU_MEASUREMENTS.json into decisions.
+
+    python dev-scripts/analyze_session.py [--in TPU_MEASUREMENTS.json]
+
+Prints, for the latest measurement session: the bench headline and ratios,
+the kernel roofline (chained vs dispatch, pct of peak, binding), the
+tile-height A/B verdict (should PHOTON_FUSED_TILE_U change?), the measured
+spill-cost calibration (should PHOTON_SPILL_SLOT_COST change?), the
+memory-envelope table against docs/SCALING.md's predictions, and the bf16
+win-or-cut evidence. Pure reporting — no repo mutations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fmt_bytes(b):
+    if b is None:
+        return "n/a"
+    return f"{b / 2**30:.2f} GiB"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--in", dest="path",
+                    default=os.path.join(REPO, "TPU_MEASUREMENTS.json"))
+    args = ap.parse_args()
+    with open(args.path) as f:
+        d = json.load(f)
+
+    print("== bench ==")
+    b = d.get("bench", {})
+    if b:
+        print(f"headline {b.get('headline_workload')}: "
+              f"{b.get('value'):,} {b.get('unit', '')}")
+        print(f"vs_baseline {b.get('vs_baseline')} "
+              f"(pinned {b.get('vs_baseline_pinned')}, "
+              f"fresh {b.get('vs_baseline_fresh')})")
+        print(f"time-to-AUC {b.get('wallclock_to_auc_s')}s "
+              f"(target {b.get('auc_target')}, final {b.get('auc_final')}; "
+              f"trace {b.get('auc_trace')})")
+        print(f"smalldim {b.get('smalldim_passes_per_s')} passes/s, "
+              f"engines {b.get('engines')}")
+        if b.get("stale"):
+            print("!! STALE replay — no live measurement this session")
+
+    def _ms(e, key):
+        return f"{e[key] * 1e3:.2f}ms" if key in e else "n/a"
+
+    print("\n== kernels (chained = dispatch excluded) ==")
+    kern = d.get("kernels", {})
+    base = kern.get("fused", {})
+    for name, e in kern.items():
+        if not isinstance(e, dict) or name.startswith("fused_u"):
+            continue  # tile-cap variants are reported in the A/B below
+        if "error" in e:
+            print(f"{name}: ERROR {e['error'][:90]}")
+            continue
+        print(f"{name}: matvec {_ms(e, 'matvec_s')} "
+              f"(1-call {_ms(e, 'matvec_dispatch_s')}), "
+              f"rmatvec {_ms(e, 'rmatvec_s')}, "
+              f"eval {_ms(e, 'objective_eval_s')}, "
+              f"{e.get('pct_of_peak_matvec')}%/{e.get('pct_of_peak_rmatvec')}%"
+              f" of peak")
+        if e.get("binding"):
+            print(f"   binding: {e['binding']}")
+    for cap in (32, 64):
+        v = kern.get(f"fused_u{cap}", {})
+        if "matvec_s" in v and "matvec_s" in base:
+            speed = base["matvec_s"] / v["matvec_s"]
+            verdict = "WINS" if speed > 1.05 else (
+                "ties" if speed > 0.95 else "LOSES")
+            print(f"tile cap u{cap}: {speed:.2f}x vs default -> {verdict}"
+                  + ("  => set PHOTON_FUSED_TILE_U and re-run bench"
+                     if speed > 1.05 else ""))
+
+    cal = d.get("spill_calibration", {})
+    if cal and "error" in cal:
+        print(f"\n== spill calibration == ERROR {cal['error'][:90]}")
+    elif cal:
+        print("\n== spill calibration ==")
+        print(f"scatter {cal.get('scatter_ns_per_entry')} ns/entry, "
+              f"routed {cal.get('routed_ns_per_slot')} ns/slot -> "
+              f"recommended PHOTON_SPILL_SLOT_COST = "
+              f"{cal.get('recommended_spill_slot_cost')} (default 32)")
+
+    mem = d.get("memory", {})
+    if mem:
+        print("\n== memory envelope (SCALING.md: history = m*2*4B/coef "
+              "dominates; 2^26 m=10 predicted ~5.4 GiB history + 0.25 GiB "
+              "w + data) ==")
+        for key, e in sorted(mem.items()):
+            if not isinstance(e, dict):
+                continue
+            if "error" in e:
+                print(f"{key}: ERROR {e['error'][:90]}")
+                continue
+            print(f"{key}: peak {_fmt_bytes(e.get('peak_bytes_in_use'))} "
+                  f"of {_fmt_bytes(e.get('bytes_limit'))}, "
+                  f"{e.get('passes_per_s'):,} passes/s, "
+                  f"solve {e.get('solve_s')}s")
+
+    eng = (d.get("bench") or {}).get("engines", {})
+    if "fused_bf16" in eng and "fused" in eng:
+        print("\n== bf16 verdict ==")
+        r = eng["fused_bf16"] / eng["fused"]
+        print(f"fused_bf16/fused = {r:.3f} -> "
+              + ("bf16 WINS the small-dim A/B" if r > 1.02 else
+                 "bf16 does not pay at small-dim"))
+
+    if d.get("recommended_auto_engine"):
+        print(f"\nrecommended auto engine: {d['recommended_auto_engine']}")
+
+
+if __name__ == "__main__":
+    main()
