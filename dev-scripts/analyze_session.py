@@ -33,11 +33,31 @@ def main() -> None:
     with open(args.path) as f:
         d = json.load(f)
 
+    def _num(v):
+        return f"{v:,}" if isinstance(v, (int, float)) else "n/a"
+
+    for phase in ("bench", "kernels", "memory", "validate"):
+        if d.get(phase + "_error"):
+            print(f"!! {phase} phase failed this session: "
+                  f"{d[phase + '_error'][:90]}")
+        if (phase + "_latest_partial" in d) or (
+            isinstance(d.get(phase), dict) and d[phase].get("error")
+            and phase + "_latest_partial" not in d
+        ):
+            print(f"!! {phase}: shown numbers may be carried from an "
+                  "EARLIER session (see measured_at_unix / "
+                  f"{phase}_latest_partial in the record)")
+
     print("== bench ==")
     b = d.get("bench", {})
+    if isinstance(b, dict) and b.get("error") and not b.get("value"):
+        print(f"ERROR {b['error'][:120]}")
+        b = {}
     if b:
         print(f"headline {b.get('headline_workload')}: "
-              f"{b.get('value'):,} {b.get('unit', '')}")
+              f"{_num(b.get('value'))} {b.get('unit', '')}"
+              + (f"  (measured_at {b.get('measured_at_unix')})"
+                 if b.get("measured_at_unix") else ""))
         print(f"vs_baseline {b.get('vs_baseline')} "
               f"(pinned {b.get('vs_baseline_pinned')}, "
               f"fresh {b.get('vs_baseline_fresh')})")
@@ -56,11 +76,13 @@ def main() -> None:
     kern = d.get("kernels", {})
     base = kern.get("fused", {})
     for name, e in kern.items():
-        if not isinstance(e, dict) or name.startswith("fused_u"):
-            continue  # tile-cap variants are reported in the A/B below
+        if not isinstance(e, dict):
+            continue
         if "error" in e:
             print(f"{name}: ERROR {e['error'][:90]}")
             continue
+        if name.startswith("fused_u"):
+            continue  # healthy tile-cap variants report in the A/B below
         print(f"{name}: matvec {_ms(e, 'matvec_s')} "
               f"(1-call {_ms(e, 'matvec_dispatch_s')}), "
               f"rmatvec {_ms(e, 'rmatvec_s')}, "
@@ -102,7 +124,7 @@ def main() -> None:
                 continue
             print(f"{key}: peak {_fmt_bytes(e.get('peak_bytes_in_use'))} "
                   f"of {_fmt_bytes(e.get('bytes_limit'))}, "
-                  f"{e.get('passes_per_s'):,} passes/s, "
+                  f"{_num(e.get('passes_per_s'))} passes/s, "
                   f"solve {e.get('solve_s')}s")
 
     eng = (d.get("bench") or {}).get("engines", {})

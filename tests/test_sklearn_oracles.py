@@ -1,0 +1,139 @@
+"""Extra external-oracle gates against sklearn (the same assumed-correct-
+implementation discipline as tests/test_oracle.py, reference
+DriverTest.scala:84-85): the GP posterior math and the weighted-AUC
+evaluator are checked value-for-value against independent sklearn
+implementations of the identical definitions."""
+
+import numpy as np
+import pytest
+
+
+class TestGpPosteriorOracle:
+    """GaussianProcessModel (GPML Alg 2.1, hyperparameter/gp.py) vs
+    sklearn.gaussian_process with a FIXED kernel (no hyperparameter
+    sampling on either side): posterior mean and variance must agree to
+    float tolerance."""
+
+    def _problem(self, seed=0, n=24, d=3, nq=17):
+        rng = np.random.default_rng(seed)
+        x = rng.uniform(-2, 2, (n, d))
+        y = np.sin(x).sum(axis=1) + 0.05 * rng.standard_normal(n)
+        xq = rng.uniform(-2, 2, (nq, d))
+        return x, y, xq
+
+    @pytest.mark.parametrize("ls", [0.7, 1.5])
+    def test_matern52_posterior_matches_sklearn(self, ls):
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        from photon_ml_tpu.hyperparameter.gp import (
+            _JITTER,
+            GaussianProcessModel,
+        )
+        from photon_ml_tpu.hyperparameter.kernels import Matern52
+
+        x, y, xq = self._problem()
+        ours = GaussianProcessModel(
+            x, y, y_mean=0.0, kernels=[Matern52(length_scale=np.array([ls]))]
+        )
+        mean, var = ours.predict(xq)
+
+        sk = GaussianProcessRegressor(
+            kernel=Matern(length_scale=ls, nu=2.5),
+            alpha=_JITTER, optimizer=None,
+        ).fit(x, y)
+        sk_mean, sk_std = sk.predict(xq, return_std=True)
+        np.testing.assert_allclose(mean, sk_mean, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(var, sk_std**2, rtol=1e-4, atol=1e-8)
+
+    def test_rbf_posterior_matches_sklearn(self):
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import RBF as SkRBF
+
+        from photon_ml_tpu.hyperparameter.gp import (
+            _JITTER,
+            GaussianProcessModel,
+        )
+        from photon_ml_tpu.hyperparameter.kernels import RBF
+
+        x, y, xq = self._problem(seed=3)
+        ours = GaussianProcessModel(
+            x, y, y_mean=0.0, kernels=[RBF(length_scale=np.array([1.1]))]
+        )
+        mean, var = ours.predict(xq)
+        sk = GaussianProcessRegressor(
+            kernel=SkRBF(length_scale=1.1), alpha=_JITTER, optimizer=None,
+        ).fit(x, y)
+        sk_mean, sk_std = sk.predict(xq, return_std=True)
+        np.testing.assert_allclose(mean, sk_mean, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(var, sk_std**2, rtol=1e-4, atol=1e-8)
+
+    def test_anisotropic_matern_matches_sklearn(self):
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        from photon_ml_tpu.hyperparameter.gp import (
+            _JITTER,
+            GaussianProcessModel,
+        )
+        from photon_ml_tpu.hyperparameter.kernels import Matern52
+
+        x, y, xq = self._problem(seed=5)
+        ls = np.array([0.6, 1.3, 2.2])
+        ours = GaussianProcessModel(
+            x, y, y_mean=0.0, kernels=[Matern52(length_scale=ls)]
+        )
+        mean, var = ours.predict(xq)
+        sk = GaussianProcessRegressor(
+            kernel=Matern(length_scale=ls, nu=2.5),
+            alpha=_JITTER, optimizer=None,
+        ).fit(x, y)
+        sk_mean, sk_std = sk.predict(xq, return_std=True)
+        np.testing.assert_allclose(mean, sk_mean, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(var, sk_std**2, rtol=1e-4, atol=1e-8)
+
+
+class TestAucSklearnOracle:
+    """Both AUC implementations (the on-device rank-sum and its numpy
+    twin) vs sklearn.metrics.roc_auc_score, including ties and sample
+    weights (evaluation/evaluators.py AUC semantics)."""
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_weighted_auc_matches_sklearn(self, seed):
+        from sklearn.metrics import roc_auc_score
+
+        from photon_ml_tpu.evaluation.evaluators import (
+            _np_auc,
+            area_under_roc_curve,
+        )
+
+        rng = np.random.default_rng(seed)
+        n = 500
+        y = (rng.random(n) < 0.4).astype(np.float32)
+        # quantized scores force tie groups
+        s = np.round(rng.standard_normal(n), 1).astype(np.float32)
+        w = rng.uniform(0.1, 3.0, n).astype(np.float32)
+        ref = roc_auc_score(y, s, sample_weight=w)
+        np.testing.assert_allclose(_np_auc(s, y, w), ref, atol=1e-6)
+        np.testing.assert_allclose(
+            float(area_under_roc_curve(s, y, w)), ref, atol=1e-5
+        )
+
+    def test_unweighted_auc_matches_sklearn(self):
+        from sklearn.metrics import roc_auc_score
+
+        from photon_ml_tpu.evaluation.evaluators import (
+            _np_auc,
+            area_under_roc_curve,
+        )
+
+        rng = np.random.default_rng(2)
+        n = 400
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        s = rng.standard_normal(n).astype(np.float32)
+        w = np.ones(n, np.float32)
+        ref = roc_auc_score(y, s)
+        np.testing.assert_allclose(_np_auc(s, y, w), ref, atol=1e-6)
+        np.testing.assert_allclose(
+            float(area_under_roc_curve(s, y, w)), ref, atol=1e-5
+        )
