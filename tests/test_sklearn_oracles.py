@@ -93,6 +93,86 @@ class TestGpPosteriorOracle:
         np.testing.assert_allclose(var, sk_std**2, rtol=1e-4, atol=1e-8)
 
 
+class TestKendallTauScipyOracle:
+    """diagnostics/independence.py's tau-beta vs scipy.stats.kendalltau
+    (the standard tie-corrected tau-b), with and without ties."""
+
+    def test_continuous_no_ties(self):
+        from scipy.stats import kendalltau
+
+        from photon_ml_tpu.diagnostics.independence import (
+            kendall_tau_analysis,
+        )
+
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal(300)
+        b = 0.6 * a + 0.8 * rng.standard_normal(300)
+        rep = kendall_tau_analysis(a, b)
+        ref = kendalltau(a, b)
+        np.testing.assert_allclose(rep.tau_beta, ref.statistic, atol=1e-12)
+        # without ties tau-alpha == tau-beta
+        np.testing.assert_allclose(rep.tau_alpha, ref.statistic, atol=1e-12)
+
+    def test_heavy_ties(self):
+        from scipy.stats import kendalltau
+
+        from photon_ml_tpu.diagnostics.independence import (
+            kendall_tau_analysis,
+        )
+
+        rng = np.random.default_rng(1)
+        a = rng.integers(0, 5, 400).astype(float)
+        b = (a + rng.integers(0, 3, 400)).astype(float)
+        rep = kendall_tau_analysis(a, b)
+        ref = kendalltau(a, b)  # scipy default is tau-b
+        np.testing.assert_allclose(rep.tau_beta, ref.statistic, atol=1e-12)
+
+
+class TestPrCurveSklearnOracle:
+    """diagnostics/evaluation.py's PR sweep vs
+    sklearn.metrics.precision_recall_curve: the (precision, recall) points
+    at each distinct threshold must coincide (our PR-AUC then integrates
+    them with MLlib trapezoid semantics, which sklearn's
+    average_precision deliberately does not — the POINTS are the
+    comparable object). Peak F1 is additionally checked against a
+    brute-force sklearn f1_score sweep."""
+
+    def test_pr_points_match_sklearn(self):
+        from sklearn.metrics import precision_recall_curve
+
+        from photon_ml_tpu.diagnostics.evaluation import (
+            _precision_recall_points,
+        )
+
+        rng = np.random.default_rng(3)
+        n = 300
+        y = (rng.random(n) < 0.35).astype(np.float64)
+        s = np.round(rng.standard_normal(n), 1)  # ties
+        p_ours, r_ours = _precision_recall_points(s, y, None)
+        p_sk, r_sk, thr = precision_recall_curve(y, s)
+        # sklearn returns ascending thresholds + a final (1, 0) anchor;
+        # ours returns descending distinct thresholds. Reverse and drop
+        # sklearn's anchor to align.
+        p_sk, r_sk = p_sk[:-1][::-1], r_sk[:-1][::-1]
+        np.testing.assert_allclose(p_ours, p_sk, atol=1e-12)
+        np.testing.assert_allclose(r_ours, r_sk, atol=1e-12)
+
+    def test_peak_f1_matches_brute_force(self):
+        from sklearn.metrics import f1_score
+
+        from photon_ml_tpu.diagnostics.evaluation import peak_f1
+
+        rng = np.random.default_rng(4)
+        n = 200
+        y = (rng.random(n) < 0.4).astype(np.float64)
+        s = rng.standard_normal(n)
+        ours = peak_f1(s, y, None)
+        best = max(
+            f1_score(y, (s >= t).astype(int)) for t in np.unique(s)
+        )
+        np.testing.assert_allclose(ours, best, atol=1e-12)
+
+
 class TestAucSklearnOracle:
     """Both AUC implementations (the on-device rank-sum and its numpy
     twin) vs sklearn.metrics.roc_auc_score, including ties and sample
