@@ -1,0 +1,138 @@
+"""Property-based invariants (hypothesis) for the sparse routing engines,
+the layout planner, and the Avro varint codec — arbitrary small inputs
+rather than fixed seeds, complementing the randomized cases in
+test_benes.py/test_fused_perm.py."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+pytestmark = pytest.mark.slow  # many small engine builds per test
+
+
+coo_shapes = st.tuples(
+    st.integers(min_value=1, max_value=96),   # rows
+    st.integers(min_value=1, max_value=64),   # cols
+    st.integers(min_value=0, max_value=400),  # nnz draws (pre-coalesce)
+)
+
+
+def _coo(draw_shape, seed):
+    n, d, m = draw_shape
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, n, m)
+    cols = rng.integers(0, d, m)
+    vals = rng.standard_normal(m).astype(np.float32)
+    dense = np.zeros((n, d), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    return rows, cols, vals, dense
+
+
+class TestEngineProperties:
+    @settings(max_examples=20, deadline=None)
+    @given(
+        engine=st.sampled_from(["benes", "fused"]),
+        shape=coo_shapes,
+        seed=st.integers(0, 2**31),
+    )
+    def test_routed_maps_match_dense(self, engine, shape, seed):
+        """For ANY coo pattern (duplicates, empty rows/cols, hot columns,
+        degenerate shapes), both routed engines' matvec/rmatvec equal the
+        dense reference (the fused builder exercises its CPU fallback +
+        pow2 slot groups + auto layout)."""
+        from photon_ml_tpu.ops import fused_perm, sparse_perm
+
+        builder = (
+            sparse_perm.from_coo if engine == "benes" else fused_perm.from_coo
+        )
+        rows, cols, vals, dense = _coo(shape, seed)
+        n, d = dense.shape
+        feats = builder(rows, cols, vals, (n, d), plan_cache="")
+        rng = np.random.default_rng(seed + 1)
+        w = rng.standard_normal(d).astype(np.float32)
+        c = rng.standard_normal(n).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(feats.matvec(w)), dense @ w, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(feats.rmatvec(c)), dense.T @ c, atol=2e-4
+        )
+
+
+class TestPlannerProperties:
+    @settings(max_examples=60, deadline=None)
+    @given(
+        n=st.integers(1, 1 << 16),
+        d=st.integers(1, 1 << 18),
+        k=st.integers(1, 64),
+        seed=st.integers(0, 2**31),
+        lam=st.floats(0.05, 8.0),
+    )
+    def test_plan_always_legal_and_never_worse_than_flat(
+        self, n, d, k, seed, lam
+    ):
+        """For any column-degree profile: the cap is a power of two below
+        kp_full (or None), the block count is a power of two within the
+        search bound, spill respects the nnz/8 bound, and the planned
+        slots+spill cost never exceeds the flat layout's."""
+        from photon_ml_tpu.ops import routing
+        from photon_ml_tpu.ops.sparse_perm import (
+            _spill_slot_cost,
+            plan_column_layout,
+        )
+
+        rng = np.random.default_rng(seed)
+        cc = rng.poisson(lam, d).astype(np.int64)
+        nnz = int(cc.sum())
+        if not nnz:
+            return
+        kp_full = int(cc.max())
+        cap, t = plan_column_layout(cc, n, d, k, kp_full)
+        assert t >= 1 and (t & (t - 1)) == 0 and t <= 16
+        if cap is not None:
+            assert cap < kp_full and (cap & (cap - 1)) == 0
+            spill = int(np.maximum(cc - cap, 0).sum())
+            assert spill <= max(nnz // 8, 4096)
+        eff = cap if cap is not None else kp_full
+        spill = int(np.maximum(cc - eff, 0).sum())
+        total = t * routing.valid_size(max(n * k, -(-d // t) * eff, 1)) \
+            + spill * _spill_slot_cost()
+        flat = routing.valid_size(max(n * k, d * kp_full, 1))
+        assert total <= flat or (cap is None and t == 1)
+
+
+class TestValidSizeProperties:
+    @settings(max_examples=200, deadline=None)
+    @given(n=st.integers(1, 1 << 34))
+    def test_valid_size_on_ladder_and_minimal(self, n):
+        from photon_ml_tpu.ops.routing import valid_size
+
+        s = valid_size(n)
+        assert s >= n
+        # on the ladder: s = c * 128^(m+1), c in {1,2,4,8}
+        m = s
+        while m % 128 == 0:
+            m //= 128
+        assert m in (1, 2, 4, 8), s
+        # minimal: the next-smaller ladder value is below n (128 is the
+        # ladder floor — nothing below it to compare)
+        if s > 128:
+            smaller = s // 2 if m in (2, 4, 8) else s * 8 // 128
+            assert smaller < n, (n, s, smaller)
+
+
+class TestAvroVarintProperties:
+    @settings(max_examples=300, deadline=None)
+    @given(v=st.integers(-(2**63), 2**63 - 1))
+    def test_long_zigzag_roundtrip(self, v):
+        """The in-tree codec's zigzag varint encode/decode are inverse
+        over the full int64 range (io/avro.py _write_long / read_long)."""
+        import io
+
+        from photon_ml_tpu.io.avro import _Reader, _write_long
+
+        out = io.BytesIO()
+        _write_long(out, v)
+        r = _Reader(out.getvalue())
+        assert r.read_long() == v
+        assert r.pos == len(out.getvalue())
