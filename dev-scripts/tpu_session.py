@@ -73,7 +73,9 @@ def _phase_validate(results: dict) -> None:
 
 
 def _phase_bench(results: dict) -> None:
-    env = dict(os.environ, BENCH_WATCHDOG_S="2400")
+    # the batched session wants the COMPLETE record, including the
+    # default-off bf16 A/B (see bench.py: default-off after the r4 verdict)
+    env = dict(os.environ, BENCH_WATCHDOG_S="2400", BENCH_BF16="1")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, timeout=2700, env=env,
