@@ -620,6 +620,145 @@ def _backend_preflight(timeout_s: int = 300, watchdog_s: int = 2700) -> None:
     _emit_failure(f"backend preflight failed after {attempts} attempts: {last}")
 
 
+# ---- online serving benchmark (bench.py --serving) ----
+
+N_SRV_REQ = 400 if _SMOKE else 20_000       # replayed requests
+D_SRV_FE = 1 << (8 if _SMOKE else 14)       # fixed-effect dim
+N_SRV_ENT = 512 if _SMOKE else 100_000      # RE entities
+D_SRV_RE = 16                               # per-entity dim
+K_SRV_FE = 16                               # FE nonzeros per request
+SRV_CACHE = 128 if _SMOKE else 4096         # hot-entity cache rows
+SRV_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+_SERVING_PATH = os.path.join(_REPO, "BENCH_SERVING.json")
+
+
+def _serving_bench():
+    """Replay a synthetic GLMix request stream through the serving stack.
+
+    The workload models the production shape: a dense FE prior, one RE
+    coordinate with a heavy-tailed (Zipf) entity popularity so the
+    hot-entity cache sees realistic hit rates, and requests microbatched
+    into power-of-two buckets. Emits ONE JSON line and writes
+    BENCH_SERVING.json; an exception emits an error line instead (never a
+    bare traceback — same contract as the training bench)."""
+    import sys
+
+    try:
+        import jax
+
+        if _SMOKE:
+            jax.config.update("jax_platforms", "cpu")
+        from photon_ml_tpu.indexmap import DefaultIndexMap
+        from photon_ml_tpu.serving import (
+            GameScorer,
+            ServingArtifact,
+            ServingTable,
+            replay_requests,
+        )
+        from photon_ml_tpu.serving.scorer import ScoreRequest
+        from photon_ml_tpu.types import TaskType
+
+        rng = np.random.default_rng(SEED)
+        fe_w = (rng.standard_normal(D_SRV_FE) * 0.1).astype(np.float32)
+        re_table = (
+            rng.standard_normal((N_SRV_ENT, D_SRV_RE)) * 0.3
+        ).astype(np.float32)
+        artifact = ServingArtifact(
+            task=TaskType.LOGISTIC_REGRESSION,
+            tables={
+                "fixed": ServingTable(
+                    feature_shard="global", random_effect_type=None,
+                    weights=fe_w,
+                ),
+                "per_user": ServingTable(
+                    feature_shard="per_user", random_effect_type="userId",
+                    weights=re_table,
+                    entity_index=DefaultIndexMap(
+                        {f"u{i}": i for i in range(N_SRV_ENT)}
+                    ),
+                ),
+            },
+            model_name="serving-bench",
+        )
+
+        # Zipf entity popularity (~2% of entities take most traffic): the
+        # regime the LRU cache is built for
+        ent = (rng.zipf(1.3, N_SRV_REQ) - 1) % N_SRV_ENT
+        fe_idx = rng.integers(0, D_SRV_FE, (N_SRV_REQ, K_SRV_FE))
+        fe_val = rng.standard_normal((N_SRV_REQ, K_SRV_FE)).astype(np.float32)
+        re_val = rng.standard_normal((N_SRV_REQ, D_SRV_RE)).astype(np.float32)
+        requests = [
+            ScoreRequest(
+                request_id=f"r{i}",
+                features={
+                    "global": {
+                        int(c): float(v)
+                        for c, v in zip(fe_idx[i], fe_val[i])
+                    },
+                    "per_user": {
+                        j: float(re_val[i, j]) for j in range(D_SRV_RE)
+                    },
+                },
+                entity_ids={"userId": f"u{ent[i]}"},
+            )
+            for i in range(N_SRV_REQ)
+        ]
+
+        scorer = GameScorer(
+            artifact,
+            max_nnz={"global": K_SRV_FE, "per_user": D_SRV_RE},
+            cache_capacity=SRV_CACHE,
+        )
+        # warmup: compile every bucket once outside the timed replay (the
+        # steady-state latency is the serving number; cold compiles are a
+        # deploy-time cost)
+        for b in SRV_BUCKETS:
+            scorer.score_batch(requests[:b], bucket_size=b)
+        warm_compiles = scorer.compile_count
+        for cache in scorer.caches.values():
+            # keep the warmed rows, drop the warmup's hit/miss accounting
+            cache.hits = cache.misses = cache.evictions = cache.cold = 0
+
+        _, snapshot = replay_requests(
+            scorer, requests, bucket_sizes=SRV_BUCKETS,
+            model_id="serving-bench",
+        )
+        payload = {
+            "metric": "serving_p99_latency_s",
+            "value": snapshot.get("latency_p99_s", 0.0),
+            "unit": "seconds",
+            "requests_per_s": snapshot.get("replay_requests_per_s", 0.0),
+            "num_requests": N_SRV_REQ,
+            "n_entities": N_SRV_ENT,
+            "cache_capacity": SRV_CACHE,
+            "bucket_sizes": list(SRV_BUCKETS),
+            "warm_compiles": warm_compiles,
+            "post_replay_compiles": scorer.compile_count,
+            "backend": jax.default_backend(),
+            **{
+                k: snapshot[k]
+                for k in (
+                    "latency_p50_s", "latency_p95_s", "latency_p99_s",
+                    "batch_fill_ratio", "cache_hit_rate",
+                    "replay_requests_per_s",
+                )
+                if k in snapshot
+            },
+        }
+        if "caches" in snapshot:
+            payload["cache_stats"] = snapshot["caches"]
+        print(json.dumps(payload))
+        if not _SMOKE or _env_flag("BENCH_SERVING_WRITE"):
+            with open(_SERVING_PATH, "w") as f:
+                json.dump(payload, f, indent=2)
+    except Exception as e:  # noqa: BLE001 - one JSON line per exit path
+        print(json.dumps({
+            "metric": "serving_p99_latency_s",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
+
+
 def main():
     """Every exit path emits one JSON line: an uncaught exception anywhere
     (e.g. the tunnel dying mid-phase with the headline already measured)
@@ -653,7 +792,18 @@ def _main():
         "--skip-smalldim", action="store_true",
         help="skip the small-dim FE+RE engine A/B extras",
     )
+    ap.add_argument(
+        "--serving", action="store_true",
+        help="run the online-serving benchmark instead of the training "
+             "bench: replay a synthetic request stream through the "
+             "microbatcher + hot-entity cache, report p99 latency and "
+             "sustained requests/sec, and write BENCH_SERVING.json",
+    )
     args = ap.parse_args()
+
+    if args.serving:
+        _serving_bench()
+        return
 
     watchdog_s = int(os.environ.get("BENCH_WATCHDOG_S", "2700"))
     _arm_watchdog(watchdog_s)

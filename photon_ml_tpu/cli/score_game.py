@@ -80,6 +80,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="JSON map overriding input field names; keys: "
                         "response, offset, weight, uid (reference "
                         "InputColumnsNames)")
+    p.add_argument("--missing-entity-policy", choices=("fe-only", "error"),
+                   default="fe-only",
+                   help="rows naming entities absent from the model: "
+                        "'fe-only' (default) scores them with the fixed "
+                        "effects only (RE contribution 0, the reference "
+                        "left-join semantics, same fallback the serving "
+                        "path uses); 'error' fails fast instead")
     p.add_argument("--log-data-and-model-stats", action="store_true",
                    help="log dataset stats (rows, per-id-tag entity counts "
                         "and samples-per-entity) and per-coordinate model "
@@ -117,6 +124,40 @@ def _log_data_and_model_stats(logger, data, model, id_tags) -> None:
             )
         else:
             logger.info("model stats [%s]: %s", cid, type(sub).__name__)
+
+
+def _check_missing_entities(model, data) -> None:
+    """--missing-entity-policy=error: fail fast when the dataset names
+    random-effect entities the model has never seen (the default scores
+    those rows FE-only, exactly like the online serving fallback)."""
+    from photon_ml_tpu.algorithm.factored_random_effect import (
+        FactoredRandomEffectModel,
+    )
+
+    problems = []
+    for cid, sub in model.models.items():
+        re_type = model.meta[cid].random_effect_type
+        if not re_type:
+            continue
+        loc = (
+            sub.latent.entity_to_loc
+            if isinstance(sub, FactoredRandomEffectModel)
+            else sub.entity_to_loc
+        )
+        ids = data.id_tags.get(re_type)
+        if ids is None:
+            continue
+        missing = sorted({str(e) for e in ids if str(e) not in loc})
+        if missing:
+            problems.append(
+                f"[{cid}] {len(missing)} unknown {re_type!r} entities "
+                f"(e.g. {missing[:5]})"
+            )
+    if problems:
+        raise ValueError(
+            "--missing-entity-policy=error: the dataset references "
+            "entities absent from the model: " + "; ".join(problems)
+        )
 
 
 def run(args: argparse.Namespace) -> Optional[float]:
@@ -201,6 +242,9 @@ def run(args: argparse.Namespace) -> Optional[float]:
 
     if args.log_data_and_model_stats:
         _log_data_and_model_stats(logger, data, model, id_tags)
+
+    if args.missing_entity_policy == "error":
+        _check_missing_entities(model, data)
 
     with timer.time("score"):
         scores = model.score(data) + data.offsets

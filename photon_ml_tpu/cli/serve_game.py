@@ -1,0 +1,219 @@
+"""GAME online serving driver: export a serving artifact and replay a
+request stream against it.
+
+The offline driver (``score_game``) reloads the Avro model and scores a
+static dataset in one pass; this driver exercises the *online* path: the
+model is packed into a serving artifact (dense FE coefficients +
+contiguous per-entity RE tables behind off-heap entity indexes), requests
+are drawn row-by-row from a scoring dataset, coalesced by the microbatcher
+into fixed-bucket jit'd batches, and scored through the hot-entity cache.
+Prints a one-line JSON metrics report (latency percentiles, sustained
+request rate, batch fill, cache hit rate, XLA compile count).
+
+Usage:
+    # pack a trained model and serve a replayed stream
+    python -m photon_ml_tpu.cli.serve_game \
+        --model-dir out/best --data-dirs data/test \
+        --export-artifact-dir out/artifact --max-requests 10000
+
+    # serve from a previously exported artifact
+    python -m photon_ml_tpu.cli.serve_game \
+        --artifact-dir out/artifact --data-dirs data/test
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from photon_ml_tpu.cli.common import parse_input_columns, setup_logger
+from photon_ml_tpu.utils.timer import Timer
+
+DEFAULT_BUCKETS = "1,2,4,8,16,32"
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu serve-game", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model-dir",
+                     help="trained GAME model directory to pack on the fly")
+    src.add_argument("--artifact-dir",
+                     help="previously exported serving artifact directory")
+    p.add_argument("--data-dirs", nargs="+", default=None,
+                   help="scoring dataset dirs replayed as the request stream")
+    p.add_argument("--export-artifact-dir", default=None,
+                   help="write the packed serving artifact here "
+                        "(with --model-dir; train → export → serve)")
+    p.add_argument("--bucket-sizes", default=DEFAULT_BUCKETS,
+                   help="comma-separated microbatch bucket sizes "
+                        f"(default {DEFAULT_BUCKETS}); XLA compiles once "
+                        "per bucket")
+    p.add_argument("--cache-capacity", type=int, default=None,
+                   help="hot-entity cache rows per RE coordinate (default: "
+                        "full tables device-resident, no cache)")
+    p.add_argument("--max-requests", type=int, default=None,
+                   help="replay at most this many rows")
+    p.add_argument("--max-nnz", type=int, default=None,
+                   help="padded nonzeros per shard (default: tight "
+                        "power-of-two fit to the request stream)")
+    p.add_argument("--metrics-output", default=None,
+                   help="also write the metrics snapshot JSON to this file")
+    p.add_argument("--model-id", default=None,
+                   help="model id stamped on scoring events")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   help="dotted class paths registered on the event emitter")
+    p.add_argument("--input-columns-names", default=None,
+                   help="JSON map overriding input field names")
+    p.add_argument("--log-file", default=None)
+    return p.parse_args(argv)
+
+
+def _load_or_pack(args, logger, timer):
+    from photon_ml_tpu.serving import load_artifact, pack_game_model
+
+    if args.artifact_dir:
+        with timer.time("load artifact"):
+            artifact = load_artifact(args.artifact_dir)
+        logger.info(
+            "loaded artifact: %d coordinates, %s entities",
+            len(artifact.tables),
+            sum(t.n_entities for t in artifact.tables.values()),
+        )
+        return artifact
+
+    from photon_ml_tpu.io.model_io import (
+        load_game_model,
+        load_game_model_metadata,
+    )
+
+    metadata = load_game_model_metadata(args.model_dir)
+    with timer.time("load model"):
+        model, index_maps = load_game_model(args.model_dir)
+    with timer.time("pack artifact"):
+        artifact = pack_game_model(
+            model,
+            index_maps=index_maps,
+            model_name=metadata.get("modelName", "game-model"),
+            configurations=metadata.get("configurations") or {},
+        )
+    return artifact
+
+
+def run(args: argparse.Namespace) -> Optional[dict]:
+    logger = setup_logger(args.log_file)
+    timer = Timer()
+
+    bucket_sizes = tuple(
+        int(b) for b in str(args.bucket_sizes).split(",") if b.strip()
+    )
+
+    artifact = _load_or_pack(args, logger, timer)
+    model_id = args.model_id or artifact.model_name
+
+    if args.export_artifact_dir:
+        from photon_ml_tpu.serving import save_artifact
+
+        with timer.time("export artifact"):
+            save_artifact(artifact, args.export_artifact_dir)
+        logger.info("exported serving artifact to %s", args.export_artifact_dir)
+
+    snapshot: Optional[dict] = None
+    if args.data_dirs:
+        from photon_ml_tpu.event import EventEmitter
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_game_data,
+        )
+        from photon_ml_tpu.serving import GameScorer, replay_requests
+        from photon_ml_tpu.serving.replay import (
+            max_nnz_of,
+            requests_from_game_data,
+        )
+
+        shard_bags = {}
+        for sid, s in (
+            (artifact.configurations.get("feature_shards") or {}).items()
+        ):
+            shard_bags[sid] = FeatureShardConfiguration(
+                feature_bags=s["feature_bags"],
+                add_intercept=bool(s.get("add_intercept", True)),
+            )
+        for sid in artifact.shard_dims():
+            shard_bags.setdefault(
+                sid, FeatureShardConfiguration(feature_bags=[sid])
+            )
+        index_maps = dict(artifact.feature_index) or None
+        if index_maps is None:
+            logger.warning(
+                "artifact carries no feature index maps; indexes will be "
+                "rebuilt from the request data and may not match the model"
+            )
+        col_names = parse_input_columns(args.input_columns_names)
+        with timer.time("read data"):
+            data, _, uids = read_game_data(
+                args.data_dirs,
+                {
+                    sid: cfg for sid, cfg in shard_bags.items()
+                    if sid in artifact.shard_dims()
+                },
+                index_maps,
+                id_tags=artifact.random_effect_types(),
+                is_response_required=False,
+                **col_names,
+            )
+        with timer.time("build requests"):
+            requests = requests_from_game_data(
+                data, artifact, uids=uids, max_requests=args.max_requests
+            )
+        logger.info("replaying %d requests", len(requests))
+
+        emitter = EventEmitter()
+        for name in args.event_listeners:
+            emitter.register_listener_class(name)
+
+        scorer = GameScorer(
+            artifact,
+            max_nnz=args.max_nnz if args.max_nnz else max_nnz_of(requests),
+            cache_capacity=args.cache_capacity,
+        )
+        with timer.time("replay"):
+            results, snapshot = replay_requests(
+                scorer, requests,
+                bucket_sizes=bucket_sizes,
+                emitter=emitter,
+                model_id=model_id,
+            )
+        emitter.clear_listeners()
+
+        snapshot["model_id"] = model_id
+        snapshot["bucket_sizes"] = list(bucket_sizes)
+        if args.metrics_output:
+            with open(args.metrics_output, "w") as f:
+                json.dump(snapshot, f, indent=2)
+        print(json.dumps(snapshot))
+
+    for name, seconds in timer.durations.items():
+        logger.info("timing %-20s %.3fs", name, seconds)
+    return snapshot
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if not args.data_dirs and not args.export_artifact_dir:
+        print(
+            "nothing to do: pass --data-dirs to serve and/or "
+            "--export-artifact-dir to export",
+            file=sys.stderr,
+        )
+        return 2
+    run(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

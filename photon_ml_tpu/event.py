@@ -57,6 +57,24 @@ class TrainingFinishEvent(Event):
     wall_seconds: float
 
 
+@dataclasses.dataclass(frozen=True)
+class ScoringStartEvent(Event):
+    """Online/offline scoring phase entered (serving replay, serve CLI)."""
+
+    model_id: str
+    num_requests: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoringFinishEvent(Event):
+    """Scoring phase finished; carries the serving metrics snapshot."""
+
+    model_id: str
+    num_requests: int
+    wall_seconds: float
+    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
 class EventListener:
     """Receives every event from an emitter (EventListener.scala)."""
 
@@ -85,7 +103,20 @@ class EventEmitter:
         module_name, _, class_name = dotted_name.rpartition(".")
         if not module_name:
             raise ValueError(f"listener name must be dotted path, got {dotted_name!r}")
-        cls = getattr(importlib.import_module(module_name), class_name)
+        try:
+            module = importlib.import_module(module_name)
+        except ImportError as e:
+            raise ValueError(
+                f"cannot register event listener {dotted_name!r}: module "
+                f"{module_name!r} failed to import ({e})"
+            ) from e
+        try:
+            cls = getattr(module, class_name)
+        except AttributeError:
+            raise ValueError(
+                f"cannot register event listener {dotted_name!r}: module "
+                f"{module_name!r} has no attribute {class_name!r}"
+            ) from None
         self.register_listener(cls())
 
     def send_event(self, event: Event) -> None:

@@ -1,0 +1,55 @@
+"""Online serving subsystem: score individual requests against a trained
+GAME model at low latency.
+
+The offline path (``cli/score_game.py``) reloads the Avro model and scores a
+static dataset in one pass; this package is the other half of the stack —
+the Photon-ML GLMix design (fixed-effect prior + per-entity random-effect
+corrections) was built for per-member online serving, and the pieces here
+map onto that design:
+
+- :mod:`photon_ml_tpu.serving.artifact` — pack a trained ``GameModel`` into
+  a serving artifact: dense FE coefficient arrays plus per-coordinate RE
+  coefficient tables as contiguous ``(n_entities, dim)`` matrices behind an
+  entity-id → row off-heap index (the PHIX store from ``indexmap/offheap``).
+- :mod:`photon_ml_tpu.serving.scorer` — a jit'd fixed-shape score function:
+  ``mean(x·β_FE + Σ_re x·β_RE[entity])`` with gathered RE rows; cold
+  entities degrade to the FE-only score (RE prior mean = 0).
+- :mod:`photon_ml_tpu.serving.batcher` — a microbatcher coalescing
+  ``ScoreRequest``s into padded batches drawn from a small set of bucket
+  sizes, so XLA compiles once per bucket and never per request.
+- :mod:`photon_ml_tpu.serving.cache` — an LRU device-resident cache of hot
+  RE coefficient rows over a host-side backing store.
+- :mod:`photon_ml_tpu.serving.metrics` — latency percentiles, queue depth,
+  batch fill ratio and cache hit rate as a dict snapshot.
+- :mod:`photon_ml_tpu.serving.replay` — turn a scoring dataset into a
+  request stream and pump it through the batcher (CLI + bench driver).
+"""
+
+from photon_ml_tpu.serving.artifact import (
+    ServingArtifact,
+    ServingTable,
+    load_artifact,
+    pack_game_model,
+    save_artifact,
+)
+from photon_ml_tpu.serving.batcher import MicroBatcher
+from photon_ml_tpu.serving.cache import HotEntityCache
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.replay import replay_requests, requests_from_game_data
+from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
+
+__all__ = [
+    "GameScorer",
+    "HotEntityCache",
+    "MicroBatcher",
+    "ScoreRequest",
+    "ScoreResult",
+    "ServingArtifact",
+    "ServingMetrics",
+    "ServingTable",
+    "load_artifact",
+    "pack_game_model",
+    "replay_requests",
+    "requests_from_game_data",
+    "save_artifact",
+]

@@ -1,0 +1,341 @@
+"""Serving artifact: a trained GAME model packed for the online score path.
+
+The training-side ``GameModel`` stores random effects as padded per-bucket
+blocks in per-entity *local* feature space — the right layout for coordinate
+descent, the wrong one for a per-request gather. Packing materializes, per
+coordinate:
+
+- fixed effect: one dense float32 coefficient vector ``[dim]``;
+- random effect: one contiguous float32 table ``[n_entities, dim]`` of
+  global-space coefficient rows (sorted by entity id), plus an
+  entity-id → row-index map persisted as a PHIX off-heap store
+  (``indexmap/offheap``) so million-entity maps never live on the heap.
+
+The artifact directory reuses the ``io/model_io`` metadata file
+(``model-metadata.json``; task, model name, configurations) with a
+``serving`` section describing each packed coordinate:
+
+    <dir>/model-metadata.json
+    <dir>/fixed-effect/<cid>.npy
+    <dir>/random-effect/<cid>/table.npy
+    <dir>/random-effect/<cid>/entity-index/{metadata.json,partition-0.bin}
+    <dir>/feature-index/<shard>/{metadata.json,partition-0.bin}
+
+``feature-index`` stores are forward-lookup (name → index) maps used to
+featurize raw records at serve time; they preserve the model's original
+indices, so reverse lookup is only meaningful when those are dense.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.indexmap import DefaultIndexMap, IndexMap
+from photon_ml_tpu.indexmap.offheap import (
+    METADATA_FILE as _PHIX_METADATA_FILE,
+    OffHeapIndexMap,
+    PARTITION_FILE as _PHIX_PARTITION_FILE,
+    _build_partition,
+)
+from photon_ml_tpu.io.model_io import (
+    load_game_model_metadata,
+    save_game_model_metadata,
+)
+from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.types import TaskType
+
+FIXED_EFFECT_DIR = "fixed-effect"
+RANDOM_EFFECT_DIR = "random-effect"
+ENTITY_INDEX_DIR = "entity-index"
+FEATURE_INDEX_DIR = "feature-index"
+TABLE_FILE = "table.npy"
+SERVING_FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass
+class ServingTable:
+    """One packed coordinate: FE vector or RE (entities × dim) matrix."""
+
+    feature_shard: str
+    random_effect_type: Optional[str]
+    weights: np.ndarray  # FE: [dim] float32; RE: [n_entities, dim] float32
+    entity_index: Optional[IndexMap] = None  # RE only: entity id -> table row
+
+    @property
+    def is_random_effect(self) -> bool:
+        return self.random_effect_type is not None
+
+    @property
+    def dim(self) -> int:
+        return int(self.weights.shape[-1])
+
+    @property
+    def n_entities(self) -> int:
+        return int(self.weights.shape[0]) if self.is_random_effect else 0
+
+
+@dataclasses.dataclass
+class ServingArtifact:
+    task: TaskType
+    tables: Dict[str, ServingTable]  # coordinate id -> packed table
+    model_name: str = "photon-ml-tpu"
+    # the training model's configurations blob (feature shard -> bags etc.)
+    # rides along so the serve CLI can read raw records the same way the
+    # score CLI does
+    configurations: Dict[str, object] = dataclasses.field(default_factory=dict)
+    feature_index: Dict[str, IndexMap] = dataclasses.field(default_factory=dict)
+
+    def entity_row(self, cid: str, entity_id: str) -> int:
+        """Table row of an entity in one RE coordinate; -1 when cold/unknown
+        (the caller scores FE-only for that coordinate — RE prior mean 0)."""
+        table = self.tables[cid]
+        if table.entity_index is None:
+            raise ValueError(f"coordinate {cid!r} is not a random effect")
+        return table.entity_index.get_index(str(entity_id))
+
+    def shard_dims(self) -> Dict[str, int]:
+        dims: Dict[str, int] = {}
+        for t in self.tables.values():
+            dims[t.feature_shard] = max(dims.get(t.feature_shard, 0), t.dim)
+        return dims
+
+    def random_effect_types(self) -> Tuple[str, ...]:
+        return tuple(
+            sorted(
+                {
+                    t.random_effect_type
+                    for t in self.tables.values()
+                    if t.random_effect_type
+                }
+            )
+        )
+
+
+def pack_game_model(
+    model: GameModel,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+    model_name: str = "photon-ml-tpu",
+    configurations: Optional[dict] = None,
+) -> ServingArtifact:
+    """Pack a trained GameModel into the serving layout.
+
+    Random-effect rows are materialized in *global* shard space (one dense
+    row per entity, sorted by entity id); a factored RE model is expanded
+    through its projection matrix (``w = latent · Bᵀ``) so the packed table
+    scores identically to the training model. Gathers of sharded arrays run
+    on every host (they are collectives); packing itself is host-side.
+    """
+    from photon_ml_tpu.algorithm.factored_random_effect import (
+        FactoredRandomEffectModel,
+    )
+    from photon_ml_tpu.parallel.mesh import fetch_global
+
+    tables: Dict[str, ServingTable] = {}
+    for cid, sub in model.models.items():
+        meta = model.meta[cid]
+        if isinstance(sub, GeneralizedLinearModel):
+            w = np.asarray(fetch_global(sub.coefficients.means), dtype=np.float32)
+            tables[cid] = ServingTable(
+                feature_shard=meta.feature_shard,
+                random_effect_type=None,
+                weights=w,
+            )
+        elif isinstance(sub, RandomEffectModel):
+            tables[cid] = _pack_random_effect(
+                meta.feature_shard, sub.random_effect_type,
+                sub.items(), sub.global_dim,
+            )
+        elif isinstance(sub, FactoredRandomEffectModel):
+            B = np.asarray(fetch_global(sub.projection_matrix))  # [d, k]
+            latent = sub.latent
+
+            def _factored_items():
+                for b, ids in enumerate(latent.entity_ids):
+                    w_b = np.asarray(fetch_global(latent.coefficients[b]))
+                    eff = w_b @ B.T  # [Eb, d]
+                    for e, eid in enumerate(ids):
+                        (nz,) = np.nonzero(eff[e])
+                        yield eid, {int(i): float(eff[e, i]) for i in nz}
+
+            tables[cid] = _pack_random_effect(
+                meta.feature_shard, latent.random_effect_type,
+                _factored_items(), B.shape[0],
+            )
+        else:
+            raise ValueError(
+                f"cannot pack sub-model type {type(sub).__name__} for {cid}"
+            )
+    return ServingArtifact(
+        task=model.task,
+        tables=tables,
+        model_name=model_name,
+        configurations=dict(configurations or {}),
+        feature_index=dict(index_maps or {}),
+    )
+
+
+def _pack_random_effect(
+    feature_shard: str,
+    re_type: str,
+    items: Iterable[Tuple[str, Dict[int, float]]],
+    global_dim: int,
+) -> ServingTable:
+    sparse = {str(eid): coefs for eid, coefs in items}
+    ids = sorted(sparse)
+    table = np.zeros((len(ids), global_dim), dtype=np.float32)
+    for row, eid in enumerate(ids):
+        for i, v in sparse[eid].items():
+            table[row, i] = v
+    return ServingTable(
+        feature_shard=feature_shard,
+        random_effect_type=re_type,
+        weights=table,
+        entity_index=DefaultIndexMap({eid: row for row, eid in enumerate(ids)}),
+    )
+
+
+def _index_map_items(imap: IndexMap) -> Iterable[Tuple[str, int]]:
+    if isinstance(imap, DefaultIndexMap):
+        return list(imap.items())
+    # generic fallback: contiguous reverse scan (OffHeapIndexMap etc.)
+    out = []
+    for i in range(len(imap)):
+        name = imap.get_feature_name(i)
+        if name is not None:
+            out.append((name, i))
+    return out
+
+
+def _write_phix_map(items: Iterable[Tuple[str, int]], out_dir: str) -> None:
+    """Persist a name→index map as a single-partition PHIX store, PRESERVING
+    the given indices (unlike ``build_offheap_index_map``, which reassigns
+    them — the artifact's indices must keep matching the packed weights)."""
+    items = sorted(items)
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    keys = [name.encode("utf-8") for name, _ in items]
+    indices = np.asarray([i for _, i in items], dtype=np.uint32)
+    _build_partition(str(out / _PHIX_PARTITION_FILE.format(i=0)), keys, indices)
+    (out / _PHIX_METADATA_FILE).write_text(
+        json.dumps(
+            {
+                "format": "PHIX",
+                "version": 1,
+                "num_partitions": 1,
+                "num_entries": len(keys),
+                "partition_offsets": [0],
+            }
+        )
+    )
+
+
+def save_artifact(artifact: ServingArtifact, output_dir: str) -> None:
+    """Write the artifact directory (layout in the module docstring)."""
+    os.makedirs(output_dir, exist_ok=True)
+    serving: Dict[str, object] = {
+        "format_version": SERVING_FORMAT_VERSION,
+        "coordinates": {},
+    }
+    for cid, table in artifact.tables.items():
+        desc = {
+            "kind": "random" if table.is_random_effect else "fixed",
+            "feature_shard": table.feature_shard,
+            "dim": table.dim,
+        }
+        if table.is_random_effect:
+            desc["random_effect_type"] = table.random_effect_type
+            desc["n_entities"] = table.n_entities
+            cdir = os.path.join(output_dir, RANDOM_EFFECT_DIR, cid)
+            os.makedirs(cdir, exist_ok=True)
+            np.save(
+                os.path.join(cdir, TABLE_FILE),
+                np.asarray(table.weights, dtype=np.float32),
+            )
+            _write_phix_map(
+                _index_map_items(table.entity_index),
+                os.path.join(cdir, ENTITY_INDEX_DIR),
+            )
+        else:
+            fdir = os.path.join(output_dir, FIXED_EFFECT_DIR)
+            os.makedirs(fdir, exist_ok=True)
+            np.save(
+                os.path.join(fdir, f"{cid}.npy"),
+                np.asarray(table.weights, dtype=np.float32),
+            )
+        serving["coordinates"][cid] = desc
+    for shard, imap in artifact.feature_index.items():
+        _write_phix_map(
+            _index_map_items(imap),
+            os.path.join(output_dir, FEATURE_INDEX_DIR, shard),
+        )
+    configurations = dict(artifact.configurations)
+    configurations["serving"] = serving
+    save_game_model_metadata(
+        output_dir, artifact.task,
+        model_name=artifact.model_name,
+        configurations=configurations,
+    )
+
+
+def load_artifact(artifact_dir: str, mmap: bool = True) -> ServingArtifact:
+    """Open an artifact directory.
+
+    ``mmap=True`` memory-maps the RE coefficient tables (they are the
+    host-side backing store behind the device cache, so the full tables
+    need never be resident) and the PHIX entity stores (always mmap'd).
+    """
+    metadata = load_game_model_metadata(artifact_dir)
+    task = TaskType[metadata["modelType"]]
+    configurations = dict(metadata.get("configurations") or {})
+    serving = configurations.pop("serving", None)
+    if not serving:
+        raise ValueError(
+            f"{artifact_dir} has no 'serving' section in its metadata — "
+            "not a serving artifact (export one with "
+            "photon_ml_tpu.serving.save_artifact)"
+        )
+    mmap_mode = "r" if mmap else None
+    tables: Dict[str, ServingTable] = {}
+    for cid, desc in serving["coordinates"].items():
+        if desc["kind"] == "random":
+            cdir = os.path.join(artifact_dir, RANDOM_EFFECT_DIR, cid)
+            weights = np.load(os.path.join(cdir, TABLE_FILE), mmap_mode=mmap_mode)
+            entity_index: IndexMap = OffHeapIndexMap(
+                os.path.join(cdir, ENTITY_INDEX_DIR)
+            )
+            tables[cid] = ServingTable(
+                feature_shard=desc["feature_shard"],
+                random_effect_type=desc["random_effect_type"],
+                weights=weights,
+                entity_index=entity_index,
+            )
+        else:
+            weights = np.load(
+                os.path.join(artifact_dir, FIXED_EFFECT_DIR, f"{cid}.npy"),
+                mmap_mode=mmap_mode,
+            )
+            tables[cid] = ServingTable(
+                feature_shard=desc["feature_shard"],
+                random_effect_type=None,
+                weights=weights,
+            )
+    feature_index: Dict[str, IndexMap] = {}
+    fdir = os.path.join(artifact_dir, FEATURE_INDEX_DIR)
+    if os.path.isdir(fdir):
+        for shard in sorted(os.listdir(fdir)):
+            feature_index[shard] = OffHeapIndexMap(os.path.join(fdir, shard))
+    return ServingArtifact(
+        task=task,
+        tables=tables,
+        model_name=metadata.get("modelName", "game-model"),
+        configurations=configurations,
+        feature_index=feature_index,
+    )

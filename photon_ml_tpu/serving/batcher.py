@@ -1,0 +1,90 @@
+"""Request microbatcher: coalesce requests into fixed-shape padded batches.
+
+Per-request scoring would make XLA dispatch (and on a cold scorer, compile)
+the price of every request; per-request shapes would make it compile *per
+request*. The batcher holds a FIFO of pending requests and drains them in
+batches padded to one of a small, fixed set of bucket sizes — so the jit'd
+scorer sees at most ``len(bucket_sizes)`` distinct shapes, ever.
+
+Draining is synchronous: ``submit`` drains a full max-size batch whenever
+enough requests are pending and returns any completed results; ``flush``
+drains the remainder through the smallest bucket that fits. (A network
+server would put a deadline timer in front of ``flush``; the replay and
+bench drivers call it explicitly.)
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
+
+DEFAULT_BUCKET_SIZES = (1, 2, 4, 8, 16, 32)
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        scorer: GameScorer,
+        bucket_sizes: Sequence[int] = DEFAULT_BUCKET_SIZES,
+        metrics: Optional[ServingMetrics] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        buckets = sorted({int(b) for b in bucket_sizes})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"bucket sizes must be positive, got {bucket_sizes}")
+        self.bucket_sizes: Tuple[int, ...] = tuple(buckets)
+        self.max_bucket = buckets[-1]
+        for cid, cache in scorer.caches.items():
+            if cache.capacity < self.max_bucket:
+                raise ValueError(
+                    f"hot-entity cache for {cid!r} holds {cache.capacity} "
+                    f"rows < max bucket size {self.max_bucket}; a single "
+                    f"batch could evict rows it is about to gather"
+                )
+        self._scorer = scorer
+        self._metrics = metrics
+        self._clock = clock
+        self._pending: "deque[Tuple[ScoreRequest, float]]" = deque()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        return self.max_bucket
+
+    def submit(self, request: ScoreRequest) -> List[ScoreResult]:
+        """Enqueue one request; returns results completed by this call
+        (empty until a full max-size batch has accumulated)."""
+        self._pending.append((request, self._clock()))
+        out: List[ScoreResult] = []
+        while len(self._pending) >= self.max_bucket:
+            out.extend(self._drain(self.max_bucket))
+        return out
+
+    def flush(self) -> List[ScoreResult]:
+        """Score everything still pending (smallest buckets that fit)."""
+        out: List[ScoreResult] = []
+        while self._pending:
+            out.extend(self._drain(min(len(self._pending), self.max_bucket)))
+        return out
+
+    def _drain(self, n: int) -> List[ScoreResult]:
+        batch = [self._pending.popleft() for _ in range(n)]
+        bucket = self._bucket_for(n)
+        results = self._scorer.score_batch([req for req, _ in batch], bucket)
+        done = self._clock()
+        if self._metrics is not None:
+            self._metrics.observe_batch(
+                n_real=n, bucket_size=bucket, queue_depth=len(self._pending)
+            )
+            for _, enqueued in batch:
+                self._metrics.observe_latency(done - enqueued)
+        return results

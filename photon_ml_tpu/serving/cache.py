@@ -1,0 +1,130 @@
+"""Hot-entity cache: device-resident LRU over RE coefficient rows.
+
+The packed RE table (``artifact.ServingTable.weights``) is the host-side
+backing store — potentially a memory-mapped ``(n_entities, dim)`` file for
+million-entity coordinates. Serving gathers one row per request; keeping the
+full table on device wastes HBM and keeping none forces a host→device copy
+per request. Entity popularity is heavy-tailed (the Snap ML observation:
+hot model state belongs device-resident behind a hierarchical cache), so a
+small device table of the hottest rows makes the steady-state gather never
+leave the chip.
+
+Layout: a device array ``[capacity + 1, dim]``. Slots ``0..capacity-1``
+hold cached entity rows; slot ``capacity`` is permanently zero — the *cold
+slot* that unknown entities gather from, which realizes the FE-only
+fallback (RE prior mean = 0) without any branching in the jit'd scorer.
+Misses within one batch are filled with a single scatter.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class HotEntityCache:
+    """LRU cache of backing-store rows on device.
+
+    ``lookup`` maps backing-table row indices (−1 = unknown entity) to slots
+    in the device ``table``; rows already cached are hits, others are copied
+    in from the backing store (evicting least-recently-used slots when
+    full). Rows referenced by the *current* batch are pinned: they cannot be
+    evicted by later misses in the same lookup, so a batch is always
+    internally consistent. That requires ``capacity >= distinct entities per
+    batch``; the scorer enforces ``capacity >= max bucket size``.
+    """
+
+    def __init__(self, backing: np.ndarray, capacity: int):
+        if backing.ndim != 2:
+            raise ValueError(f"backing store must be 2-D, got {backing.shape}")
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        import jax.numpy as jnp
+
+        self._backing = backing
+        self.capacity = int(capacity)
+        self.cold_slot = self.capacity
+        self._table = jnp.zeros(
+            (self.capacity + 1, backing.shape[1]), dtype=jnp.float32
+        )
+        # entity row -> slot, in LRU order (oldest first)
+        self._slot_of: "OrderedDict[int, int]" = OrderedDict()
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.cold = 0  # lookups of entities absent from the model
+
+    @property
+    def table(self):
+        """Device array [capacity + 1, dim]; last row is the zero cold slot."""
+        return self._table
+
+    def lookup(self, entity_rows: np.ndarray) -> np.ndarray:
+        """Backing rows (−1 = cold) → device slots, filling misses.
+
+        Returns an int32 array the same length as ``entity_rows``.
+        """
+        entity_rows = np.asarray(entity_rows, dtype=np.int64)
+        slots = np.full(len(entity_rows), self.cold_slot, dtype=np.int32)
+        pinned: set = set()
+        fill_slots: List[int] = []
+        fill_rows: List[int] = []
+        for i, row in enumerate(entity_rows):
+            row = int(row)
+            if row < 0:
+                self.cold += 1
+                continue
+            slot = self._slot_of.get(row)
+            if slot is not None:
+                self.hits += 1
+                self._slot_of.move_to_end(row)
+            else:
+                self.misses += 1
+                slot = self._allocate_slot(pinned)
+                self._slot_of[row] = slot
+                fill_slots.append(slot)
+                fill_rows.append(row)
+            pinned.add(slot)
+            slots[i] = slot
+        if fill_slots:
+            rows = np.ascontiguousarray(
+                self._backing[np.asarray(fill_rows)], dtype=np.float32
+            )
+            self._table = self._table.at[np.asarray(fill_slots)].set(rows)
+        return slots
+
+    def _allocate_slot(self, pinned: set) -> int:
+        if self._free:
+            return self._free.pop()
+        for row, slot in self._slot_of.items():  # oldest first
+            if slot not in pinned:
+                del self._slot_of[row]
+                self.evictions += 1
+                return slot
+        raise RuntimeError(
+            f"cache capacity {self.capacity} smaller than the distinct "
+            f"entities of one batch — raise capacity above the largest "
+            f"bucket size"
+        )
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def cached_entities(self) -> List[int]:
+        """Backing rows currently resident, LRU → MRU (test/debug hook)."""
+        return list(self._slot_of)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "capacity": self.capacity,
+            "resident": len(self._slot_of),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "cold_lookups": self.cold,
+            "hit_rate": round(self.hit_rate(), 6),
+        }

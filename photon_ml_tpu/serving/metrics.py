@@ -1,0 +1,109 @@
+"""Serving metrics: latency percentiles, queue depth, batch fill, cache hits.
+
+The batcher feeds per-request latencies (enqueue → scored) and per-batch
+fill/queue observations; ``snapshot`` renders everything as one plain dict
+so it can be logged, JSON-dumped by the CLI/bench, or attached to a
+``ScoringFinishEvent``. Latencies additionally land in a fixed log-spaced
+histogram (100µs … 10s) whose bucket counts survive in the snapshot even
+if a future caller decides to drop the raw samples.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+# log-spaced upper bounds, seconds: 1e-4 .. 1e1 (8 per decade is plenty to
+# localize a p99 shift; the exact percentiles come from the raw samples)
+LATENCY_BUCKET_BOUNDS = tuple(
+    float(b) for b in np.logspace(-4, 1, num=5 * 8 + 1)
+)
+
+
+class ServingMetrics:
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._latencies: List[float] = []
+        self._hist = np.zeros(len(LATENCY_BUCKET_BOUNDS) + 1, dtype=np.int64)
+        self._fill_real = 0
+        self._fill_padded = 0
+        self._queue_depths: List[int] = []
+        self.num_requests = 0
+        self.num_batches = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    def observe_batch(
+        self, n_real: int, bucket_size: int, queue_depth: int
+    ) -> None:
+        now = self._clock()
+        if self._t_first is None:
+            self._t_first = now
+        self._t_last = now
+        self.num_batches += 1
+        self.num_requests += n_real
+        self._fill_real += n_real
+        self._fill_padded += bucket_size
+        self._queue_depths.append(queue_depth)
+
+    def observe_latency(self, seconds: float) -> None:
+        self._latencies.append(float(seconds))
+        self._hist[np.searchsorted(LATENCY_BUCKET_BOUNDS, seconds)] += 1
+
+    def snapshot(
+        self,
+        cache_stats: Optional[Dict[str, Dict[str, float]]] = None,
+        compile_count: Optional[int] = None,
+    ) -> dict:
+        lat = np.asarray(self._latencies, dtype=np.float64)
+        out: dict = {
+            "num_requests": self.num_requests,
+            "num_batches": self.num_batches,
+            "batch_fill_ratio": (
+                round(self._fill_real / self._fill_padded, 6)
+                if self._fill_padded
+                else 0.0
+            ),
+            "queue_depth_mean": (
+                round(float(np.mean(self._queue_depths)), 3)
+                if self._queue_depths
+                else 0.0
+            ),
+            "queue_depth_max": (
+                int(max(self._queue_depths)) if self._queue_depths else 0
+            ),
+        }
+        if lat.size:
+            p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+            out.update(
+                latency_p50_s=round(float(p50), 6),
+                latency_p95_s=round(float(p95), 6),
+                latency_p99_s=round(float(p99), 6),
+                latency_mean_s=round(float(lat.mean()), 6),
+                latency_max_s=round(float(lat.max()), 6),
+            )
+            nz = np.nonzero(self._hist)[0]
+            out["latency_histogram"] = {
+                (
+                    f"le_{LATENCY_BUCKET_BOUNDS[i]:.6g}s"
+                    if i < len(LATENCY_BUCKET_BOUNDS)
+                    else "inf"
+                ): int(self._hist[i])
+                for i in nz
+            }
+        if self._t_first is not None and self._t_last > self._t_first:
+            wall = self._t_last - self._t_first
+            out["wall_seconds"] = round(wall, 6)
+            out["requests_per_s"] = round(self.num_requests / wall, 3)
+        if compile_count is not None:
+            out["xla_compiles"] = int(compile_count)
+        if cache_stats:
+            out["caches"] = dict(cache_stats)
+            hits = sum(c.get("hits", 0) for c in cache_stats.values())
+            misses = sum(c.get("misses", 0) for c in cache_stats.values())
+            out["cache_hit_rate"] = (
+                round(hits / (hits + misses), 6) if hits + misses else 0.0
+            )
+        return out

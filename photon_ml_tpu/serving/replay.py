@@ -1,0 +1,126 @@
+"""Request-stream replay: drive the serving stack from a scoring dataset.
+
+Turns ``GameData`` rows into ``ScoreRequest``s (one per row: sparse
+features per shard the artifact consumes, the row's entity id per
+random-effect type, its offset) and pumps them through a microbatcher with
+full metrics/event instrumentation. This is the shared driver behind
+``cli/serve_game.py`` and the serving mode of ``bench.py``; tests use it to
+prove the online path reproduces the offline ``GameModel.score``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.game_data import GameData
+from photon_ml_tpu.serving.artifact import ServingArtifact
+from photon_ml_tpu.serving.batcher import DEFAULT_BUCKET_SIZES, MicroBatcher
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
+
+
+def requests_from_game_data(
+    data: GameData,
+    artifact: ServingArtifact,
+    uids: Optional[Sequence[Optional[str]]] = None,
+    max_requests: Optional[int] = None,
+) -> List[ScoreRequest]:
+    """One ScoreRequest per dataset row, restricted to the shards and
+    random-effect types the artifact actually consumes."""
+    n = data.num_rows
+    if max_requests is not None:
+        n = min(n, int(max_requests))
+    shards = sorted({t.feature_shard for t in artifact.tables.values()})
+    re_types = [t for t in artifact.random_effect_types() if t in data.id_tags]
+
+    per_row: Dict[str, List[Dict[int, float]]] = {}
+    for shard_name in shards:
+        shard = data.feature_shards[shard_name]
+        feats: List[Dict[int, float]] = [{} for _ in range(n)]
+        keep = shard.rows < n
+        for r, c, v in zip(
+            shard.rows[keep], shard.cols[keep], shard.vals[keep]
+        ):
+            feats[int(r)][int(c)] = float(v)
+        per_row[shard_name] = feats
+
+    requests = []
+    for i in range(n):
+        rid = None
+        if uids is not None and i < len(uids):
+            rid = uids[i]
+        requests.append(
+            ScoreRequest(
+                request_id=str(rid) if rid is not None else f"row-{i}",
+                features={s: per_row[s][i] for s in shards},
+                entity_ids={t: str(data.id_tags[t][i]) for t in re_types},
+                offset=float(data.offsets[i]),
+            )
+        )
+    return requests
+
+
+def max_nnz_of(
+    requests: Sequence[ScoreRequest], round_pow2: bool = True
+) -> Dict[str, int]:
+    """Per-shard max nonzero count over a request stream — a tight
+    ``GameScorer(max_nnz=...)`` choice for replay (rounded up to a power of
+    two so near-boundary streams do not split compile signatures)."""
+    out: Dict[str, int] = {}
+    for req in requests:
+        for shard, feats in req.features.items():
+            out[shard] = max(out.get(shard, 1), len(feats))
+    if round_pow2:
+        out = {s: 1 << (int(k - 1)).bit_length() for s, k in out.items()}
+    return out
+
+
+def replay_requests(
+    scorer: GameScorer,
+    requests: Sequence[ScoreRequest],
+    bucket_sizes: Sequence[int] = DEFAULT_BUCKET_SIZES,
+    metrics: Optional[ServingMetrics] = None,
+    emitter=None,
+    model_id: str = "game-model",
+) -> Tuple[List[ScoreResult], dict]:
+    """Pump a request stream through a fresh microbatcher.
+
+    Returns (results in submission order, metrics snapshot). When an
+    ``EventEmitter`` is given, a ``ScoringStartEvent`` fires before the
+    first request and a ``ScoringFinishEvent`` (carrying the snapshot)
+    after the flush.
+    """
+    from photon_ml_tpu.event import ScoringFinishEvent, ScoringStartEvent
+
+    metrics = metrics if metrics is not None else ServingMetrics()
+    batcher = MicroBatcher(scorer, bucket_sizes=bucket_sizes, metrics=metrics)
+    if emitter is not None:
+        emitter.send_event(
+            ScoringStartEvent(model_id=model_id, num_requests=len(requests))
+        )
+    t0 = time.perf_counter()
+    results: List[ScoreResult] = []
+    for req in requests:
+        results.extend(batcher.submit(req))
+    results.extend(batcher.flush())
+    wall = time.perf_counter() - t0
+    snapshot = metrics.snapshot(
+        cache_stats=scorer.cache_stats() or None,
+        compile_count=scorer.compile_count,
+    )
+    snapshot["replay_wall_seconds"] = round(wall, 6)
+    if wall > 0:
+        snapshot["replay_requests_per_s"] = round(len(requests) / wall, 3)
+    if emitter is not None:
+        emitter.send_event(
+            ScoringFinishEvent(
+                model_id=model_id,
+                num_requests=len(results),
+                wall_seconds=wall,
+                metrics=dict(snapshot),
+            )
+        )
+    return results, snapshot

@@ -1,0 +1,267 @@
+"""Jit'd fixed-shape request scoring against a packed serving artifact.
+
+One request carries sparse features per shard and one entity id per
+random-effect type; a batch of B requests is scored as
+
+    z   = offset + Σ_fe x·β_fe + Σ_re x·β_re[entity]
+    out = mean(z)                      (task link-inverse, e.g. sigmoid)
+
+with every array shaped ``[B, K_shard]`` (K fixed per shard, nonzeros
+padded with zero values at index 0). RE rows are gathered from a device
+table through slot indices produced by the hot-entity cache (or the full
+device-resident table); entities absent from the model gather the
+permanently-zero cold slot, so they degrade to the FE-only score — the
+Photon-ML left-join semantics — without a branch.
+
+Because shapes are fixed per (bucket size, shard-K) signature, XLA compiles
+the score function once per bucket size and never per request;
+``compile_count`` counts actual traces (incremented by a Python side effect
+that only runs when jit traces).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from photon_ml_tpu.serving.artifact import ServingArtifact
+from photon_ml_tpu.serving.cache import HotEntityCache
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One item to score: sparse features per shard + entity ids."""
+
+    request_id: str
+    features: Dict[str, Dict[int, float]]  # shard -> {feature index: value}
+    entity_ids: Dict[str, str] = dataclasses.field(default_factory=dict)
+    offset: float = 0.0
+
+
+@dataclasses.dataclass
+class ScoreResult:
+    request_id: str
+    score: float  # margin z including the request offset (GameModel.score + offset)
+    mean: float   # task link-inverse of the margin
+    cold_coordinates: Tuple[str, ...] = ()  # RE coordinates served FE-only
+
+
+class _FullTable:
+    """No-cache RE row provider: whole table device-resident, plus the
+    trailing zero cold row. Same lookup contract as HotEntityCache."""
+
+    def __init__(self, backing: np.ndarray):
+        import jax.numpy as jnp
+
+        n, dim = backing.shape
+        self._table = jnp.concatenate(
+            [
+                jnp.asarray(np.ascontiguousarray(backing, dtype=np.float32)),
+                jnp.zeros((1, dim), dtype=jnp.float32),
+            ]
+        )
+        self.cold_slot = n
+
+    @property
+    def table(self):
+        return self._table
+
+    def lookup(self, entity_rows: np.ndarray) -> np.ndarray:
+        rows = np.asarray(entity_rows, dtype=np.int64)
+        return np.where(rows < 0, self.cold_slot, rows).astype(np.int32)
+
+    def stats(self) -> Dict[str, float]:
+        return {}
+
+
+class GameScorer:
+    """Scores request batches against a :class:`ServingArtifact`.
+
+    - ``max_nnz``: per-shard padded nonzero capacity K (int applies to all
+      shards; default: the shard's full dimension, always correct).
+    - ``cache_capacity``: device rows per RE coordinate. None keeps each
+      full RE table device-resident; an int puts an LRU
+      :class:`HotEntityCache` in front of the host backing store (must be
+      >= the largest batch the caller will score).
+    """
+
+    def __init__(
+        self,
+        artifact: ServingArtifact,
+        max_nnz: Optional[Union[int, Dict[str, int]]] = None,
+        cache_capacity: Optional[int] = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.losses.pointwise import mean_function
+
+        self._artifact = artifact
+        self._task = artifact.task
+        dims = artifact.shard_dims()
+        self._shard_nnz: Dict[str, int] = {}
+        for shard, dim in dims.items():
+            if isinstance(max_nnz, dict):
+                k = max_nnz.get(shard, dim)
+            elif max_nnz is not None:
+                k = int(max_nnz)
+            else:
+                k = dim
+            self._shard_nnz[shard] = max(1, min(int(k), dim))
+        self._shard_dim = dims
+
+        self._fe_specs: List[Tuple[str, str]] = []  # (cid, shard)
+        self._re_specs: List[Tuple[str, str, str]] = []  # (cid, shard, re_type)
+        self.caches: Dict[str, HotEntityCache] = {}
+        self._providers: Dict[str, object] = {}
+        fe_params: Dict[str, object] = {}
+        for cid in sorted(artifact.tables):
+            table = artifact.tables[cid]
+            if table.is_random_effect:
+                self._re_specs.append(
+                    (cid, table.feature_shard, table.random_effect_type)
+                )
+                if cache_capacity is not None:
+                    cache = HotEntityCache(table.weights, cache_capacity)
+                    self.caches[cid] = cache
+                    self._providers[cid] = cache
+                else:
+                    self._providers[cid] = _FullTable(np.asarray(table.weights))
+            else:
+                self._fe_specs.append((cid, table.feature_shard))
+                fe_params[cid] = jnp.asarray(
+                    np.ascontiguousarray(table.weights, dtype=np.float32)
+                )
+        self._fe_params = fe_params
+        self._compiles = 0
+
+        fe_specs = tuple(self._fe_specs)
+        re_specs = tuple(self._re_specs)
+        task = self._task
+
+        def _score(params, batch):
+            # trace-time side effect: runs once per compiled shape signature
+            self._compiles += 1
+            z = batch["offsets"]
+            for cid, shard in fe_specs:
+                vals, idx = batch["shards"][shard]
+                z = z + (vals * params["fe"][cid][idx]).sum(axis=1)
+            for cid, shard, _ in re_specs:
+                vals, idx = batch["shards"][shard]
+                rows = params["re"][cid][batch["slots"][cid]]  # [B, dim]
+                z = z + (vals * jnp.take_along_axis(rows, idx, axis=1)).sum(axis=1)
+            return z, mean_function(task, z)
+
+        self._score_fn = jax.jit(_score)
+
+    @property
+    def compile_count(self) -> int:
+        """Number of XLA traces so far — one per distinct bucket size."""
+        return self._compiles
+
+    @property
+    def task(self):
+        return self._task
+
+    def cache_stats(self) -> Dict[str, Dict[str, float]]:
+        return {cid: c.stats() for cid, c in self.caches.items()}
+
+    def _featurize(self, requests: Sequence[ScoreRequest], bucket: int):
+        shards = {}
+        for shard, k in self._shard_nnz.items():
+            dim = self._shard_dim[shard]
+            vals = np.zeros((bucket, k), dtype=np.float32)
+            idx = np.zeros((bucket, k), dtype=np.int32)
+            for i, req in enumerate(requests):
+                feats = req.features.get(shard)
+                if not feats:
+                    continue
+                if len(feats) > k:
+                    raise ValueError(
+                        f"request {req.request_id!r} has {len(feats)} nonzeros "
+                        f"in shard {shard!r} but the scorer was built with "
+                        f"max_nnz={k} — raise max_nnz"
+                    )
+                for j, (c, v) in enumerate(feats.items()):
+                    c = int(c)
+                    if not 0 <= c < dim:
+                        raise ValueError(
+                            f"request {req.request_id!r}: feature index {c} "
+                            f"out of range for shard {shard!r} (dim {dim})"
+                        )
+                    idx[i, j] = c
+                    vals[i, j] = float(v)
+            shards[shard] = (vals, idx)
+        offsets = np.zeros(bucket, dtype=np.float32)
+        for i, req in enumerate(requests):
+            offsets[i] = req.offset
+        return shards, offsets
+
+    def score_batch(
+        self,
+        requests: Sequence[ScoreRequest],
+        bucket_size: Optional[int] = None,
+    ) -> List[ScoreResult]:
+        """Score up to ``bucket_size`` requests, padding the batch to exactly
+        that size (defaults to ``len(requests)``). Results keep request order."""
+        import jax.numpy as jnp
+
+        n = len(requests)
+        bucket = int(bucket_size) if bucket_size is not None else n
+        if n == 0:
+            return []
+        if n > bucket:
+            raise ValueError(f"{n} requests do not fit bucket size {bucket}")
+
+        shards, offsets = self._featurize(requests, bucket)
+        slots: Dict[str, np.ndarray] = {}
+        cold: List[List[str]] = [[] for _ in range(n)]
+        for cid, _, re_type in self._re_specs:
+            table = self._artifact.tables[cid]
+            entity_rows = np.full(bucket, -1, dtype=np.int64)
+            ids, where = [], []
+            for i, req in enumerate(requests):
+                eid = req.entity_ids.get(re_type)
+                if eid is not None:
+                    ids.append(str(eid))
+                    where.append(i)
+            if ids:
+                entity_rows[np.asarray(where)] = table.entity_index.get_indices(ids)
+            for i in range(n):
+                if entity_rows[i] < 0:
+                    cold[i].append(cid)
+            # pad rows bypass the provider: they would otherwise count as
+            # cold lookups in the cache statistics
+            provider = self._providers[cid]
+            cid_slots = np.full(bucket, provider.cold_slot, dtype=np.int32)
+            cid_slots[:n] = np.asarray(
+                provider.lookup(entity_rows[:n]), dtype=np.int32
+            )
+            slots[cid] = cid_slots
+
+        batch = {
+            "offsets": jnp.asarray(offsets),
+            "shards": {
+                shard: (jnp.asarray(v), jnp.asarray(i))
+                for shard, (v, i) in shards.items()
+            },
+            "slots": {cid: jnp.asarray(s) for cid, s in slots.items()},
+        }
+        params = {
+            "fe": self._fe_params,
+            "re": {cid: self._providers[cid].table for cid, _, _ in self._re_specs},
+        }
+        z, mean = self._score_fn(params, batch)
+        z = np.asarray(z)
+        mean = np.asarray(mean)
+        return [
+            ScoreResult(
+                request_id=req.request_id,
+                score=float(z[i]),
+                mean=float(mean[i]),
+                cold_coordinates=tuple(cold[i]),
+            )
+            for i, req in enumerate(requests)
+        ]

@@ -573,3 +573,27 @@ class TestScoresIO:
         assert got[0].prediction_score == pytest.approx(0.9)
         assert got[0].id_tags == {"userId": "u1"}
         assert got[1].label is None and got[1].uid is None
+
+    def test_flush_cap_round_trip(self, tmp_path):
+        """Writing more items than one file's flush cap must roll over to
+        new part files without dropping/duplicating records; ids and scores
+        reload exactly and in order."""
+        from photon_ml_tpu.io.scores_io import ScoredItem, load_scores, save_scores
+
+        cap = 7
+        n_items = 3 * cap + 2  # crosses the cap boundary three times
+        items = [
+            ScoredItem(prediction_score=float(i) / 8.0, uid=f"uid-{i:03d}")
+            for i in range(n_items)
+        ]
+        out = str(tmp_path / "scores")
+        n = save_scores(out, items, model_id="m", records_per_file=cap)
+        assert n == n_items
+        parts = sorted(f for f in os.listdir(out) if f.endswith(".avro"))
+        assert len(parts) == 4  # 7 + 7 + 7 + 2
+        got = list(load_scores(out))
+        assert [g.uid for g in got] == [f"uid-{i:03d}" for i in range(n_items)]
+        np.testing.assert_array_equal(
+            np.array([g.prediction_score for g in got], dtype=np.float32),
+            np.array([i / 8.0 for i in range(n_items)], dtype=np.float32),
+        )
