@@ -759,6 +759,170 @@ def _serving_bench():
         sys.exit(1)
 
 
+N_INC_ENT = 32 if _SMOKE else 1024          # random-effect entities
+N_INC_ROWS = 8 if _SMOKE else 40            # base rows per entity
+N_INC_TOUCH = 8 if _SMOKE else 128          # entities touched by the update
+N_INC_NEW = 4 if _SMOKE else 32             # brand-new entities in the update
+D_INC_FE = 16 if _SMOKE else 128            # global feature dim
+D_INC_RE = 8                                # per-entity dim
+_INCREMENTAL_PATH = os.path.join(_REPO, "BENCH_INCREMENTAL.json")
+
+
+def _incremental_bench():
+    """Time the nearline loop: warm-started incremental re-solve of the
+    touched entities, delta publish (atomic dir write + fingerprint) and
+    hot-swap into a live scorer (in-place device-table mutation, no
+    re-jit). The headline is the incremental update latency — the
+    freshness floor of the nearline pipeline; blackout and added compiles
+    are the serving-side costs. Emits ONE JSON line and writes
+    BENCH_INCREMENTAL.json; an exception emits an error line instead."""
+    import sys
+    import tempfile
+    import time as _time
+
+    try:
+        import jax
+
+        if _SMOKE:
+            jax.config.update("jax_platforms", "cpu")
+        from photon_ml_tpu.data import RandomEffectDataConfiguration
+        from photon_ml_tpu.data.game_data import FeatureShard, GameData
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+            RandomEffectCoordinateConfiguration,
+        )
+        from photon_ml_tpu.incremental import (
+            build_delta,
+            delta_dir_name,
+            incremental_update,
+            save_delta,
+        )
+        from photon_ml_tpu.opt import (
+            GlmOptimizationConfiguration,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.serving import (
+            GameScorer,
+            HotSwapManager,
+            pack_game_model,
+        )
+        from photon_ml_tpu.serving.replay import (
+            max_nnz_of,
+            requests_from_game_data,
+        )
+        from photon_ml_tpu.types import RegularizationType, TaskType
+
+        l2 = lambda lam: GlmOptimizationConfiguration(  # noqa: E731
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=lam,
+        )
+        rng = np.random.default_rng(SEED)
+
+        def _coo(X):
+            r, c = np.nonzero(X)
+            return FeatureShard(rows=r, cols=c, vals=X[r, c], dim=X.shape[1])
+
+        def _dataset(entities, rows, wg, wu):
+            n = len(entities) * rows
+            Xg = rng.normal(size=(n, D_INC_FE)).astype(np.float32)
+            Xu = rng.normal(size=(n, D_INC_RE)).astype(np.float32)
+            users = np.repeat(entities, rows)
+            y = Xg @ wg + np.array(
+                [Xu[i] @ wu[users[i]] for i in range(n)], np.float32
+            )
+            y += 0.05 * rng.normal(size=n).astype(np.float32)
+            return GameData(
+                labels=y,
+                feature_shards={"g": _coo(Xg), "u": _coo(Xu)},
+                id_tags={"userId": users},
+            )
+
+        wg = rng.normal(size=D_INC_FE).astype(np.float32)
+        base_ids = [f"u{i}" for i in range(N_INC_ENT)]
+        new_ids = [f"n{i}" for i in range(N_INC_NEW)]
+        wu = {
+            e: rng.normal(size=D_INC_RE).astype(np.float32)
+            for e in base_ids + new_ids
+        }
+        base_data = _dataset(base_ids, N_INC_ROWS, wg, wu)
+        events = _dataset(
+            base_ids[:N_INC_TOUCH] + new_ids, max(4, N_INC_ROWS // 2), wg, wu
+        )
+
+        estimator = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates={
+                "fixed": FixedEffectCoordinateConfiguration("g", l2(0.1)),
+                "per_user": RandomEffectCoordinateConfiguration(
+                    "u",
+                    RandomEffectDataConfiguration(random_effect_type="userId"),
+                    l2(1.0),
+                ),
+            },
+            num_outer_iterations=1,
+        )
+        fit = estimator.fit(base_data)
+        artifact = pack_game_model(fit.model, model_name="incremental-bench")
+
+        t0 = _time.perf_counter()
+        update = incremental_update(
+            estimator, fit.model, events,
+            refresh_fixed_iterations=1, merge=False,
+        )
+        update_s = _time.perf_counter() - t0
+
+        with tempfile.TemporaryDirectory() as tmp:
+            t0 = _time.perf_counter()
+            delta = build_delta(
+                update.re_updates, artifact,
+                fe_updates=update.fe_updates or None,
+                generation=1, created_at_unix=_time.time(),
+            )
+            delta_dir = os.path.join(tmp, delta_dir_name(1))
+            save_delta(delta, delta_dir)
+            publish_s = _time.perf_counter() - t0
+
+            requests = requests_from_game_data(events, artifact)
+            scorer = GameScorer(
+                artifact, max_nnz=max_nnz_of(requests), growth_headroom=True,
+            )
+            warm = min(8, len(requests))
+            scorer.score_batch(requests[:warm], bucket_size=warm)
+            manager = HotSwapManager(scorer)
+            report = manager.apply_delta(delta_dir)
+
+        payload = {
+            "metric": "incremental_update_latency_s",
+            "value": round(update_s, 6),
+            "unit": "seconds",
+            "publish_s": round(publish_s, 6),
+            "swap_blackout_s": round(report.blackout_s, 6),
+            "swap_staleness_s": (
+                round(report.staleness_s, 6)
+                if report.staleness_s is not None else None
+            ),
+            "swap_compiles_added": report.compiles_added,
+            "swap_regrew": list(report.regrew),
+            "rows_updated": report.rows_updated,
+            "touched_entities": N_INC_TOUCH,
+            "new_entities": N_INC_NEW,
+            "n_entities": N_INC_ENT,
+            "num_events": update.num_events,
+            "backend": jax.default_backend(),
+        }
+        print(json.dumps(payload))
+        if not _SMOKE or _env_flag("BENCH_INCREMENTAL_WRITE"):
+            with open(_INCREMENTAL_PATH, "w") as f:
+                json.dump(payload, f, indent=2)
+    except Exception as e:  # noqa: BLE001 - one JSON line per exit path
+        print(json.dumps({
+            "metric": "incremental_update_latency_s",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
+
+
 def main():
     """Every exit path emits one JSON line: an uncaught exception anywhere
     (e.g. the tunnel dying mid-phase with the headline already measured)
@@ -799,10 +963,20 @@ def _main():
              "microbatcher + hot-entity cache, report p99 latency and "
              "sustained requests/sec, and write BENCH_SERVING.json",
     )
+    ap.add_argument(
+        "--incremental", action="store_true",
+        help="run the nearline-update benchmark instead of the training "
+             "bench: warm-started incremental re-solve, delta publish and "
+             "zero-re-jit hot-swap; reports update latency and swap "
+             "blackout, and writes BENCH_INCREMENTAL.json",
+    )
     args = ap.parse_args()
 
     if args.serving:
         _serving_bench()
+        return
+    if args.incremental:
+        _incremental_bench()
         return
 
     watchdog_s = int(os.environ.get("BENCH_WATCHDOG_S", "2700"))

@@ -35,6 +35,8 @@ from photon_ml_tpu.types import TaskType
 
 STATE_FILE = "training-state.json"
 _FORMAT_VERSION = 1
+_TMP_PREFIX = ".ckpt-tmp-"
+_OLD_PREFIX = ".ckpt-old-"
 
 
 # ------------------------------------------------------------- serialization
@@ -185,14 +187,65 @@ def model_fingerprint(models: Dict[str, object]) -> Dict[str, list]:
 
 # ------------------------------------------------------------------ save/load
 
+def _sweep_orphans(parent: str, keep: str) -> None:
+    """Delete leftover ``.ckpt-tmp-*`` / ``.ckpt-old-*`` sibling dirs — a
+    kill between the two renames (or mid-build) leaks them forever, and a
+    long training run saves every outer iteration. Runs after a SUCCESSFUL
+    save, so any matching dir other than ``keep`` is an orphan (single
+    writer per parent directory — the checkpointing contract)."""
+    try:
+        names = os.listdir(parent)
+    except OSError:
+        return
+    for name in names:
+        if not (name.startswith(_TMP_PREFIX) or name.startswith(_OLD_PREFIX)):
+            continue
+        full = os.path.join(parent, name)
+        if full != keep and os.path.isdir(full):
+            shutil.rmtree(full, ignore_errors=True)
+
+
+def _prune_numbered_siblings(directory: str, keep_last_n: int) -> None:
+    """Retention for iteration-numbered checkpoint dirs (``ckpt-000010``):
+    keep the ``keep_last_n`` highest-numbered siblings sharing the same
+    prefix, delete the rest. Only dirs that actually contain a checkpoint
+    state file are eligible — anything else in the parent is left alone."""
+    import re
+
+    if keep_last_n < 1:
+        raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+    base = os.path.basename(os.path.abspath(directory))
+    m = re.match(r"^(.*?)(\d+)$", base)
+    if m is None:
+        raise ValueError(
+            f"keep_last_n needs an iteration-numbered checkpoint directory "
+            f"name (e.g. 'ckpt-000010'), got {base!r}"
+        )
+    prefix = m.group(1)
+    parent = os.path.dirname(os.path.abspath(directory)) or "."
+    numbered = []
+    for name in os.listdir(parent):
+        mm = re.match(rf"^{re.escape(prefix)}(\d+)$", name)
+        full = os.path.join(parent, name)
+        if mm and os.path.isfile(os.path.join(full, STATE_FILE)):
+            numbered.append((int(mm.group(1)), full))
+    numbered.sort()
+    for _, full in numbered[:-keep_last_n]:
+        shutil.rmtree(full, ignore_errors=True)
+
+
 def save_training_checkpoint(
     directory: str,
     models: Dict[str, object],
     state: dict,
     best_models: Optional[Dict[str, object]] = None,
+    keep_last_n: Optional[int] = None,
 ) -> None:
     """Atomically write a checkpoint: build in a tmp sibling dir, fsync the
-    state file, then rename over the target (crash-safe).
+    state file, then rename over the target (crash-safe). A successful save
+    also sweeps orphaned tmp/old sibling dirs left by earlier crashes, and
+    ``keep_last_n`` prunes older iteration-numbered sibling checkpoints
+    (the directory name must end in digits, e.g. ``ckpt-000010``).
 
     Multi-host: sharded model arrays are gathered on EVERY process (the
     gathers are collectives), but only process 0 writes files; other
@@ -208,7 +261,7 @@ def save_training_checkpoint(
         return
     parent = os.path.dirname(os.path.abspath(directory)) or "."
     os.makedirs(parent, exist_ok=True)
-    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=parent)
+    tmp = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=parent)
     try:
         meta: Dict[str, dict] = {}
         for cid, model in models.items():
@@ -237,7 +290,7 @@ def save_training_checkpoint(
         # then delete the old one
         old = None
         if os.path.isdir(directory):
-            old = tempfile.mkdtemp(prefix=".ckpt-old-", dir=parent)
+            old = tempfile.mkdtemp(prefix=_OLD_PREFIX, dir=parent)
             os.rmdir(old)
             os.replace(directory, old)
         os.replace(tmp, directory)
@@ -246,6 +299,9 @@ def save_training_checkpoint(
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    _sweep_orphans(parent, keep=tmp)
+    if keep_last_n is not None:
+        _prune_numbered_siblings(directory, keep_last_n)
 
 
 def has_checkpoint(directory: str) -> bool:

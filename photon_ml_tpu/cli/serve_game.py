@@ -19,6 +19,12 @@ Usage:
     # serve from a previously exported artifact
     python -m photon_ml_tpu.cli.serve_game \
         --artifact-dir out/artifact --data-dirs data/test
+
+    # additionally hot-swap nearline deltas (update_game output) into the
+    # live scorer between request chunks — no restart, no re-jit
+    python -m photon_ml_tpu.cli.serve_game \
+        --artifact-dir out/artifact --data-dirs data/test \
+        --watch-deltas out/deltas
 """
 
 from __future__ import annotations
@@ -58,6 +64,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "full tables device-resident, no cache)")
     p.add_argument("--max-requests", type=int, default=None,
                    help="replay at most this many rows")
+    p.add_argument("--watch-deltas", default=None,
+                   help="directory of nearline delta artifacts "
+                        "(update_game output); polled between request "
+                        "chunks and hot-swapped into the live scorer")
+    p.add_argument("--watch-chunk", type=int, default=256,
+                   help="requests replayed between delta polls "
+                        "(with --watch-deltas; default 256)")
     p.add_argument("--max-nnz", type=int, default=None,
                    help="padded nonzeros per shard (default: tight "
                         "power-of-two fit to the request stream)")
@@ -180,15 +193,48 @@ def run(args: argparse.Namespace) -> Optional[dict]:
             artifact,
             max_nnz=args.max_nnz if args.max_nnz else max_nnz_of(requests),
             cache_capacity=args.cache_capacity,
+            growth_headroom=bool(args.watch_deltas),
         )
+        from photon_ml_tpu.serving import ServingMetrics
+
+        metrics = ServingMetrics()
+        manager = None
+        if args.watch_deltas:
+            from photon_ml_tpu.incremental import fingerprint_dir
+            from photon_ml_tpu.serving import HotSwapManager
+
+            manager = HotSwapManager(
+                scorer,
+                fingerprint=(
+                    fingerprint_dir(args.artifact_dir)
+                    if args.artifact_dir else None
+                ),
+                metrics=metrics,
+                emitter=emitter,
+                model_id=model_id,
+            )
+            logger.info(
+                "watching %s for delta artifacts (poll every %d requests)",
+                args.watch_deltas, args.watch_chunk,
+            )
         with timer.time("replay"):
             results, snapshot = replay_requests(
                 scorer, requests,
                 bucket_sizes=bucket_sizes,
+                metrics=metrics,
                 emitter=emitter,
                 model_id=model_id,
+                swap_manager=manager,
+                watch_dir=args.watch_deltas,
+                poll_every=args.watch_chunk,
             )
         emitter.clear_listeners()
+        if manager is not None:
+            logger.info(
+                "served through generation %d (%d swap(s))",
+                manager.generation,
+                len(snapshot.get("swap_reports", [])),
+            )
 
         snapshot["model_id"] = model_id
         snapshot["bucket_sizes"] = list(bucket_sizes)

@@ -410,6 +410,58 @@ class GameEstimator:
                 + "\n  ".join(problems)
             )
 
+    def resolve_coordinate(
+        self,
+        cid: str,
+        data: GameData,
+        models: Dict[str, object],
+        initial_model: object = "auto",
+    ):
+        """Warm-started re-solve of ONE coordinate against ``data`` — the
+        single-coordinate slice of a CD outer iteration, exposed for the
+        nearline incremental trainer.
+
+        Builds only this coordinate's dataset over ``data``, scores every
+        OTHER coordinate's current model as the residual offset (standard CD
+        residual algebra), and runs one ``update_model``. For a random-effect
+        coordinate the warm start is re-aligned onto the fresh dataset's
+        entity layout by id (``align_warm_start``) — entities absent from
+        ``data`` are untouched by construction because the dataset only
+        contains the entities present in it; entities absent from the old
+        model start from zero. Returns the re-solved sub-model in the new
+        dataset's layout.
+        """
+        cfg = self.coordinate_configs.get(cid)
+        if cfg is None:
+            raise ValueError(
+                f"unknown coordinate {cid!r}; have {sorted(self.coordinate_configs)}"
+            )
+        if isinstance(cfg, FactoredRandomEffectCoordinateConfiguration):
+            raise ValueError(
+                f"coordinate {cid!r} is factored — single-coordinate re-solve "
+                "supports fixed-effect and plain random-effect coordinates"
+            )
+        coord = self._build_coordinate(cid, cfg, data)
+        meta = self._meta()
+        others = {
+            c: m for c, m in models.items() if c != cid and m is not None
+        }
+        if others:
+            gm = GameModel(
+                models=others,
+                meta={c: meta[c] for c in others},
+                task=self.task,
+            )
+            residual = np.asarray(gm.score(data), dtype=np.float32)
+        else:
+            residual = np.zeros(data.num_rows, dtype=np.float32)
+        model0 = models.get(cid) if initial_model == "auto" else initial_model
+        if isinstance(coord, RandomEffectCoordinate) and model0 is not None:
+            from photon_ml_tpu.estimators.random_effect import align_warm_start
+
+            model0 = align_warm_start(model0, coord.dataset)
+        return coord.update_model(model0, residual)
+
     def fit(
         self,
         data: GameData,

@@ -108,6 +108,57 @@ def train_random_effects(
     return model, results
 
 
+def align_warm_start(
+    model: RandomEffectModel, dataset: RandomEffectDataset
+) -> RandomEffectModel:
+    """Re-layout a trained RE model onto a DIFFERENT dataset's entity/bucket
+    layout so it can warm-start ``train_random_effects`` there.
+
+    ``train_random_effects`` consumes ``initial_model.coefficients[b]``
+    positionally, which is only correct when the model was trained on the
+    same dataset. The nearline path re-solves against a dataset built from a
+    fresh events batch — different entities, different bucket packing,
+    different local feature sets — so the old coefficients must be joined by
+    entity id and re-scattered through the new dataset's projection indices.
+    Entities the old model never saw start from zero (a fresh row).
+    """
+    from photon_ml_tpu.projector import ProjectorType
+
+    if dataset.config.projector is ProjectorType.RANDOM:
+        raise ValueError(
+            "align_warm_start cannot re-scatter into a RANDOM-projected "
+            "dataset: projected local spaces are seed/dim-dependent and "
+            "global-space coefficients do not map back exactly"
+        )
+    coeffs = []
+    for b, bucket in enumerate(dataset.buckets):
+        idx_b = np.asarray(fetch_global(bucket.proj_indices))
+        val_b = np.asarray(fetch_global(bucket.proj_valid))
+        w = np.zeros(idx_b.shape, dtype=np.float32)
+        for e, eid in enumerate(dataset.entity_ids[b]):
+            old = model.coefficients_for(eid)
+            if not old:
+                continue
+            row_idx, row_ok = idx_b[e], val_b[e]
+            for j in range(len(row_idx)):
+                if row_ok[j]:
+                    w[e, j] = old.get(int(row_idx[j]), 0.0)
+        coeffs.append(jnp.asarray(w))
+    return RandomEffectModel(
+        random_effect_type=dataset.config.random_effect_type,
+        task=model.task,
+        coefficients=coeffs,
+        variances=[None] * len(coeffs),
+        proj_indices=[b.proj_indices for b in dataset.buckets],
+        proj_valid=[b.proj_valid for b in dataset.buckets],
+        entity_ids=dataset.entity_ids,
+        entity_to_loc=dataset.entity_to_loc,
+        global_dim=dataset.global_dim,
+        projector_type=dataset.config.projector,
+        projection_seed=dataset.config.seed,
+    )
+
+
 @jax.jit
 def _score_bucket(w: jax.Array, bucket: ReBucket) -> jax.Array:
     return jnp.einsum("esd,ed->es", bucket.X, w)

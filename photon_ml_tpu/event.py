@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 import importlib
 import logging
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _log = logging.getLogger("photon_ml_tpu.event")
 
@@ -73,6 +73,21 @@ class ScoringFinishEvent(Event):
     num_requests: int
     wall_seconds: float
     metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSwapEvent(Event):
+    """A hot-swap attempt on a live scorer (serving.hotswap). Fired for
+    successful swaps AND rollbacks (``rolled_back`` distinguishes them)."""
+
+    model_id: str
+    generation: int
+    fingerprint: Optional[str]
+    coordinates: Tuple[str, ...]
+    rows_updated: int
+    blackout_s: float
+    rolled_back: bool = False
+    validation_metric: Optional[float] = None
 
 
 class EventListener:

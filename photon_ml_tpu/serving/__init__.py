@@ -23,6 +23,10 @@ map onto that design:
   batch fill ratio and cache hit rate as a dict snapshot.
 - :mod:`photon_ml_tpu.serving.replay` — turn a scoring dataset into a
   request stream and pump it through the batcher (CLI + bench driver).
+- :mod:`photon_ml_tpu.serving.hotswap` — apply nearline delta artifacts
+  (``photon_ml_tpu.incremental``) to a live scorer between batches: in-place
+  table mutation with no retrace, per-row cache invalidation, AUC validation
+  gate with rollback to the previous generation.
 """
 
 from photon_ml_tpu.serving.artifact import (
@@ -34,6 +38,11 @@ from photon_ml_tpu.serving.artifact import (
 )
 from photon_ml_tpu.serving.batcher import MicroBatcher
 from photon_ml_tpu.serving.cache import HotEntityCache
+from photon_ml_tpu.serving.hotswap import (
+    HotSwapManager,
+    SwapReport,
+    ValidationGate,
+)
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.replay import replay_requests, requests_from_game_data
 from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
@@ -41,12 +50,15 @@ from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
 __all__ = [
     "GameScorer",
     "HotEntityCache",
+    "HotSwapManager",
     "MicroBatcher",
     "ScoreRequest",
     "ScoreResult",
     "ServingArtifact",
     "ServingMetrics",
     "ServingTable",
+    "SwapReport",
+    "ValidationGate",
     "load_artifact",
     "pack_game_model",
     "replay_requests",

@@ -238,7 +238,44 @@ def _write_phix_map(items: Iterable[Tuple[str, int]], out_dir: str) -> None:
 
 
 def save_artifact(artifact: ServingArtifact, output_dir: str) -> None:
-    """Write the artifact directory (layout in the module docstring)."""
+    """Atomically write the artifact directory (layout in the module
+    docstring): build in a tmp sibling dir, fsync the metadata file, rename
+    over the target — same pattern as ``save_training_checkpoint``. A crash
+    at any point leaves either the previous artifact or the new one, never
+    a half-written directory that ``load_artifact`` would happily open (and
+    a hot-swap watcher would happily serve)."""
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.io.model_io import METADATA_FILE
+
+    parent = os.path.dirname(os.path.abspath(output_dir)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=".artifact-tmp-", dir=parent)
+    try:
+        _write_artifact_contents(artifact, tmp)
+        # the metadata file is written LAST and names every other file;
+        # fsync it so the rename below never exposes an artifact whose
+        # manifest is still in the page cache only
+        fd = os.open(os.path.join(tmp, METADATA_FILE), os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        old = None
+        if os.path.isdir(output_dir):
+            old = tempfile.mkdtemp(prefix=".artifact-old-", dir=parent)
+            os.rmdir(old)
+            os.replace(output_dir, old)
+        os.replace(tmp, output_dir)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def _write_artifact_contents(artifact: ServingArtifact, output_dir: str) -> None:
     os.makedirs(output_dir, exist_ok=True)
     serving: Dict[str, object] = {
         "format_version": SERVING_FORMAT_VERSION,

@@ -8,9 +8,11 @@ scorer sees at most ``len(bucket_sizes)`` distinct shapes, ever.
 
 Draining is synchronous: ``submit`` drains a full max-size batch whenever
 enough requests are pending and returns any completed results; ``flush``
-drains the remainder through the smallest bucket that fits. (A network
-server would put a deadline timer in front of ``flush``; the replay and
-bench drivers call it explicitly.)
+drains the remainder through the smallest bucket that fits. A real server
+runs the deadline policy instead: construct with ``max_wait_s`` and call
+``poll()`` from its event loop — once the OLDEST pending request has
+waited past the deadline, everything pending drains through the smallest
+fitting buckets, bounding queue wait without manual ``flush`` calls.
 """
 
 from __future__ import annotations
@@ -32,7 +34,10 @@ class MicroBatcher:
         bucket_sizes: Sequence[int] = DEFAULT_BUCKET_SIZES,
         metrics: Optional[ServingMetrics] = None,
         clock: Callable[[], float] = time.perf_counter,
+        max_wait_s: Optional[float] = None,
     ):
+        if max_wait_s is not None and max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
         buckets = sorted({int(b) for b in bucket_sizes})
         if not buckets or buckets[0] < 1:
             raise ValueError(f"bucket sizes must be positive, got {bucket_sizes}")
@@ -48,6 +53,7 @@ class MicroBatcher:
         self._scorer = scorer
         self._metrics = metrics
         self._clock = clock
+        self.max_wait_s = max_wait_s
         self._pending: "deque[Tuple[ScoreRequest, float]]" = deque()
 
     @property
@@ -76,8 +82,28 @@ class MicroBatcher:
             out.extend(self._drain(min(len(self._pending), self.max_bucket)))
         return out
 
+    def poll(self, now: Optional[float] = None) -> List[ScoreResult]:
+        """Deadline check: when the OLDEST pending request has waited at
+        least ``max_wait_s``, drain everything pending through the smallest
+        fitting buckets (younger requests ride along — padding slots are
+        cheaper than a second dispatch). Otherwise a no-op. ``now`` defaults
+        to the batcher's clock; pass it explicitly from an event loop that
+        already read the time."""
+        if self.max_wait_s is None:
+            raise ValueError(
+                "poll() needs a deadline: construct the batcher with "
+                "max_wait_s"
+            )
+        if now is None:
+            now = self._clock()
+        out: List[ScoreResult] = []
+        while self._pending and now - self._pending[0][1] >= self.max_wait_s:
+            out.extend(self._drain(min(len(self._pending), self.max_bucket)))
+        return out
+
     def _drain(self, n: int) -> List[ScoreResult]:
         batch = [self._pending.popleft() for _ in range(n)]
+        dequeued = self._clock()
         bucket = self._bucket_for(n)
         results = self._scorer.score_batch([req for req, _ in batch], bucket)
         done = self._clock()
@@ -86,5 +112,6 @@ class MicroBatcher:
                 n_real=n, bucket_size=bucket, queue_depth=len(self._pending)
             )
             for _, enqueued in batch:
+                self._metrics.observe_queue_wait(dequeued - enqueued)
                 self._metrics.observe_latency(done - enqueued)
         return results

@@ -110,6 +110,38 @@ class HotEntityCache:
             f"bucket size"
         )
 
+    def invalidate(self, rows) -> int:
+        """Drop the given backing rows from the device table if resident
+        (hot-swap: only the rows a delta touched get invalidated; everything
+        else stays warm). Freed slots are reused by later misses — stale
+        values linger in device memory but are unreachable. Returns how many
+        resident rows were dropped."""
+        dropped = 0
+        for row in np.asarray(rows, dtype=np.int64).ravel():
+            slot = self._slot_of.pop(int(row), None)
+            if slot is not None:
+                self._free.append(slot)
+                dropped += 1
+        return dropped
+
+    def rebind(self, backing: np.ndarray) -> int:
+        """Point the cache at a new backing store (hot-swap / rollback:
+        the delta-applied table replaces the old array in O(1) — the device
+        table and its resident rows are kept). The caller must ``invalidate``
+        the rows whose CONTENT changed; rows beyond the new store's end
+        (rollback after appends) are dropped here. Returns the number of
+        rows dropped for being out of range."""
+        if backing.ndim != 2 or backing.shape[1] != self._backing.shape[1]:
+            raise ValueError(
+                f"rebind backing shape {backing.shape} incompatible with "
+                f"cached row dim {self._backing.shape[1]}"
+            )
+        out_of_range = [
+            row for row in self._slot_of if row >= backing.shape[0]
+        ]
+        self._backing = backing
+        return self.invalidate(out_of_range) if out_of_range else 0
+
     def hit_rate(self) -> float:
         total = self.hits + self.misses
         return self.hits / total if total else 0.0

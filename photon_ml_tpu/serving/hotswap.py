@@ -1,0 +1,346 @@
+"""Zero-downtime hot-swap of a live scorer's tables from delta artifacts.
+
+The scorer's coefficient tables are jit ARGUMENTS, not captured constants
+(scorer.py), so new table CONTENT never retraces — the swap cost is the
+table mutation itself, not a recompile. The manager turns a published
+delta into the narrowest possible mutation of a live ``GameScorer``:
+
+- fixed effects: same-shape vector replacement;
+- full-table RE coordinates: in-place row scatter on device when the rows
+  fit the table's padding headroom, a rebuild at the next power-of-two
+  size bucket when they don't (the one case that retraces, reported in
+  ``SwapReport.regrew``);
+- cache-backed RE coordinates: O(1) backing-store rebind + invalidation of
+  only the touched rows — everything else stays warm on device.
+
+The mutation runs in one critical section between request batches (the
+*blackout*, microseconds-to-milliseconds); a generation counter tracks the
+live version. An optional validation gate replays a held-out slice through
+the swapped scorer and rolls back to the previous generation when AUC
+regresses past a threshold — the inverse mutation is applied from an undo
+snapshot of exactly the touched rows, so rollback is as cheap as the swap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from photon_ml_tpu.serving.artifact import ServingArtifact
+from photon_ml_tpu.serving.cache import HotEntityCache
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest
+
+_log = logging.getLogger("photon_ml_tpu.serving.hotswap")
+
+
+@dataclasses.dataclass
+class ValidationGate:
+    """Held-out replay slice scored through the swapped scorer: the swap
+    only sticks when AUC does not regress more than ``max_auc_regression``
+    below the previous generation's AUC on the same slice.
+
+    The baseline is (re)measured through the LIVE scorer right before the
+    first swap and after every accepted one, so the comparison is always
+    generation-to-generation on identical requests. Score the slice once
+    through the scorer at startup (or reuse a serving bucket size) to keep
+    the gate itself from compiling during a swap."""
+
+    requests: Sequence[ScoreRequest]
+    labels: np.ndarray
+    max_auc_regression: float = 0.01
+    bucket_size: Optional[int] = None
+
+    def evaluate(self, scorer: GameScorer) -> float:
+        from photon_ml_tpu.evaluation.evaluators import AUC
+
+        bucket = self.bucket_size or len(self.requests)
+        results = []
+        for i in range(0, len(self.requests), bucket):
+            results.extend(scorer.score_batch(
+                self.requests[i:i + bucket], bucket_size=bucket
+            ))
+        scores = np.asarray([r.score for r in results], dtype=np.float32)
+        labels = np.asarray(self.labels, dtype=np.float32)
+        return AUC.evaluate_host(scores, labels, np.ones_like(labels))
+
+
+@dataclasses.dataclass
+class SwapReport:
+    generation: int
+    fingerprint: Optional[str]
+    coordinates: Tuple[str, ...]
+    rows_updated: int
+    blackout_s: float
+    staleness_s: Optional[float]
+    rolled_back: bool
+    validation_metric: Optional[float]
+    baseline_metric: Optional[float]
+    regrew: Tuple[str, ...]  # full tables rebuilt at a larger size bucket
+    compiles_added: int
+
+
+@dataclasses.dataclass
+class _Undo:
+    """Inverse of one swap: enough to restore the previous generation."""
+
+    artifact: ServingArtifact
+    fingerprint: Optional[str]
+    fe: Dict[str, np.ndarray]
+    re_inplace: Dict[str, Tuple[np.ndarray, np.ndarray]]  # cid -> (rows, old)
+    re_rebuilt: Dict[str, object]  # cid -> previous provider object
+    cache_rebinds: Dict[str, Tuple[object, np.ndarray]]  # cid -> (old backing, rows)
+
+
+class HotSwapManager:
+    """Applies delta artifacts to a live :class:`GameScorer`.
+
+    ``fingerprint`` roots the hash chain — pass the base artifact
+    directory's content fingerprint (``incremental.fingerprint_dir``) when
+    serving from disk; ``None`` disables chain verification (in-memory
+    artifacts have no content identity). One level of undo is kept: a
+    failed validation gate (or an explicit ``rollback()``) restores the
+    previous generation."""
+
+    def __init__(
+        self,
+        scorer: GameScorer,
+        fingerprint: Optional[str] = None,
+        gate: Optional[ValidationGate] = None,
+        metrics: Optional[ServingMetrics] = None,
+        emitter=None,
+        model_id: Optional[str] = None,
+        clock=time.time,
+    ):
+        self._scorer = scorer
+        self.fingerprint = fingerprint
+        self.gate = gate
+        self.generation = 0
+        self._metrics = metrics
+        self._emitter = emitter
+        self._model_id = model_id or scorer.artifact.model_name
+        self._clock = clock
+        self._baseline_metric: Optional[float] = None
+        self._undo: Optional[_Undo] = None
+        self._processed_dirs: set = set()
+
+    # ------------------------------------------------------------- swapping
+
+    def apply_delta(self, delta) -> SwapReport:
+        """Swap one delta (a ``DeltaArtifact`` or a delta directory path)
+        into the live scorer. Raises on a broken fingerprint chain; returns
+        a report (``rolled_back=True`` when the validation gate rejected
+        the candidate and the previous generation was restored)."""
+        from photon_ml_tpu.incremental.delta import (
+            DeltaArtifact,
+            apply_delta as fold_delta,
+            load_delta,
+        )
+
+        if not isinstance(delta, DeltaArtifact):
+            delta = load_delta(str(delta))
+        if (
+            self.fingerprint is not None
+            and delta.base_fingerprint is not None
+            and delta.base_fingerprint != self.fingerprint
+        ):
+            raise ValueError(
+                f"delta generation {delta.generation} chains to base "
+                f"{delta.base_fingerprint}, live scorer is at "
+                f"{self.fingerprint} — missing intermediate delta or wrong "
+                "base artifact"
+            )
+
+        old_artifact = self._scorer.artifact
+        candidate = fold_delta(old_artifact, delta)
+
+        # establish the gate baseline through the LIVE scorer before any
+        # mutation (also warms the gate's bucket, so post-swap evaluation
+        # never compiles)
+        if self.gate is not None and self._baseline_metric is None:
+            self._baseline_metric = self.gate.evaluate(self._scorer)
+
+        # plan every mutation (and its inverse) outside the critical section
+        fe_plan: Dict[str, np.ndarray] = dict(delta.fe_updates)
+        undo = _Undo(
+            artifact=old_artifact,
+            fingerprint=self.fingerprint,
+            fe={
+                cid: np.array(old_artifact.tables[cid].weights, dtype=np.float32)
+                for cid in fe_plan
+            },
+            re_inplace={},
+            re_rebuilt={},
+            cache_rebinds={},
+        )
+        inplace_plan: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        rebind_plan: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        cache_plan: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for cid, (ids, _) in delta.re_rows.items():
+            if not ids:
+                continue
+            new_table = candidate.tables[cid]
+            old_table = old_artifact.tables[cid]
+            targets = np.asarray(
+                new_table.entity_index.get_indices(ids), dtype=np.int64
+            )
+            values = np.asarray(new_table.weights, dtype=np.float32)[targets]
+            provider = self._scorer._providers[cid]
+            if isinstance(provider, HotEntityCache):
+                cache_plan[cid] = (np.asarray(new_table.weights), targets)
+                undo.cache_rebinds[cid] = (old_table.weights, targets)
+                continue
+            if targets.max() < provider.capacity:
+                inplace_plan[cid] = (targets, values)
+                n_old = old_table.n_entities
+                old_rows = np.zeros_like(values)
+                in_base = targets < n_old
+                if in_base.any():
+                    old_rows[in_base] = np.asarray(
+                        old_table.weights, dtype=np.float32
+                    )[targets[in_base]]
+                undo.re_inplace[cid] = (targets, old_rows)
+            else:
+                rebind_plan[cid] = (np.asarray(new_table.weights), targets)
+                undo.re_rebuilt[cid] = provider
+
+        # ------------------------- critical section: the blackout -------
+        compiles_before = self._scorer.compile_count
+        t0 = time.perf_counter()
+        regrew: List[str] = []
+        self._scorer.set_artifact(candidate)
+        for cid, w in fe_plan.items():
+            self._scorer.update_fixed_effect(cid, w)
+        for cid, (rows, values) in inplace_plan.items():
+            self._scorer.update_random_effect_rows(cid, rows, values)
+        for cid, (backing, _) in rebind_plan.items():
+            if self._scorer.rebind_random_effect(cid, backing):
+                regrew.append(cid)
+        for cid, (backing, rows) in cache_plan.items():
+            cache = self._scorer.caches[cid]
+            cache.rebind(backing)
+            cache.invalidate(rows)
+        blackout_s = time.perf_counter() - t0
+        # ----------------------------------------------------------------
+
+        self.generation += 1
+        candidate_fp = delta.fingerprint
+        now = self._clock()
+        staleness_s = (
+            max(0.0, now - delta.created_at_unix)
+            if delta.created_at_unix
+            else None
+        )
+
+        validation_metric: Optional[float] = None
+        rolled_back = False
+        if self.gate is not None:
+            validation_metric = self.gate.evaluate(self._scorer)
+            floor = self._baseline_metric - self.gate.max_auc_regression
+            if not validation_metric >= floor:  # NaN fails the gate too
+                _log.warning(
+                    "validation gate failed: AUC %.6f < floor %.6f "
+                    "(baseline %.6f - threshold %g) — rolling back to "
+                    "generation %d",
+                    validation_metric, floor, self._baseline_metric,
+                    self.gate.max_auc_regression, self.generation - 1,
+                )
+                self._undo = undo
+                self.rollback()
+                rolled_back = True
+            else:
+                self._baseline_metric = validation_metric
+        compiles_added = self._scorer.compile_count - compiles_before
+
+        if not rolled_back:
+            self.fingerprint = candidate_fp
+            self._undo = undo
+        report = SwapReport(
+            generation=self.generation,
+            fingerprint=self.fingerprint,
+            coordinates=delta.coordinates(),
+            rows_updated=delta.num_rows_updated,
+            blackout_s=blackout_s,
+            staleness_s=staleness_s,
+            rolled_back=rolled_back,
+            validation_metric=validation_metric,
+            baseline_metric=self._baseline_metric,
+            regrew=tuple(regrew),
+            compiles_added=compiles_added,
+        )
+        if self._metrics is not None:
+            self._metrics.observe_swap(
+                generation=self.generation,
+                rows_updated=report.rows_updated,
+                blackout_s=blackout_s,
+                staleness_s=staleness_s,
+                rolled_back=rolled_back,
+            )
+        if self._emitter is not None:
+            from photon_ml_tpu.event import ModelSwapEvent
+
+            self._emitter.send_event(
+                ModelSwapEvent(
+                    model_id=self._model_id,
+                    generation=self.generation,
+                    fingerprint=self.fingerprint,
+                    coordinates=report.coordinates,
+                    rows_updated=report.rows_updated,
+                    blackout_s=blackout_s,
+                    rolled_back=rolled_back,
+                    validation_metric=validation_metric,
+                )
+            )
+        return report
+
+    def rollback(self) -> None:
+        """Restore the previous generation from the undo snapshot (applies
+        the inverse mutation: old artifact reference, old FE vectors, old
+        rows scattered back, old providers for regrown tables, old cache
+        backings with the touched rows re-invalidated)."""
+        undo = self._undo
+        if undo is None:
+            raise ValueError("no previous generation to roll back to")
+        self._scorer.set_artifact(undo.artifact)
+        for cid, w in undo.fe.items():
+            self._scorer.update_fixed_effect(cid, w)
+        for cid, (rows, old_rows) in undo.re_inplace.items():
+            self._scorer.update_random_effect_rows(cid, rows, old_rows)
+        for cid, provider in undo.re_rebuilt.items():
+            self._scorer._providers[cid] = provider
+        for cid, (backing, rows) in undo.cache_rebinds.items():
+            cache = self._scorer.caches[cid]
+            cache.rebind(np.asarray(backing))
+            cache.invalidate(rows)
+        self.generation -= 1
+        self.fingerprint = undo.fingerprint
+        self._undo = None
+
+    # ------------------------------------------------------------ watching
+
+    def poll_directory(self, watch_dir: str) -> List[SwapReport]:
+        """Apply any newly published deltas under ``watch_dir`` (``delta-*``
+        directories, name order = chain order). Already-processed
+        directories are skipped; a delta whose own fingerprint equals the
+        live one is recognized as already applied. Safe to call from the
+        serving loop between batches."""
+        from photon_ml_tpu.incremental.delta import discover_deltas, load_delta
+
+        reports: List[SwapReport] = []
+        for path in discover_deltas(watch_dir):
+            if path in self._processed_dirs:
+                continue
+            delta = load_delta(path)
+            if (
+                delta.fingerprint is not None
+                and delta.fingerprint == self.fingerprint
+            ):
+                self._processed_dirs.add(path)
+                continue
+            reports.append(self.apply_delta(delta))
+            self._processed_dirs.add(path)
+        return reports

@@ -30,10 +30,19 @@ class ServingMetrics:
         self._fill_real = 0
         self._fill_padded = 0
         self._queue_depths: List[int] = []
+        self._queue_waits: List[float] = []
         self.num_requests = 0
         self.num_batches = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+        # hot-swap counters (fed by serving.hotswap.HotSwapManager)
+        self.num_swaps = 0
+        self.num_rollbacks = 0
+        self.rows_updated_total = 0
+        self.current_generation = 0
+        self._last_swap_blackout_s: Optional[float] = None
+        self._max_swap_blackout_s = 0.0
+        self._last_update_staleness_s: Optional[float] = None
 
     def observe_batch(
         self, n_real: int, bucket_size: int, queue_depth: int
@@ -51,6 +60,37 @@ class ServingMetrics:
     def observe_latency(self, seconds: float) -> None:
         self._latencies.append(float(seconds))
         self._hist[np.searchsorted(LATENCY_BUCKET_BOUNDS, seconds)] += 1
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        """Time a request sat in the batcher queue before its batch was
+        drained — tracked separately from total latency so queueing policy
+        (deadline vs. fill) is visible independently of scoring cost."""
+        self._queue_waits.append(float(seconds))
+
+    def observe_swap(
+        self,
+        generation: int,
+        rows_updated: int,
+        blackout_s: float,
+        staleness_s: Optional[float] = None,
+        rolled_back: bool = False,
+    ) -> None:
+        """One hot-swap attempt. ``blackout_s`` is the time the scorer's
+        tables were mid-flip (no requests may run); ``staleness_s`` is
+        swap-visible time minus the update's event-batch timestamp — how old
+        the freshest served coefficients are at the moment they go live."""
+        self.num_swaps += 1
+        self._last_swap_blackout_s = float(blackout_s)
+        self._max_swap_blackout_s = max(
+            self._max_swap_blackout_s, float(blackout_s)
+        )
+        if rolled_back:
+            self.num_rollbacks += 1
+            return
+        self.current_generation = int(generation)
+        self.rows_updated_total += int(rows_updated)
+        if staleness_s is not None:
+            self._last_update_staleness_s = float(staleness_s)
 
     def snapshot(
         self,
@@ -92,6 +132,32 @@ class ServingMetrics:
                     else "inf"
                 ): int(self._hist[i])
                 for i in nz
+            }
+        if self._queue_waits:
+            qw = np.asarray(self._queue_waits, dtype=np.float64)
+            q50, q99 = np.percentile(qw, [50, 99])
+            out.update(
+                queue_wait_p50_s=round(float(q50), 6),
+                queue_wait_p99_s=round(float(q99), 6),
+                queue_wait_max_s=round(float(qw.max()), 6),
+            )
+        if self.num_swaps:
+            out["swaps"] = {
+                "num_swaps": self.num_swaps,
+                "num_rollbacks": self.num_rollbacks,
+                "current_generation": self.current_generation,
+                "rows_updated_total": self.rows_updated_total,
+                "last_blackout_s": (
+                    round(self._last_swap_blackout_s, 6)
+                    if self._last_swap_blackout_s is not None
+                    else None
+                ),
+                "max_blackout_s": round(self._max_swap_blackout_s, 6),
+                "last_staleness_s": (
+                    round(self._last_update_staleness_s, 6)
+                    if self._last_update_staleness_s is not None
+                    else None
+                ),
             }
         if self._t_first is not None and self._t_last > self._t_first:
             wall = self._t_last - self._t_first

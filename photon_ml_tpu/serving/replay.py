@@ -85,13 +85,20 @@ def replay_requests(
     metrics: Optional[ServingMetrics] = None,
     emitter=None,
     model_id: str = "game-model",
+    swap_manager=None,
+    watch_dir: Optional[str] = None,
+    poll_every: int = 256,
 ) -> Tuple[List[ScoreResult], dict]:
     """Pump a request stream through a fresh microbatcher.
 
     Returns (results in submission order, metrics snapshot). When an
     ``EventEmitter`` is given, a ``ScoringStartEvent`` fires before the
     first request and a ``ScoringFinishEvent`` (carrying the snapshot)
-    after the flush.
+    after the flush. When a ``HotSwapManager`` and ``watch_dir`` are given,
+    the batcher is flushed and ``swap_manager.poll_directory(watch_dir)``
+    called every ``poll_every`` requests — new deltas land between batches,
+    never under an in-flight one; swap reports ride in the snapshot under
+    ``"swap_reports"``.
     """
     from photon_ml_tpu.event import ScoringFinishEvent, ScoringStartEvent
 
@@ -101,9 +108,15 @@ def replay_requests(
         emitter.send_event(
             ScoringStartEvent(model_id=model_id, num_requests=len(requests))
         )
+    watching = swap_manager is not None and watch_dir is not None
+    poll_every = max(1, int(poll_every))
+    swap_reports: List[object] = []
     t0 = time.perf_counter()
     results: List[ScoreResult] = []
-    for req in requests:
+    for i, req in enumerate(requests):
+        if watching and i % poll_every == 0:
+            results.extend(batcher.flush())
+            swap_reports.extend(swap_manager.poll_directory(watch_dir))
         results.extend(batcher.submit(req))
     results.extend(batcher.flush())
     wall = time.perf_counter() - t0
@@ -114,6 +127,17 @@ def replay_requests(
     snapshot["replay_wall_seconds"] = round(wall, 6)
     if wall > 0:
         snapshot["replay_requests_per_s"] = round(len(requests) / wall, 3)
+    if watching:
+        snapshot["swap_reports"] = [
+            {
+                "generation": r.generation,
+                "fingerprint": r.fingerprint,
+                "rows_updated": r.rows_updated,
+                "rolled_back": r.rolled_back,
+                "blackout_s": round(r.blackout_s, 6),
+            }
+            for r in swap_reports
+        ]
     if emitter is not None:
         emitter.send_event(
             ScoringFinishEvent(

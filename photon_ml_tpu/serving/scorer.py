@@ -50,27 +50,62 @@ class ScoreResult:
 
 class _FullTable:
     """No-cache RE row provider: whole table device-resident, plus the
-    trailing zero cold row. Same lookup contract as HotEntityCache."""
+    trailing zero cold row. Same lookup contract as HotEntityCache.
 
-    def __init__(self, backing: np.ndarray):
+    ``pad_rows`` reserves headroom BETWEEN the live rows and the cold slot
+    (device shape ``[pad_rows + 1, dim]``, cold slot at ``pad_rows``): a
+    hot-swap can then append new entities into the zero headroom rows
+    without changing the table shape — and therefore without retracing the
+    jit'd scorer. The headroom rows are all-zero until claimed, so an
+    accidental gather of one degrades to the FE-only score, same as cold.
+    """
+
+    def __init__(self, backing: np.ndarray, pad_rows: Optional[int] = None):
         import jax.numpy as jnp
 
         n, dim = backing.shape
+        pad = n if pad_rows is None else max(int(pad_rows), n)
         self._table = jnp.concatenate(
             [
                 jnp.asarray(np.ascontiguousarray(backing, dtype=np.float32)),
-                jnp.zeros((1, dim), dtype=jnp.float32),
+                jnp.zeros((pad - n + 1, dim), dtype=jnp.float32),
             ]
         )
-        self.cold_slot = n
+        self.num_rows = n  # live rows; grows as headroom is claimed
+        self.cold_slot = pad
 
     @property
     def table(self):
         return self._table
 
+    @property
+    def capacity(self) -> int:
+        """Rows the device table can hold without a shape change."""
+        return self.cold_slot
+
     def lookup(self, entity_rows: np.ndarray) -> np.ndarray:
         rows = np.asarray(entity_rows, dtype=np.int64)
         return np.where(rows < 0, self.cold_slot, rows).astype(np.int32)
+
+    def update_rows(self, rows: np.ndarray, values: np.ndarray) -> None:
+        """In-place row update/append on device — no shape change, no
+        retrace. Rows must fit below the cold slot; the hot-swap manager
+        rebuilds the provider at the next size bucket when they don't."""
+        import jax.numpy as jnp
+
+        rows = np.asarray(rows, dtype=np.int64)
+        if rows.size == 0:
+            return
+        if rows.min() < 0 or rows.max() >= self.cold_slot:
+            raise ValueError(
+                f"row update [{rows.min()}, {rows.max()}] exceeds table "
+                f"capacity {self.cold_slot} — table must grow (re-pad to "
+                "the next size bucket)"
+            )
+        self._table = self._table.at[rows].set(
+            jnp.asarray(np.ascontiguousarray(values, dtype=np.float32))
+        )
+        self.num_rows = max(self.num_rows, int(rows.max()) + 1)
 
     def stats(self) -> Dict[str, float]:
         return {}
@@ -85,6 +120,11 @@ class GameScorer:
       full RE table device-resident; an int puts an LRU
       :class:`HotEntityCache` in front of the host backing store (must be
       >= the largest batch the caller will score).
+    - ``growth_headroom``: pad full device-resident RE tables to the next
+      power-of-two size bucket so a hot-swap can append new entities
+      in-shape (no retrace). Cached coordinates have a fixed device shape
+      and never need it. Off by default — steady-state memory is the
+      padded bucket.
     """
 
     def __init__(
@@ -92,6 +132,7 @@ class GameScorer:
         artifact: ServingArtifact,
         max_nnz: Optional[Union[int, Dict[str, int]]] = None,
         cache_capacity: Optional[int] = None,
+        growth_headroom: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -116,6 +157,7 @@ class GameScorer:
         self._re_specs: List[Tuple[str, str, str]] = []  # (cid, shard, re_type)
         self.caches: Dict[str, HotEntityCache] = {}
         self._providers: Dict[str, object] = {}
+        self._growth_headroom = bool(growth_headroom)
         fe_params: Dict[str, object] = {}
         for cid in sorted(artifact.tables):
             table = artifact.tables[cid]
@@ -128,7 +170,10 @@ class GameScorer:
                     self.caches[cid] = cache
                     self._providers[cid] = cache
                 else:
-                    self._providers[cid] = _FullTable(np.asarray(table.weights))
+                    self._providers[cid] = _FullTable(
+                        np.asarray(table.weights),
+                        pad_rows=self._pad_rows_for(table.n_entities),
+                    )
             else:
                 self._fe_specs.append((cid, table.feature_shard))
                 fe_params[cid] = jnp.asarray(
@@ -165,8 +210,110 @@ class GameScorer:
     def task(self):
         return self._task
 
+    @property
+    def artifact(self) -> ServingArtifact:
+        return self._artifact
+
     def cache_stats(self) -> Dict[str, Dict[str, float]]:
         return {cid: c.stats() for cid, c in self.caches.items()}
+
+    # ------------------------------------------------------ hot-swap hooks
+
+    def _pad_rows_for(self, n: int) -> Optional[int]:
+        """Full-table headroom: pad to the next power-of-two size bucket so
+        moderate entity growth stays in-shape (None = tight, no headroom)."""
+        if not self._growth_headroom:
+            return None
+        bucket = 1
+        while bucket <= n:  # strictly greater: never a zero-headroom bucket
+            bucket <<= 1
+        return bucket
+
+    def set_artifact(self, artifact: ServingArtifact) -> None:
+        """Flip the scorer's artifact reference (entity indexes, dims) to a
+        delta-applied candidate. The candidate must keep the coordinate
+        structure — same coordinate ids, shards, RE types, and FE dims — or
+        the jit'd score function would no longer match; table CONTENT is
+        swapped separately via ``update_fixed_effect`` /
+        ``update_random_effect_rows`` / ``rebind_random_effect``."""
+        fe = [
+            (cid, t.feature_shard)
+            for cid, t in sorted(artifact.tables.items())
+            if not t.is_random_effect
+        ]
+        re = [
+            (cid, t.feature_shard, t.random_effect_type)
+            for cid, t in sorted(artifact.tables.items())
+            if t.is_random_effect
+        ]
+        if fe != self._fe_specs or re != self._re_specs:
+            raise ValueError(
+                "candidate artifact changes the coordinate structure "
+                f"(have fe={self._fe_specs} re={self._re_specs}, candidate "
+                f"fe={fe} re={re}) — a structural change needs a new scorer, "
+                "not a hot swap"
+            )
+        for cid, shard in self._fe_specs:
+            if artifact.tables[cid].dim != self._artifact.tables[cid].dim:
+                raise ValueError(
+                    f"candidate artifact changes fixed-effect dim of {cid!r}"
+                )
+        self._artifact = artifact
+
+    def update_fixed_effect(self, cid: str, weights: np.ndarray) -> None:
+        """Replace one FE coefficient vector in place (same shape — the
+        params are jit ARGUMENTS, so new content never retraces)."""
+        import jax.numpy as jnp
+
+        old = self._fe_params.get(cid)
+        if old is None:
+            raise ValueError(f"{cid!r} is not a fixed-effect coordinate")
+        w = np.ascontiguousarray(weights, dtype=np.float32)
+        if w.shape != old.shape:
+            raise ValueError(
+                f"fixed-effect update for {cid!r} has shape {w.shape}, "
+                f"scorer holds {old.shape}"
+            )
+        self._fe_params[cid] = jnp.asarray(w)
+
+    def update_random_effect_rows(
+        self, cid: str, rows: np.ndarray, values: np.ndarray
+    ) -> None:
+        """In-place update/append of full-table RE rows on device (raises
+        if the rows exceed the table's headroom — then use
+        ``rebind_random_effect``). Cached coordinates take content changes
+        through ``rebind_random_effect`` + cache invalidation instead."""
+        provider = self._providers.get(cid)
+        if provider is None:
+            raise ValueError(f"{cid!r} is not a random-effect coordinate")
+        if isinstance(provider, HotEntityCache):
+            raise ValueError(
+                f"{cid!r} is cache-backed; rebind its backing store and "
+                "invalidate the touched rows instead of updating in place"
+            )
+        provider.update_rows(rows, values)
+
+    def rebind_random_effect(self, cid: str, backing: np.ndarray) -> bool:
+        """Point one RE coordinate at a new backing table.
+
+        Cache-backed: O(1) pointer swap, device shape unchanged → never
+        retraces (the caller invalidates the rows whose content changed).
+        Full-table: rebuilds the device table — same shape when the new
+        row count fits the current padding bucket, next bucket otherwise
+        (one expected retrace). Returns True when the device table shape
+        changed."""
+        provider = self._providers.get(cid)
+        if provider is None:
+            raise ValueError(f"{cid!r} is not a random-effect coordinate")
+        if isinstance(provider, HotEntityCache):
+            provider.rebind(backing)
+            return False
+        n = backing.shape[0]
+        pad = self._pad_rows_for(n)
+        rebuilt = _FullTable(np.asarray(backing), pad_rows=pad)
+        shape_changed = rebuilt.table.shape != provider.table.shape
+        self._providers[cid] = rebuilt
+        return shape_changed
 
     def _featurize(self, requests: Sequence[ScoreRequest], bucket: int):
         shards = {}

@@ -193,3 +193,59 @@ class TestResume:
         assert (ck / "training-state.json").is_file()
         payload = json.loads((ck / "training-state.json").read_text())
         assert payload["state"]["completed_iterations"] == 2
+
+class TestRetention:
+    def test_orphan_sweep_after_successful_save(self, rng, tmp_path):
+        """A kill between the two renames leaks .ckpt-tmp-*/.ckpt-old-*
+        siblings; the next successful save sweeps them."""
+        from photon_ml_tpu import checkpoint as ckpt
+
+        data, _ = _problem(rng)
+        fit = _estimator(num_outer=1).fit(data)
+        for name in (".ckpt-tmp-dead", ".ckpt-old-dead"):
+            (tmp_path / name).mkdir()
+            (tmp_path / name / "junk.json").write_text("{}")
+        ckpt.save_training_checkpoint(
+            str(tmp_path / "c"), fit.model.models,
+            state={"completed_iterations": 1},
+        )
+        leftovers = [
+            p for p in os.listdir(tmp_path)
+            if p.startswith((".ckpt-tmp-", ".ckpt-old-"))
+        ]
+        assert leftovers == []
+        ckpt.load_training_checkpoint(str(tmp_path / "c"))
+
+    def test_keep_last_n_prunes_numbered_siblings(self, rng, tmp_path):
+        from photon_ml_tpu import checkpoint as ckpt
+
+        data, _ = _problem(rng)
+        fit = _estimator(num_outer=1).fit(data)
+        # an unrelated non-checkpoint dir matching nothing must survive
+        (tmp_path / "notes").mkdir()
+        for i in range(1, 5):
+            ckpt.save_training_checkpoint(
+                str(tmp_path / f"ckpt-{i:06d}"), fit.model.models,
+                state={"completed_iterations": i},
+                keep_last_n=2,
+            )
+        kept = sorted(
+            p for p in os.listdir(tmp_path) if p.startswith("ckpt-")
+        )
+        assert kept == ["ckpt-000003", "ckpt-000004"]
+        assert (tmp_path / "notes").is_dir()
+        _, state, _ = ckpt.load_training_checkpoint(
+            str(tmp_path / "ckpt-000004")
+        )
+        assert state["completed_iterations"] == 4
+
+    def test_keep_last_n_requires_numbered_name(self, rng, tmp_path):
+        from photon_ml_tpu import checkpoint as ckpt
+
+        data, _ = _problem(rng)
+        fit = _estimator(num_outer=1).fit(data)
+        with pytest.raises(ValueError, match="iteration-numbered"):
+            ckpt.save_training_checkpoint(
+                str(tmp_path / "latest"), fit.model.models,
+                state={"completed_iterations": 1}, keep_last_n=3,
+            )

@@ -278,6 +278,89 @@ class TestMetrics:
         snap = ServingMetrics().snapshot()
         assert snap["num_requests"] == 0
         assert "latency_p99_s" not in snap
+        assert "queue_wait_p99_s" not in snap
+        assert "swaps" not in snap
+
+    def test_queue_wait_separate_from_latency(self):
+        metrics = ServingMetrics()
+        for _ in range(4):
+            metrics.observe_queue_wait(0.002)
+            metrics.observe_latency(0.010)
+        snap = metrics.snapshot()
+        assert snap["queue_wait_p50_s"] == pytest.approx(0.002)
+        assert (
+            snap["queue_wait_p50_s"]
+            <= snap["queue_wait_p99_s"]
+            <= snap["queue_wait_max_s"]
+        )
+        assert snap["latency_p50_s"] == pytest.approx(0.010)
+
+    def test_swap_counters(self):
+        metrics = ServingMetrics()
+        metrics.observe_swap(
+            generation=1, rows_updated=12, blackout_s=0.01, staleness_s=2.5
+        )
+        metrics.observe_swap(
+            generation=1, rows_updated=0, blackout_s=0.02, rolled_back=True
+        )
+        swaps = metrics.snapshot()["swaps"]
+        assert swaps["num_swaps"] == 2 and swaps["num_rollbacks"] == 1
+        # a rollback never advances the generation or the row counters
+        assert swaps["current_generation"] == 1
+        assert swaps["rows_updated_total"] == 12
+        assert swaps["max_blackout_s"] == pytest.approx(0.02)
+        assert swaps["last_staleness_s"] == pytest.approx(2.5)
+
+
+class TestBatcherDeadline:
+    def test_poll_drains_on_deadline(self, glmix):
+        """Deadline policy: nothing drains before max_wait_s; once the
+        OLDEST pending request times out, everything pending rides along."""
+        data, _, artifact = glmix
+        scorer = GameScorer(artifact)
+        now = [0.0]
+        metrics = ServingMetrics()
+        batcher = MicroBatcher(
+            scorer, bucket_sizes=(4, 16), metrics=metrics,
+            clock=lambda: now[0], max_wait_s=0.005,
+        )
+        requests = requests_from_game_data(data, artifact)[:3]
+        for r in requests:
+            batcher.submit(r)
+            now[0] += 0.001
+        assert batcher.poll() == []  # oldest has waited 2ms < 5ms
+        assert batcher.queue_depth == 3
+        now[0] = 0.006
+        out = batcher.poll()
+        assert len(out) == 3 and batcher.queue_depth == 0
+        snap = metrics.snapshot()
+        # queue wait is measured enqueue->dequeue, separate from latency
+        assert snap["queue_wait_max_s"] == pytest.approx(0.006)
+        assert snap["queue_wait_p50_s"] <= snap["queue_wait_max_s"]
+        assert snap["num_batches"] == 1
+
+    def test_poll_accepts_external_now(self, glmix):
+        data, _, artifact = glmix
+        scorer = GameScorer(artifact)
+        batcher = MicroBatcher(
+            scorer, bucket_sizes=(4,), clock=lambda: 0.0, max_wait_s=1.0,
+        )
+        batcher.submit(requests_from_game_data(data, artifact)[0])
+        assert batcher.poll(now=0.5) == []
+        assert len(batcher.poll(now=1.5)) == 1
+
+    def test_poll_without_deadline_raises(self, glmix):
+        _, _, artifact = glmix
+        batcher = MicroBatcher(GameScorer(artifact), bucket_sizes=(4,))
+        with pytest.raises(ValueError, match="max_wait_s"):
+            batcher.poll()
+
+    def test_negative_deadline_rejected(self, glmix):
+        _, _, artifact = glmix
+        with pytest.raises(ValueError, match="max_wait_s"):
+            MicroBatcher(
+                GameScorer(artifact), bucket_sizes=(4,), max_wait_s=-0.1
+            )
 
 
 class TestArtifact:
