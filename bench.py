@@ -923,6 +923,142 @@ def _incremental_bench():
         sys.exit(1)
 
 
+# --- adaptive random-effect solve bench ------------------------------------
+N_AD_ENT = 64 if _SMOKE else 1024           # entities in the skewed bucket
+N_AD_HARD = 6 if _SMOKE else 64             # slow-converging tail entities
+S_AD_MIN, S_AD_MAX = 5, 500                 # samples/entity (ISSUE workload)
+D_AD = 6                                    # per-entity feature dim
+_RE_ADAPTIVE_PATH = os.path.join(_REPO, "BENCH_RE_ADAPTIVE.json")
+
+
+def _re_adaptive_bench():
+    """Benchmark the convergence-adaptive random-effect driver against the
+    one-shot lockstep vmap on a skewed-convergence warm-started workload:
+    most entities are warm-started at their optimum (converge in a couple of
+    iterations), a small tail sees fresh near-separable data and runs long —
+    the nearline re-solve profile. Reports wall-clock speedup and
+    lane-iteration efficiency from SolverStats, and writes
+    BENCH_RE_ADAPTIVE.json. Emits ONE JSON line; an exception emits an
+    error line instead."""
+    import sys
+    import time as _time
+
+    try:
+        import jax
+
+        if _SMOKE:
+            jax.config.update("jax_platforms", "cpu")
+        from photon_ml_tpu.data import (
+            RandomEffectDataConfiguration,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.estimators.random_effect import train_random_effects
+        from photon_ml_tpu.opt import (
+            AdaptiveSolveConfig,
+            GlmOptimizationConfiguration,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.types import RegularizationType, TaskType
+
+        rng = np.random.default_rng(SEED)
+        rows, cols, vals, ids = [], [], [], []
+        labels_base, labels_fresh = [], []
+        r = 0
+        for e in range(N_AD_ENT):
+            eid = f"m{e:05d}"
+            hard = e < N_AD_HARD
+            n_e = S_AD_MAX if hard else int(rng.integers(S_AD_MIN, 30))
+            w_e = rng.normal(size=D_AD).astype(np.float32) * 0.5
+            w_fresh = rng.normal(size=D_AD).astype(np.float32) * 10.0
+            for _ in range(n_e):
+                x = rng.normal(size=D_AD).astype(np.float32)
+                z = float(x @ w_e)
+                yb = 1.0 if rng.random() < 1.0 / (1.0 + np.exp(-z)) else 0.0
+                # the tail's fresh batch is near-separable: many iterations
+                yf = yb if not hard else (1.0 if float(x @ w_fresh) > 0 else 0.0)
+                for c in range(D_AD):
+                    rows.append(r)
+                    cols.append(c)
+                    vals.append(float(x[c]))
+                ids.append(eid)
+                labels_base.append(yb)
+                labels_fresh.append(yf)
+                r += 1
+
+        dcfg = RandomEffectDataConfiguration(random_effect_type="m", num_buckets=1)
+
+        def _ds(lab):
+            return build_random_effect_dataset(
+                ids, np.array(rows), np.array(cols),
+                np.array(vals, np.float32), D_AD,
+                np.array(lab, np.float32), dcfg,
+            )
+
+        ds_base, ds_fresh = _ds(labels_base), _ds(labels_fresh)
+        base = dict(
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=1e-6,
+        )
+        cfg_ad = GlmOptimizationConfiguration(
+            **base, adaptive=AdaptiveSolveConfig(enabled=True)
+        )
+        cfg_os = GlmOptimizationConfiguration(
+            **base, adaptive=AdaptiveSolveConfig(enabled=False)
+        )
+        task = TaskType.LOGISTIC_REGRESSION
+
+        warm, _ = train_random_effects(ds_base, task, cfg_os)
+
+        def _run(cfg, stats=None):
+            t0 = _time.perf_counter()
+            train_random_effects(
+                ds_fresh, task, cfg, initial_model=warm, stats_out=stats
+            )
+            return _time.perf_counter() - t0
+
+        _run(cfg_ad)  # compile both paths before timing
+        _run(cfg_os)
+        reps = 2 if _SMOKE else 5
+        stats: list = []
+        adaptive_s = min(_run(cfg_ad, stats if i == 0 else None) for i in range(reps))
+        oneshot_s = min(_run(cfg_os) for _ in range(reps))
+
+        executed = sum(s.executed_lane_iterations for s in stats)
+        lockstep = sum(s.lockstep_lane_iterations for s in stats)
+        payload = {
+            "metric": "re_adaptive_speedup",
+            "value": round(oneshot_s / adaptive_s, 4) if adaptive_s > 0 else None,
+            "unit": "x_vs_oneshot",
+            "adaptive_wall_s": round(adaptive_s, 6),
+            "oneshot_wall_s": round(oneshot_s, 6),
+            "executed_lane_iterations": int(executed),
+            "lockstep_lane_iterations": int(lockstep),
+            "lane_iteration_savings": (
+                round(lockstep / executed, 4) if executed else None
+            ),
+            "wasted_lane_fraction": (
+                round(max(s.wasted_lane_fraction for s in stats), 4)
+                if stats else None
+            ),
+            "rounds": [s.rounds for s in stats],
+            "dispatch_widths": [list(s.dispatch_widths) for s in stats],
+            "chunk_iters": cfg_ad.adaptive.chunk_iters,
+            "n_entities": N_AD_ENT,
+            "n_hard": N_AD_HARD,
+            "backend": jax.default_backend(),
+        }
+        print(json.dumps(payload))
+        if not _SMOKE or _env_flag("BENCH_RE_ADAPTIVE_WRITE"):
+            with open(_RE_ADAPTIVE_PATH, "w") as f:
+                json.dump(payload, f, indent=2)
+    except Exception as e:  # noqa: BLE001 - one JSON line per exit path
+        print(json.dumps({
+            "metric": "re_adaptive_speedup",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
+
+
 def main():
     """Every exit path emits one JSON line: an uncaught exception anywhere
     (e.g. the tunnel dying mid-phase with the headline already measured)
@@ -970,6 +1106,14 @@ def _main():
              "zero-re-jit hot-swap; reports update latency and swap "
              "blackout, and writes BENCH_INCREMENTAL.json",
     )
+    ap.add_argument(
+        "--re-adaptive", action="store_true",
+        help="run the adaptive random-effect solve benchmark instead of the "
+             "training bench: chunked rounds + lane compaction vs one-shot "
+             "lockstep on a skewed-convergence warm-started workload; "
+             "reports wall-clock speedup and lane-iteration savings, and "
+             "writes BENCH_RE_ADAPTIVE.json",
+    )
     args = ap.parse_args()
 
     if args.serving:
@@ -977,6 +1121,9 @@ def _main():
         return
     if args.incremental:
         _incremental_bench()
+        return
+    if args.re_adaptive:
+        _re_adaptive_bench()
         return
 
     watchdog_s = int(os.environ.get("BENCH_WATCHDOG_S", "2700"))

@@ -197,6 +197,9 @@ class RandomEffectCoordinate(Coordinate):
     last_tracker: Optional[RandomEffectOptimizationTracker] = dataclasses.field(
         default=None, repr=False
     )
+    # per-bucket SolverStats from the most recent update (the convergence-
+    # adaptive driver's lane-efficiency telemetry; empty before any update)
+    last_solver_stats: list = dataclasses.field(default_factory=list, repr=False)
     # multi-chip: shard each bucket's entity axis over these mesh axes
     # (entity solves are independent — no collectives); re-applied after
     # every offset rebuild
@@ -219,10 +222,12 @@ class RandomEffectCoordinate(Coordinate):
         ds = self._place(
             self.dataset.update_offsets(self.base_offsets + residual_scores)
         )
+        stats: list = []
         new_model, results = train_random_effects(
             ds, self.task, self.configuration, initial_model=model,
-            compute_variances=self.compute_variances,
+            compute_variances=self.compute_variances, stats_out=stats,
         )
+        self.last_solver_stats = stats
         # entity lanes beyond the real ids (mesh padding) carry zero weights
         # and all-invalid projections: their solves are trivial, their
         # coefficients are forced to 0 by the proj_valid mask, and the

@@ -47,6 +47,7 @@ class CoordinateDescent:
         ] = None,
         validate: Optional[Callable[[Dict[str, object]], float]] = None,
         validation_better_than: Optional[Callable[[float, float], bool]] = None,
+        emitter: Optional[object] = None,
     ) -> None:
         if not coordinates:
             raise ValueError("need at least one coordinate")
@@ -65,6 +66,22 @@ class CoordinateDescent:
         # Evaluator.better_than semantics (larger/smaller-is-better + NaN
         # policy) come from the evaluator itself; default: larger is better.
         self.validation_better_than = validation_better_than or nan_aware_better_than
+        # optional event.EventEmitter: per-bucket SolverStatsEvent after each
+        # random-effect coordinate update (adaptive-solve lane telemetry)
+        self.emitter = emitter
+
+    def _emit_solver_stats(self, cid: str, coord: Coordinate) -> None:
+        stats = getattr(coord, "last_solver_stats", None)
+        if not stats:
+            return
+        for s in stats:
+            logger.info("CD coordinate %s: %s", cid, s.to_summary_string())
+        if self.emitter is None:
+            return
+        from photon_ml_tpu.event import SolverStatsEvent
+
+        for s in stats:
+            self.emitter.send_event(SolverStatsEvent.from_stats(cid, s))
 
     def run(
         self,
@@ -109,6 +126,7 @@ class CoordinateDescent:
                 model = coord.update_model(models.get(cid), residual)
                 models[cid] = model
                 scores[cid] = coord.score(model)
+                self._emit_solver_stats(cid, coord)
 
                 if self.training_objective is not None:
                     loss_val = float(self.training_objective(total_score()))

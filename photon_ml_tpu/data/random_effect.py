@@ -529,7 +529,14 @@ def build_random_effect_dataset(
         if E == 0:
             continue
         bi = len(buckets)
-        new_e = np.cumsum(ent_m) - 1  # entity code -> row within bucket
+        # Cost-sorted dispatch: entity rows within the bucket are ordered by
+        # DESCENDING active sample count (stable), so lockstep lanes carry
+        # similar per-iteration work and the adaptive driver's compacted
+        # prefixes keep heavy (slow-converging) entities co-scheduled.
+        codes_b = np.nonzero(ent_m)[0]
+        order_b = np.argsort(-acounts[codes_b], kind="stable")
+        new_e = np.zeros(n_ent, dtype=np.int64)  # entity code -> row within bucket
+        new_e[codes_b[order_b]] = np.arange(E, dtype=np.int64)
         S = int(acounts[ent_m].max())
         D = int(
             rproj.projected_dim
@@ -595,7 +602,7 @@ def build_random_effect_dataset(
                     jcol = ii_c - dstart[pe]
                     pX[rep_p[match], jcol[match]] = pv_[match]
 
-        ids_b = uniq[ent_m].tolist()
+        ids_b = uniq[codes_b[order_b]].tolist()
         entity_to_loc.update(
             (eid, (bi, e)) for e, eid in enumerate(ids_b)
         )

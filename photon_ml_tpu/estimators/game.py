@@ -187,6 +187,7 @@ class GameEstimator:
         parallel: Optional[ParallelConfiguration] = None,
         extra_evaluators: Sequence[Evaluator] = (),
         compute_variance: bool = False,
+        emitter: Optional[object] = None,
     ) -> None:
         """``normalization``/``intercept_indices`` are per-feature-shard;
         they apply to fixed-effect coordinates (training runs in normalized
@@ -214,6 +215,11 @@ class GameEstimator:
         # coefficient variances to FE and RE models (not the factored/MF
         # coordinate — random-projection variances don't back-project)
         self.compute_variance = compute_variance
+        # optional event.EventEmitter for SolverStatsEvent telemetry from the
+        # CD driver (adaptive random-effect lane efficiency)
+        self.emitter = emitter
+        # per-bucket SolverStats from the most recent resolve_coordinate call
+        self.last_resolve_stats: list = []
 
     def _build_coordinate(
         self, cid: str, cfg: CoordinateConfiguration, data: GameData
@@ -460,7 +466,16 @@ class GameEstimator:
             from photon_ml_tpu.estimators.random_effect import align_warm_start
 
             model0 = align_warm_start(model0, coord.dataset)
-        return coord.update_model(model0, residual)
+        updated = coord.update_model(model0, residual)
+        # warm-started nearline re-solves have the largest iteration skew —
+        # surface the adaptive driver's lane telemetry to the caller
+        self.last_resolve_stats = list(getattr(coord, "last_solver_stats", []))
+        if self.emitter is not None and self.last_resolve_stats:
+            from photon_ml_tpu.event import SolverStatsEvent
+
+            for s in self.last_resolve_stats:
+                self.emitter.send_event(SolverStatsEvent.from_stats(cid, s))
+        return updated
 
     def fit(
         self,
@@ -663,6 +678,7 @@ class GameEstimator:
             regularization_term=regularization_term,
             validate=validate,
             validation_better_than=self.evaluator.better_than,
+            emitter=self.emitter,
         )
 
         start_iteration = 0

@@ -8,12 +8,25 @@ bucket becomes ONE jit-compiled program: ``vmap(solver)`` over the entity
 axis — every entity's full L-BFGS/TRON/OWL-QN while_loop runs in lockstep
 lanes on the MXU with zero cross-entity communication. Sharding the entity
 axis over a mesh scales this to a pod with no collectives in the solve.
+
+Convergence-adaptive driver: a lockstep dispatch runs until its SLOWEST
+entity converges, so on skewed workloads most lanes burn dead iterations.
+When ``configuration.adaptive.enabled`` the per-bucket solve instead runs in
+chunks of K outer iterations (full solver state — L-BFGS memory, OWL-QN
+orthant state, TRON trust radius — carried across chunks, so the per-lane
+trajectory is IDENTICAL to one-shot), pulls the converged mask after each
+chunk, compacts unconverged entities into a dense prefix (stable argsort on
+the mask + one gather program), and re-dispatches survivors at the next
+smaller power-of-two lane count. Compiled programs per (optimizer, bucket
+shape) are bounded by the pow2 ladder and verified by ``solver_trace_counts``.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import List, Optional
+import functools
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,9 +41,29 @@ from photon_ml_tpu.models.random_effect import RandomEffectModel
 from photon_ml_tpu.ops.data import LabeledData
 from photon_ml_tpu.ops.features import DenseFeatures
 from photon_ml_tpu.opt.config import GlmOptimizationConfiguration
-from photon_ml_tpu.opt.solve import solve
+from photon_ml_tpu.opt.solve import (
+    solve,
+    solve_chunk,
+    solve_finalize,
+    solve_init,
+    solver_kind,
+)
 from photon_ml_tpu.opt.state import SolveResult
-from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.opt.tracking import SolverStats
+from photon_ml_tpu.types import ConvergenceReason, TaskType
+
+_NOT_CONVERGED = ConvergenceReason.NOT_CONVERGED.value
+
+# Python-side jit-cache-miss counter: each key is (program, optimizer kind)
+# and its count only grows when XLA actually (re)traces that program — the
+# increment sits inside the traced body, which never executes on cache hits.
+# Tests use this to assert the pow2 ladder bounds compilation.
+_TRACE_COUNTS: "collections.Counter[Tuple[str, str]]" = collections.Counter()
+
+
+def solver_trace_counts() -> Dict[Tuple[str, str], int]:
+    """Snapshot of the RE solver jit trace counters (testing/telemetry)."""
+    return dict(_TRACE_COUNTS)
 
 
 def _bucket_data(bucket: ReBucket) -> LabeledData:
@@ -43,54 +76,305 @@ def _bucket_data(bucket: ReBucket) -> LabeledData:
     )
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def _is_multi_device(x) -> bool:
+    sharding = getattr(x, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return len(sharding.device_set) > 1
+    except Exception:  # noqa: BLE001 - sharding APIs vary across jax versions
+        return False
+
+
+class _RePrograms(NamedTuple):
+    """Jitted programs for one (task, configuration, compute_variances)
+    combination. jax.jit specializes each per input shape, so the compiled
+    program count is (#pow2 widths) per bucket shape — never per round."""
+
+    kind: str
+    chunk_iters: int
+    oneshot: Callable    # (w0, data, pv, l2, l1) -> (SolveResult, w_masked, var|None)
+    init: Callable       # (w0, data, l2, l1) -> batched solver state
+    chunk: Callable      # (state, data, l2) -> state advanced by <= K iters
+    extract: Callable    # (state, data, pv, l2) -> (SolveResult, w_masked, var|None)
+    compact: Callable    # (tree, idx) -> tree gathered along the entity axis
+
+
+@functools.lru_cache(maxsize=None)
+def _re_programs(
+    task: TaskType,
+    configuration: GlmOptimizationConfiguration,
+    compute_variances: bool,
+) -> _RePrograms:
+    objective = make_glm_objective(loss_for_task(task))
+    use_l1 = configuration.l1_weight > 0
+    kind = solver_kind(configuration, None if use_l1 else 0.0)
+    K = configuration.adaptive.chunk_iters
+
+    def _mask_and_var(res: SolveResult, data, pv, l2):
+        # padding columns have all-zero features; L2 keeps them at 0, but be
+        # explicit so exported models never leak junk. Fused into the same
+        # program as the solve/finalize so there is no separate dispatch.
+        w = jnp.where(pv, res.w, 0.0)
+        if compute_variances:
+            diag = objective.hessian_diag(res.w, data, l2)
+            var = jnp.where(pv, 1.0 / (diag + 1e-12), 0.0)
+        else:
+            var = None
+        return w, var
+
+    def oneshot_one(w0, data, pv, l2, l1):
+        res = solve(
+            objective, w0, data, configuration,
+            l2_weight=l2, l1_weight=l1 if use_l1 else 0.0,
+        )
+        w, var = _mask_and_var(res, data, pv, l2)
+        return res, w, var
+
+    def init_one(w0, data, l2, l1):
+        return solve_init(
+            objective, w0, data, configuration,
+            l2_weight=l2, l1_weight=l1 if use_l1 else 0.0,
+        )
+
+    def chunk_one(state, data, l2):
+        return solve_chunk(
+            objective, state, data, configuration, l2_weight=l2, num_iters=K
+        )
+
+    def extract_one(state, data, pv, l2):
+        res = solve_finalize(state, configuration)
+        w, var = _mask_and_var(res, data, pv, l2)
+        return res, w, var
+
+    def _oneshot(w0, data, pv, l2, l1):
+        _TRACE_COUNTS[("re_oneshot", kind)] += 1
+        return jax.vmap(oneshot_one, in_axes=(0, 0, 0, None, None))(w0, data, pv, l2, l1)
+
+    def _init(w0, data, l2, l1):
+        _TRACE_COUNTS[("re_init", kind)] += 1
+        return jax.vmap(init_one, in_axes=(0, 0, None, None))(w0, data, l2, l1)
+
+    def _chunk(state, data, l2):
+        _TRACE_COUNTS[("re_chunk", kind)] += 1
+        return jax.vmap(chunk_one, in_axes=(0, 0, None))(state, data, l2)
+
+    def _extract(state, data, pv, l2):
+        _TRACE_COUNTS[("re_extract", kind)] += 1
+        return jax.vmap(extract_one, in_axes=(0, 0, 0, None))(state, data, pv, l2)
+
+    def _compact(tree, idx):
+        _TRACE_COUNTS[("re_compact", kind)] += 1
+        return jax.tree.map(lambda a: a[idx], tree)
+
+    # Donate the carried solver state so each round updates in place instead
+    # of copying the (w, memory, history) buffers; CPU ignores donation (and
+    # warns), so only request it on accelerators.
+    donate = () if jax.default_backend() == "cpu" else (0,)
+    return _RePrograms(
+        kind=kind,
+        chunk_iters=K,
+        oneshot=jax.jit(_oneshot),
+        init=jax.jit(_init),
+        chunk=jax.jit(_chunk, donate_argnums=donate),
+        extract=jax.jit(_extract),
+        compact=jax.jit(_compact),
+    )
+
+
+def _scatter_extract(progs, state, data, pv, l2, live, buffers, num_entities):
+    """Finalize the current lanes on device, then scatter every result leaf
+    into host buffers at the original entity rows (``live``). Re-scattering a
+    frozen lane later is idempotent: done lanes never advance."""
+    res, w_m, var = jax.device_get(progs.extract(state, data, pv, l2))
+    leaves = {"__w_masked": np.asarray(w_m)}
+    if var is not None:
+        leaves["__var"] = np.asarray(var)
+    for f in dataclasses.fields(SolveResult):
+        v = getattr(res, f.name)
+        if v is not None:
+            leaves[f.name] = np.asarray(v)
+    for name, arr in leaves.items():
+        if name not in buffers:
+            buffers[name] = np.zeros((num_entities,) + arr.shape[1:], dtype=arr.dtype)
+        buffers[name][live] = arr
+
+
+def _solve_bucket_adaptive(
+    progs: _RePrograms,
+    bucket: ReBucket,
+    w0: jax.Array,
+    l2: jax.Array,
+    l1: jax.Array,
+    max_iterations: int,
+    min_lanes: int,
+    bucket_index: int,
+):
+    """Chunked rounds + lane compaction for one bucket. Returns
+    (SolveResult over the ORIGINAL entity order, masked w, variances|None,
+    SolverStats)."""
+    E = bucket.num_entities
+    K = progs.chunk_iters
+    data = _bucket_data(bucket)
+    pv = bucket.proj_valid
+    retrace0 = _TRACE_COUNTS[("re_chunk", progs.kind)]
+
+    state = progs.init(w0, data, l2, l1)
+    live = np.arange(E)             # lane -> original entity row
+    width = E
+    its_before = np.zeros(E, dtype=np.int64)
+    executed = 0
+    widths: List[int] = []
+    buffers: Dict[str, np.ndarray] = {}
+    # ceil(max_iter/K) chunks always finish every lane; +1 slack for the
+    # converged-at-init case where the first chunk advances nothing.
+    max_rounds = -(-max_iterations // K) + 1
+
+    for _ in range(max_rounds):
+        state = progs.chunk(state, data, l2)
+        widths.append(width)
+        # host-side bookkeeping below overlaps the async device dispatch
+        its_after = np.asarray(jax.device_get(state.it)).astype(np.int64)
+        reasons = np.asarray(jax.device_get(state.reason))
+        executed += width * int(np.max(its_after - its_before)) if width else 0
+        done = (reasons != _NOT_CONVERGED) | (its_after >= max_iterations)
+        n_live = int(np.sum(~done))
+        if n_live == 0:
+            _scatter_extract(progs, state, data, pv, l2, live, buffers, E)
+            break
+        new_width = _next_pow2(max(n_live, min_lanes))
+        if new_width < width:
+            # freeze current results, then compact survivors (+ filler done
+            # lanes up to the pow2 width) into a dense prefix on device
+            _scatter_extract(progs, state, data, pv, l2, live, buffers, E)
+            keep = np.argsort(done, kind="stable")[:new_width]
+            idx = jnp.asarray(keep, dtype=jnp.int32)
+            state, data, pv = progs.compact((state, data, pv), idx)
+            live = live[keep]
+            its_before = its_after[keep]
+            width = new_width
+        else:
+            its_before = its_after
+    else:
+        _scatter_extract(progs, state, data, pv, l2, live, buffers, E)
+
+    sr_kwargs = {
+        f.name: (jnp.asarray(buffers[f.name]) if f.name in buffers else None)
+        for f in dataclasses.fields(SolveResult)
+    }
+    res_full = SolveResult(**sr_kwargs)
+    w_full = jnp.asarray(buffers["__w_masked"])
+    var_full = jnp.asarray(buffers["__var"]) if "__var" in buffers else None
+
+    its = buffers["iterations"].astype(np.int64)
+    reasons_full = buffers["reason"]
+    max_its = int(its.max()) if its.size else 0
+    stats = SolverStats(
+        bucket=bucket_index,
+        optimizer=progs.kind,
+        num_entities=E,
+        rounds=len(widths),
+        chunk_iters=K,
+        dispatch_widths=tuple(widths),
+        iterations_p50=float(np.percentile(its, 50)) if its.size else 0.0,
+        iterations_p99=float(np.percentile(its, 99)) if its.size else 0.0,
+        iterations_max=max_its,
+        sum_entity_iterations=int(its.sum()),
+        executed_lane_iterations=int(executed),
+        lockstep_lane_iterations=E * max_its,
+        converged=int(np.sum(reasons_full != _NOT_CONVERGED)),
+        chunk_retraces=_TRACE_COUNTS[("re_chunk", progs.kind)] - retrace0,
+    )
+    return res_full, w_full, var_full, stats
+
+
+def _solve_bucket_oneshot(
+    progs: _RePrograms,
+    bucket: ReBucket,
+    w0: jax.Array,
+    l2: jax.Array,
+    l1: jax.Array,
+    bucket_index: int,
+):
+    """Classic lockstep dispatch (adaptive disabled / sharded / tiny bucket);
+    masking and variances run inside the same jit program."""
+    data = _bucket_data(bucket)
+    res, w, var = progs.oneshot(w0, data, bucket.proj_valid, l2, l1)
+    E = bucket.num_entities
+    its = np.asarray(fetch_global(res.iterations)).astype(np.int64)
+    reasons = np.asarray(fetch_global(res.reason))
+    max_its = int(its.max()) if its.size else 0
+    stats = SolverStats(
+        bucket=bucket_index,
+        optimizer=progs.kind,
+        num_entities=E,
+        rounds=1,
+        chunk_iters=progs.chunk_iters,
+        dispatch_widths=(E,),
+        iterations_p50=float(np.percentile(its, 50)) if its.size else 0.0,
+        iterations_p99=float(np.percentile(its, 99)) if its.size else 0.0,
+        iterations_max=max_its,
+        sum_entity_iterations=int(its.sum()),
+        executed_lane_iterations=E * max_its,
+        lockstep_lane_iterations=E * max_its,
+        converged=int(np.sum(reasons != _NOT_CONVERGED)),
+        chunk_retraces=0,
+    )
+    return res, w, var, stats
+
+
 def train_random_effects(
     dataset: RandomEffectDataset,
     task: TaskType,
     configuration: GlmOptimizationConfiguration,
     initial_model: Optional[RandomEffectModel] = None,
     compute_variances: bool = False,
+    stats_out: Optional[List[SolverStats]] = None,
 ) -> tuple[RandomEffectModel, List[SolveResult]]:
     """Solve one GLM per entity (all buckets). Returns the model and the
     per-bucket vmap'd SolveResults (per-entity convergence telemetry — the
-    RandomEffectOptimizationTracker equivalent)."""
-    objective = make_glm_objective(loss_for_task(task))
-    use_l1 = configuration.l1_weight > 0
+    RandomEffectOptimizationTracker equivalent).
 
-    def solve_one(w0, data, l2, l1):
-        return solve(
-            objective, w0, data, configuration,
-            l2_weight=l2, l1_weight=l1 if use_l1 else 0.0,
-        )
-
-    batched = jax.jit(jax.vmap(solve_one, in_axes=(0, 0, None, None)))
-    hess_diag = (
-        jax.jit(jax.vmap(objective.hessian_diag, in_axes=(0, 0, None)))
-        if compute_variances
-        else None
-    )
+    When ``configuration.adaptive.enabled`` each bucket runs through the
+    convergence-adaptive driver (chunked rounds + pow2 lane compaction);
+    sharded buckets and buckets at/below ``adaptive.min_lanes`` fall back to
+    the one-shot lockstep dispatch, whose results are identical. If
+    ``stats_out`` is given, one :class:`SolverStats` per bucket is appended.
+    """
+    progs = _re_programs(task, configuration, compute_variances)
+    adaptive = configuration.adaptive
+    max_iter = configuration.optimizer_config.max_iterations
 
     l2 = jnp.float32(configuration.l2_weight)
     l1 = jnp.float32(configuration.l1_weight)
     coeffs, variances, results = [], [], []
     for b, bucket in enumerate(dataset.buckets):
-        data = _bucket_data(bucket)
         if initial_model is not None:
             w0 = _fit_entity_axis(
                 initial_model.coefficients[b], bucket.num_entities
             )
         else:
             w0 = jnp.zeros((bucket.num_entities, bucket.local_dim), dtype=jnp.float32)
-        res = batched(w0, data, l2, l1)
-        # padding columns have all-zero features; L2 keeps them at 0, but be
-        # explicit so exported models never leak junk
-        w = jnp.where(bucket.proj_valid, res.w, 0.0)
-        coeffs.append(w)
-        if compute_variances:
-            diag = hess_diag(res.w, data, l2)
-            variances.append(jnp.where(bucket.proj_valid, 1.0 / (diag + 1e-12), 0.0))
+        use_adaptive = (
+            adaptive.enabled
+            and bucket.num_entities > adaptive.min_lanes
+            and not _is_multi_device(bucket.X)
+        )
+        if use_adaptive:
+            res, w, var, stats = _solve_bucket_adaptive(
+                progs, bucket, w0, l2, l1, max_iter, adaptive.min_lanes, b
+            )
         else:
-            variances.append(None)
+            res, w, var, stats = _solve_bucket_oneshot(progs, bucket, w0, l2, l1, b)
+        coeffs.append(w)
+        variances.append(var)
         results.append(res)
+        if stats_out is not None:
+            stats_out.append(stats)
 
     model = RandomEffectModel(
         random_effect_type=dataset.config.random_effect_type,

@@ -90,6 +90,43 @@ class ModelSwapEvent(Event):
     validation_metric: Optional[float] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class SolverStatsEvent(Event):
+    """Per-bucket telemetry from the convergence-adaptive random-effect
+    driver (opt.tracking.SolverStats), emitted by the coordinate-descent
+    driver after each random-effect update."""
+
+    coordinate_id: Optional[str]
+    bucket: int
+    optimizer: str
+    num_entities: int
+    rounds: int
+    dispatch_widths: Tuple[int, ...]
+    iterations_p50: float
+    iterations_p99: float
+    executed_lane_iterations: int
+    lockstep_lane_iterations: int
+    wasted_lane_fraction: float
+
+    @classmethod
+    def from_stats(cls, coordinate_id: Optional[str], stats) -> "SolverStatsEvent":
+        """Build from an opt.tracking.SolverStats (duck-typed to avoid an
+        import cycle: event is imported from everywhere)."""
+        return cls(
+            coordinate_id=coordinate_id,
+            bucket=stats.bucket,
+            optimizer=stats.optimizer,
+            num_entities=stats.num_entities,
+            rounds=stats.rounds,
+            dispatch_widths=tuple(stats.dispatch_widths),
+            iterations_p50=stats.iterations_p50,
+            iterations_p99=stats.iterations_p99,
+            executed_lane_iterations=stats.executed_lane_iterations,
+            lockstep_lane_iterations=stats.lockstep_lane_iterations,
+            wasted_lane_fraction=stats.wasted_lane_fraction,
+        )
+
+
 class EventListener:
     """Receives every event from an emitter (EventListener.scala)."""
 

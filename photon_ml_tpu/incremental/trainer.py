@@ -54,6 +54,10 @@ class IncrementalUpdate:
     touched_entities: Dict[str, Tuple[str, ...]]
     new_entities: Dict[str, Tuple[str, ...]]
     num_events: int
+    # per-coordinate SolverStats (opt.tracking) from the warm-started RE
+    # re-solves — the convergence-adaptive driver's lane telemetry; nearline
+    # batches have the largest iteration skew so the savings show up here
+    solver_stats: Dict[str, list] = dataclasses.field(default_factory=dict)
 
     def game_model(self, estimator: GameEstimator) -> GameModel:
         return GameModel(
@@ -128,6 +132,7 @@ def incremental_update(
     re_updates: Dict[str, Dict[str, Dict[int, float]]] = {}
     touched: Dict[str, Tuple[str, ...]] = {}
     new: Dict[str, Tuple[str, ...]] = {}
+    solver_stats: Dict[str, list] = {}
     for cid in re_cids:
         old = models.get(cid)
         if old is not None and not isinstance(old, RandomEffectModel):
@@ -136,6 +141,8 @@ def incremental_update(
                 f"{type(old).__name__}"
             )
         sub = estimator.resolve_coordinate(cid, events, models)
+        if estimator.last_resolve_stats:
+            solver_stats[cid] = list(estimator.last_resolve_stats)
         rows = {str(eid): coefs for eid, coefs in sub.items()}
         touched[cid] = tuple(sorted(rows))
         known = set(old.entity_to_loc) if old is not None else set()
@@ -163,4 +170,5 @@ def incremental_update(
         touched_entities=touched,
         new_entities=new,
         num_events=events.num_rows,
+        solver_stats=solver_stats,
     )

@@ -1,4 +1,5 @@
 from photon_ml_tpu.opt.config import (
+    AdaptiveSolveConfig,
     GlmOptimizationConfiguration,
     OptimizerConfig,
     OptimizerType,
@@ -6,11 +7,18 @@ from photon_ml_tpu.opt.config import (
 )
 from photon_ml_tpu.opt.lbfgs import lbfgs_solve
 from photon_ml_tpu.opt.owlqn import owlqn_solve
-from photon_ml_tpu.opt.solve import solve
+from photon_ml_tpu.opt.solve import (
+    solve,
+    solve_chunk,
+    solve_finalize,
+    solve_init,
+    solver_kind,
+)
 from photon_ml_tpu.opt.state import SolveResult
 from photon_ml_tpu.opt.tron import tron_solve
 
 __all__ = [
+    "AdaptiveSolveConfig",
     "GlmOptimizationConfiguration",
     "OptimizerConfig",
     "OptimizerType",
@@ -19,5 +27,9 @@ __all__ = [
     "owlqn_solve",
     "tron_solve",
     "solve",
+    "solve_init",
+    "solve_chunk",
+    "solve_finalize",
+    "solver_kind",
     "SolveResult",
 ]

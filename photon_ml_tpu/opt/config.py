@@ -111,6 +111,34 @@ class OptimizerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdaptiveSolveConfig:
+    """Knobs for the convergence-adaptive random-effect driver (hashable;
+    part of the jit program cache key).
+
+    The driver runs the vmap'd per-entity solve in chunks of ``chunk_iters``
+    outer iterations, pulls the per-lane converged mask after each chunk,
+    compacts unconverged entities into a dense prefix, and re-dispatches at
+    the next smaller power-of-two lane count. Compiled-program count per
+    (optimizer, bucket shape) is therefore bounded by the pow2 ladder.
+    ``enabled=False`` restores the one-shot lockstep dispatch exactly.
+    """
+
+    enabled: bool = True
+    # Outer solver iterations per chunk. Small K pulls the converged mask
+    # often (more savings on skewed workloads) at the cost of more dispatches.
+    chunk_iters: int = 8
+    # Stop shrinking below this lane count: tiny dispatches are dominated by
+    # launch overhead, so the tail just runs lockstep at this width.
+    min_lanes: int = 8
+
+    def __post_init__(self) -> None:
+        if self.chunk_iters < 1:
+            raise ValueError(f"chunk_iters must be >= 1, got {self.chunk_iters}")
+        if self.min_lanes < 1:
+            raise ValueError(f"min_lanes must be >= 1, got {self.min_lanes}")
+
+
+@dataclasses.dataclass(frozen=True)
 class GlmOptimizationConfiguration:
     """Per-problem bundle: solver + regularization + λ + down-sampling rate
     (reference GLMOptimizationConfiguration.scala:28)."""
@@ -119,6 +147,9 @@ class GlmOptimizationConfiguration:
     regularization: RegularizationContext = RegularizationContext()
     regularization_weight: float = 0.0
     down_sampling_rate: float = 1.0
+    # Convergence-adaptive random-effect solving (chunked rounds + lane
+    # compaction); only consulted by train_random_effects.
+    adaptive: AdaptiveSolveConfig = AdaptiveSolveConfig()
 
     def __post_init__(self) -> None:
         if not (0.0 < self.down_sampling_rate <= 1.0):

@@ -32,6 +32,11 @@ from photon_ml_tpu.types import ConvergenceReason
 
 
 class _LbfgsState(NamedTuple):
+    """Resumable L-BFGS loop state: everything the next outer iteration
+    needs, including the absolute tolerances derived from the initial point
+    (so a solve can be split into chunks — ``lbfgs_chunk`` — and each chunk
+    continues exactly where the previous one stopped)."""
+
     w: jax.Array          # [d]
     f: jax.Array
     g: jax.Array          # [d]
@@ -43,6 +48,8 @@ class _LbfgsState(NamedTuple):
     reason: jax.Array     # int32 ConvergenceReason
     history: jax.Array    # [max_iter+1] objective values
     w_hist: jax.Array     # [max_iter+1, d] coefficients (or [0] when off)
+    abs_f_tol: jax.Array  # scalar, derived from f0 at init
+    abs_g_tol: jax.Array  # scalar, derived from ||g0|| at init
 
 
 def two_loop_direction(
@@ -140,26 +147,19 @@ def resolve_box(box, config: OptimizerConfig):
     return lo, hi, lo is not None or hi is not None
 
 
-def lbfgs_solve(
+def lbfgs_init(
     objective: GlmObjective,
     w0: jax.Array,
     data,
     l2_weight: jax.Array,
     config: OptimizerConfig = OptimizerConfig(),
-    box: Optional[Tuple] = None,
-) -> SolveResult:
-    """Minimize objective over w starting from w0. Pure function of its
-    inputs; jit/vmap/shard_map-safe.
-
-    ``box`` = (lower, upper) per-coefficient arrays (either side may be
-    None) — the reference's per-feature constraint map
-    (GLMSuite.createConstraintFeatureMap); scalar bounds come from the
-    config."""
+) -> _LbfgsState:
+    """Evaluate the initial point and build the resumable loop state
+    (absolute tolerances included — reference Optimizer.scala:68-71)."""
     m = config.history_length
     max_iter = config.max_iterations
     dim = w0.shape[-1]
     dtype = w0.dtype
-    box_lo, box_hi, has_box = resolve_box(box, config)
 
     f0, g0 = objective.value_and_grad(w0, data, l2_weight)
     g0_norm = jnp.linalg.norm(g0)
@@ -172,7 +172,7 @@ def lbfgs_solve(
         if config.track_coefficients
         else jnp.zeros((0,), dtype=dtype)
     )
-    init = _LbfgsState(
+    return _LbfgsState(
         w=w0,
         f=f0,
         g=g0,
@@ -184,10 +184,37 @@ def lbfgs_solve(
         reason=jnp.int32(ConvergenceReason.NOT_CONVERGED.value),
         history=history0,
         w_hist=w_hist0,
+        abs_f_tol=abs_f_tol,
+        abs_g_tol=abs_g_tol,
     )
 
+
+def lbfgs_chunk(
+    objective: GlmObjective,
+    state: _LbfgsState,
+    data,
+    l2_weight: jax.Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    box: Optional[Tuple] = None,
+    num_iters: Optional[int] = None,
+) -> _LbfgsState:
+    """Advance the solve by at most ``num_iters`` outer iterations (None =
+    run to convergence/max_iterations). The full solver state — curvature
+    ring buffers, step counts, tolerances — is carried in ``state``, so
+    chunked execution follows EXACTLY the same per-iterate trajectory as one
+    uninterrupted ``while_loop``; only the program boundaries differ. This
+    is what lets the random-effect driver pull converged lanes out of a
+    vmapped batch every K iterations (estimators/random_effect.py)."""
+    max_iter = config.max_iterations
+    dtype = state.w.dtype
+    box_lo, box_hi, has_box = resolve_box(box, config)
+    it_stop = None if num_iters is None else state.it + jnp.int32(num_iters)
+
     def cond(s: _LbfgsState):
-        return (s.reason == ConvergenceReason.NOT_CONVERGED.value) & (s.it < max_iter)
+        c = (s.reason == ConvergenceReason.NOT_CONVERGED.value) & (s.it < max_iter)
+        if it_stop is not None:
+            c = c & (s.it < it_stop)
+        return c
 
     def body(s: _LbfgsState) -> _LbfgsState:
         d = two_loop_direction(s.g, s.s_hist, s.y_hist, s.rho, s.count)
@@ -233,8 +260,8 @@ def lbfgs_solve(
         # OBJECTIVE_NOT_IMPROVING — f_conv is gated on success so a stalled
         # search is never misreported as converged.
         no_step = (~ls.success) | (ls.t <= 0)
-        f_conv = ls.success & function_values_converged(s.f, f_new, abs_f_tol)
-        g_conv = gradient_converged(jnp.linalg.norm(g_new), abs_g_tol)
+        f_conv = ls.success & function_values_converged(s.f, f_new, s.abs_f_tol)
+        g_conv = gradient_converged(jnp.linalg.norm(g_new), s.abs_g_tol)
         reason = jnp.where(
             g_conv,
             ConvergenceReason.GRADIENT_CONVERGED.value,
@@ -269,20 +296,50 @@ def lbfgs_solve(
                 if config.track_coefficients
                 else s.w_hist
             ),
+            abs_f_tol=s.abs_f_tol,
+            abs_g_tol=s.abs_g_tol,
         )
 
-    out = jax.lax.while_loop(cond, body, init)
+    return jax.lax.while_loop(cond, body, state)
+
+
+def lbfgs_finalize(
+    state: _LbfgsState, config: OptimizerConfig = OptimizerConfig()
+) -> SolveResult:
+    """Turn a finished (or exhausted) loop state into a SolveResult. A state
+    still marked NOT_CONVERGED is reported as MAX_ITERATIONS — callers only
+    finalize once the iteration budget is spent."""
     reason = jnp.where(
-        out.reason == ConvergenceReason.NOT_CONVERGED.value,
+        state.reason == ConvergenceReason.NOT_CONVERGED.value,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS.value),
-        out.reason,
+        state.reason,
     )
     return SolveResult(
-        w=out.w,
-        value=out.f,
-        grad_norm=jnp.linalg.norm(out.g),
-        iterations=out.it,
+        w=state.w,
+        value=state.f,
+        grad_norm=jnp.linalg.norm(state.g),
+        iterations=state.it,
         reason=reason,
-        value_history=out.history,
-        w_history=out.w_hist if config.track_coefficients else None,
+        value_history=state.history,
+        w_history=state.w_hist if config.track_coefficients else None,
     )
+
+
+def lbfgs_solve(
+    objective: GlmObjective,
+    w0: jax.Array,
+    data,
+    l2_weight: jax.Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    box: Optional[Tuple] = None,
+) -> SolveResult:
+    """Minimize objective over w starting from w0. Pure function of its
+    inputs; jit/vmap/shard_map-safe.
+
+    ``box`` = (lower, upper) per-coefficient arrays (either side may be
+    None) — the reference's per-feature constraint map
+    (GLMSuite.createConstraintFeatureMap); scalar bounds come from the
+    config."""
+    state = lbfgs_init(objective, w0, data, l2_weight, config)
+    state = lbfgs_chunk(objective, state, data, l2_weight, config, box=box)
+    return lbfgs_finalize(state, config)

@@ -60,6 +60,10 @@ def _project_orthant(w: jax.Array, xi: jax.Array) -> jax.Array:
 
 
 class _OwlqnState(NamedTuple):
+    """Resumable OWL-QN loop state (see _LbfgsState): carries the L1 weight
+    and the init-derived tolerances so chunked execution — ``owlqn_chunk``
+    every K iterations — follows the one-shot trajectory exactly."""
+
     w: jax.Array
     f: jax.Array          # smooth f (no L1)
     g: jax.Array          # smooth gradient
@@ -72,18 +76,19 @@ class _OwlqnState(NamedTuple):
     reason: jax.Array
     history: jax.Array
     w_hist: jax.Array     # [max_iter+1, d] coefficients (or [0] when off)
+    l1: jax.Array         # scalar L1 weight (traced)
+    abs_f_tol: jax.Array
+    abs_g_tol: jax.Array
 
 
-def owlqn_solve(
+def owlqn_init(
     objective: GlmObjective,
     w0: jax.Array,
     data,
     l2_weight: jax.Array,
     l1_weight: jax.Array,
     config: OptimizerConfig = OptimizerConfig(),
-    box=None,
-) -> SolveResult:
-    box_lo, box_hi, has_box = resolve_box(box, config)
+) -> _OwlqnState:
     m = config.history_length
     max_iter = config.max_iterations
     dim = w0.shape[-1]
@@ -103,7 +108,7 @@ def owlqn_solve(
         if config.track_coefficients
         else jnp.zeros((0,), dtype=dtype)
     )
-    init = _OwlqnState(
+    return _OwlqnState(
         w=w0,
         f=f0,
         g=g0,
@@ -116,13 +121,37 @@ def owlqn_solve(
         reason=jnp.int32(ConvergenceReason.NOT_CONVERGED.value),
         history=history0,
         w_hist=w_hist0,
+        l1=l1,
+        abs_f_tol=abs_f_tol,
+        abs_g_tol=abs_g_tol,
     )
+
+
+def owlqn_chunk(
+    objective: GlmObjective,
+    state: _OwlqnState,
+    data,
+    l2_weight: jax.Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    box=None,
+    num_iters=None,
+) -> _OwlqnState:
+    """Advance by at most ``num_iters`` outer iterations (None = to the
+    end); same chunking contract as ``lbfgs_chunk``."""
+    box_lo, box_hi, has_box = resolve_box(box, config)
+    max_iter = config.max_iterations
+    dtype = state.w.dtype
+    l1 = state.l1
+    it_stop = None if num_iters is None else state.it + jnp.int32(num_iters)
 
     GAMMA = 1e-4  # sufficient-decrease constant (Andrew & Gao use 1e-4)
     BACKTRACK = 0.5
 
     def cond(s: _OwlqnState):
-        return (s.reason == ConvergenceReason.NOT_CONVERGED.value) & (s.it < max_iter)
+        c = (s.reason == ConvergenceReason.NOT_CONVERGED.value) & (s.it < max_iter)
+        if it_stop is not None:
+            c = c & (s.it < it_stop)
+        return c
 
     def body(s: _OwlqnState) -> _OwlqnState:
         pg = pseudo_gradient(s.w, s.g, l1)
@@ -206,8 +235,8 @@ def owlqn_solve(
 
         it = s.it + 1
         pg_new = pseudo_gradient(w_new, g_new, l1)
-        g_conv = gradient_converged(jnp.linalg.norm(pg_new), abs_g_tol)
-        f_conv = ls.ok & function_values_converged(s.F, F_new, abs_f_tol)
+        g_conv = gradient_converged(jnp.linalg.norm(pg_new), s.abs_g_tol)
+        f_conv = ls.ok & function_values_converged(s.F, F_new, s.abs_f_tol)
         no_step = ~ls.ok
         reason = jnp.where(
             g_conv,
@@ -244,21 +273,44 @@ def owlqn_solve(
                 if config.track_coefficients
                 else s.w_hist
             ),
+            l1=s.l1,
+            abs_f_tol=s.abs_f_tol,
+            abs_g_tol=s.abs_g_tol,
         )
 
-    out = jax.lax.while_loop(cond, body, init)
+    return jax.lax.while_loop(cond, body, state)
+
+
+def owlqn_finalize(
+    state: _OwlqnState, config: OptimizerConfig = OptimizerConfig()
+) -> SolveResult:
+    """Convert a (fully run) loop state into the public SolveResult."""
     reason = jnp.where(
-        out.reason == ConvergenceReason.NOT_CONVERGED.value,
+        state.reason == ConvergenceReason.NOT_CONVERGED.value,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS.value),
-        out.reason,
+        state.reason,
     )
-    pg_final = pseudo_gradient(out.w, out.g, l1)
+    pg_final = pseudo_gradient(state.w, state.g, state.l1)
     return SolveResult(
-        w=out.w,
-        value=out.F,
+        w=state.w,
+        value=state.F,
         grad_norm=jnp.linalg.norm(pg_final),
-        iterations=out.it,
+        iterations=state.it,
         reason=reason,
-        value_history=out.history,
-        w_history=out.w_hist if config.track_coefficients else None,
+        value_history=state.history,
+        w_history=state.w_hist if config.track_coefficients else None,
     )
+
+
+def owlqn_solve(
+    objective: GlmObjective,
+    w0: jax.Array,
+    data,
+    l2_weight: jax.Array,
+    l1_weight: jax.Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    box=None,
+) -> SolveResult:
+    state = owlqn_init(objective, w0, data, l2_weight, l1_weight, config)
+    state = owlqn_chunk(objective, state, data, l2_weight, config, box=box)
+    return owlqn_finalize(state, config)

@@ -4,6 +4,15 @@ Reference parity: OptimizerFactory.scala:27 — OWL-QN is selected automatically
 whenever the regularization has a positive L1 component; TRON is rejected for
 first-order-only objectives. ``l2_weight``/``l1_weight`` are traced scalars so
 λ sweeps reuse one compiled program.
+
+Beyond the one-shot ``solve``, this module exposes the resumable
+init/chunk/finalize triple used by the convergence-adaptive random-effect
+driver: ``solve_init`` builds a solver-specific loop state, ``solve_chunk``
+advances it by at most K outer iterations (carrying L-BFGS memory / OWL-QN
+orthant state / TRON trust radius across calls), and ``solve_finalize`` turns
+the state into a ``SolveResult``. ``solve(...)`` is exactly
+``solve_finalize(solve_chunk(solve_init(...)))`` with no iteration cap, so
+chunked execution follows the identical per-lane trajectory.
 """
 
 from __future__ import annotations
@@ -13,10 +22,55 @@ import numpy as np
 
 from photon_ml_tpu.losses.objective import GlmObjective
 from photon_ml_tpu.opt.config import GlmOptimizationConfiguration, OptimizerType
-from photon_ml_tpu.opt.lbfgs import lbfgs_solve
-from photon_ml_tpu.opt.owlqn import owlqn_solve
+from photon_ml_tpu.opt.lbfgs import (
+    _LbfgsState,
+    lbfgs_chunk,
+    lbfgs_finalize,
+    lbfgs_init,
+    lbfgs_solve,
+)
+from photon_ml_tpu.opt.owlqn import (
+    _OwlqnState,
+    owlqn_chunk,
+    owlqn_finalize,
+    owlqn_init,
+    owlqn_solve,
+)
 from photon_ml_tpu.opt.state import SolveResult
-from photon_ml_tpu.opt.tron import tron_solve
+from photon_ml_tpu.opt.tron import (
+    _TronState,
+    tron_chunk,
+    tron_finalize,
+    tron_init,
+    tron_solve,
+)
+
+
+def _resolve_l1(configuration: GlmOptimizationConfiguration, l1_weight):
+    """Return (use_owlqn, l1_value) following the override semantics of
+    ``solve``: None → configuration-implied; a concrete 0 disables OWL-QN;
+    anything else (incl. a traced scalar) selects it."""
+    if l1_weight is None:
+        return configuration.l1_weight > 0, configuration.l1_weight
+    if isinstance(l1_weight, (int, float, np.floating, np.integer)) and float(l1_weight) == 0.0:
+        return False, 0.0
+    return True, l1_weight
+
+
+def solver_kind(configuration: GlmOptimizationConfiguration, l1_weight=None) -> str:
+    """Static solver choice for a configuration: 'owlqn' | 'tron' | 'lbfgs'.
+
+    Raises for the invalid TRON+L1 combination, mirroring ``solve``.
+    """
+    cfg = configuration.optimizer_config
+    use_owlqn, _ = _resolve_l1(configuration, l1_weight)
+    if use_owlqn:
+        if cfg.optimizer is OptimizerType.TRON:
+            raise ValueError("TRON does not support L1 regularization (use LBFGS/OWL-QN)")
+        return "owlqn"
+    if cfg.optimizer is OptimizerType.TRON:
+        return "tron"
+    return "lbfgs"
 
 
 def solve(
@@ -39,15 +93,7 @@ def solve(
     """
     cfg = configuration.optimizer_config
     l2 = jnp.asarray(configuration.l2_weight if l2_weight is None else l2_weight, dtype=w0.dtype)
-    if l1_weight is None:
-        use_owlqn = configuration.l1_weight > 0
-        l1_value = configuration.l1_weight
-    elif isinstance(l1_weight, (int, float, np.floating, np.integer)) and float(l1_weight) == 0.0:
-        use_owlqn = False
-        l1_value = 0.0
-    else:
-        use_owlqn = True
-        l1_value = l1_weight
+    use_owlqn, l1_value = _resolve_l1(configuration, l1_weight)
     if use_owlqn:
         l1 = jnp.asarray(l1_value, dtype=w0.dtype)
         if cfg.optimizer is OptimizerType.TRON:
@@ -56,3 +102,59 @@ def solve(
     if cfg.optimizer is OptimizerType.TRON:
         return tron_solve(objective, w0, data, l2, cfg, box=box)
     return lbfgs_solve(objective, w0, data, l2, cfg, box=box)
+
+
+def solve_init(
+    objective: GlmObjective,
+    w0,
+    data,
+    configuration: GlmOptimizationConfiguration,
+    l2_weight=None,
+    l1_weight=None,
+):
+    """Build the resumable loop state for the configured solver."""
+    cfg = configuration.optimizer_config
+    l2 = jnp.asarray(configuration.l2_weight if l2_weight is None else l2_weight, dtype=w0.dtype)
+    kind = solver_kind(configuration, l1_weight)
+    if kind == "owlqn":
+        _, l1_value = _resolve_l1(configuration, l1_weight)
+        l1 = jnp.asarray(l1_value, dtype=w0.dtype)
+        return owlqn_init(objective, w0, data, l2, l1, cfg)
+    if kind == "tron":
+        return tron_init(objective, w0, data, l2, cfg)
+    return lbfgs_init(objective, w0, data, l2, cfg)
+
+
+def solve_chunk(
+    objective: GlmObjective,
+    state,
+    data,
+    configuration: GlmOptimizationConfiguration,
+    l2_weight=None,
+    box=None,
+    num_iters=None,
+):
+    """Advance a ``solve_init`` state by ≤ ``num_iters`` outer iterations
+    (None = run to convergence / max_iterations). Dispatches on state type."""
+    cfg = configuration.optimizer_config
+    dtype = state.w.dtype
+    l2 = jnp.asarray(configuration.l2_weight if l2_weight is None else l2_weight, dtype=dtype)
+    if isinstance(state, _OwlqnState):
+        return owlqn_chunk(objective, state, data, l2, cfg, box=box, num_iters=num_iters)
+    if isinstance(state, _TronState):
+        return tron_chunk(objective, state, data, l2, cfg, box=box, num_iters=num_iters)
+    if isinstance(state, _LbfgsState):
+        return lbfgs_chunk(objective, state, data, l2, cfg, box=box, num_iters=num_iters)
+    raise TypeError(f"unknown solver state type {type(state).__name__}")
+
+
+def solve_finalize(state, configuration: GlmOptimizationConfiguration) -> SolveResult:
+    """Turn a loop state into the public ``SolveResult``."""
+    cfg = configuration.optimizer_config
+    if isinstance(state, _OwlqnState):
+        return owlqn_finalize(state, cfg)
+    if isinstance(state, _TronState):
+        return tron_finalize(state, cfg)
+    if isinstance(state, _LbfgsState):
+        return lbfgs_finalize(state, cfg)
+    raise TypeError(f"unknown solver state type {type(state).__name__}")

@@ -131,6 +131,60 @@ class RandomEffectOptimizationTracker:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class SolverStats:
+    """Per-bucket telemetry from the convergence-adaptive RE driver.
+
+    ``executed_lane_iterations`` counts iterations actually dispatched
+    (Σ over rounds of width × chunk-advance); ``lockstep_lane_iterations``
+    is what the one-shot vmap would have executed (num_entities × slowest
+    entity's iteration count) — their ratio is the adaptive win.
+    """
+
+    bucket: int
+    optimizer: str                 # 'lbfgs' | 'owlqn' | 'tron'
+    num_entities: int
+    rounds: int
+    chunk_iters: int
+    dispatch_widths: tuple         # lane count per round (pow2 ladder)
+    iterations_p50: float
+    iterations_p99: float
+    iterations_max: int
+    sum_entity_iterations: int     # Σ per-entity final iteration counts
+    executed_lane_iterations: int
+    lockstep_lane_iterations: int
+    converged: int                 # entities with reason != NOT_CONVERGED
+    chunk_retraces: int            # jit trace count for chunk programs
+
+    @property
+    def wasted_lane_fraction(self) -> float:
+        """Fraction of executed lane-iterations spent on already-converged
+        or padding lanes (0 = perfect packing)."""
+        if self.executed_lane_iterations == 0:
+            return 0.0
+        return 1.0 - self.sum_entity_iterations / self.executed_lane_iterations
+
+    @property
+    def lane_iteration_savings(self) -> float:
+        """lockstep / executed — ≥1; ≥2 on skewed-convergence workloads."""
+        if self.executed_lane_iterations == 0:
+            return 1.0
+        return self.lockstep_lane_iterations / self.executed_lane_iterations
+
+    def to_summary_string(self) -> str:
+        return (
+            f"bucket {self.bucket} ({self.optimizer}, {self.num_entities} entities): "
+            f"{self.rounds} rounds of K={self.chunk_iters} at widths "
+            f"{list(self.dispatch_widths)}, iterations(p50={self.iterations_p50:.0f}, "
+            f"p99={self.iterations_p99:.0f}, max={self.iterations_max}), "
+            f"lane-iters executed={self.executed_lane_iterations} vs "
+            f"lockstep={self.lockstep_lane_iterations} "
+            f"({self.lane_iteration_savings:.2f}x saved, "
+            f"wasted={self.wasted_lane_fraction:.1%}), "
+            f"converged={self.converged}/{self.num_entities}"
+        )
+
+
 def _stats(x: np.ndarray) -> Dict[str, float]:
     if x.size == 0:
         return {}

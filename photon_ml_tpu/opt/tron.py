@@ -101,6 +101,10 @@ def _truncated_cg(hess_vec, g, delta, max_cg: int, cg_tol: float):
 
 
 class _TronState(NamedTuple):
+    """Resumable TRON loop state: carries the trust radius and init-derived
+    tolerances so chunked execution (``tron_chunk`` every K iterations)
+    follows the one-shot trajectory exactly."""
+
     w: jax.Array
     f: jax.Array
     g: jax.Array
@@ -110,16 +114,17 @@ class _TronState(NamedTuple):
     reason: jax.Array
     history: jax.Array
     w_hist: jax.Array     # [max_iter+1, d] coefficients (or [0] when off)
+    abs_f_tol: jax.Array
+    abs_g_tol: jax.Array
 
 
-def tron_solve(
+def tron_init(
     objective: GlmObjective,
     w0: jax.Array,
     data,
     l2_weight: jax.Array,
     config: OptimizerConfig = OptimizerConfig.tron(),
-    box=None,
-) -> SolveResult:
+) -> _TronState:
     if not objective.has_hessian:
         raise ValueError(
             "TRON requires a twice-differentiable objective; smoothed hinge "
@@ -127,7 +132,6 @@ def tron_solve(
         )
     max_iter = config.max_iterations
     dtype = w0.dtype
-    box_lo, box_hi, has_box = resolve_box(box, config)
 
     f0, g0 = objective.value_and_grad(w0, data, l2_weight)
     g0_norm = jnp.linalg.norm(g0)
@@ -139,7 +143,7 @@ def tron_solve(
         if config.track_coefficients
         else jnp.zeros((0,), dtype=dtype)
     )
-    init = _TronState(
+    return _TronState(
         w=w0,
         f=f0,
         g=g0,
@@ -153,10 +157,31 @@ def tron_solve(
         ),
         history=history0,
         w_hist=w_hist0,
+        abs_f_tol=abs_f_tol,
+        abs_g_tol=abs_g_tol,
     )
 
+
+def tron_chunk(
+    objective: GlmObjective,
+    state: _TronState,
+    data,
+    l2_weight: jax.Array,
+    config: OptimizerConfig = OptimizerConfig.tron(),
+    box=None,
+    num_iters=None,
+) -> _TronState:
+    """Advance by at most ``num_iters`` outer iterations (None = to the
+    end); same chunking contract as ``lbfgs_chunk``."""
+    max_iter = config.max_iterations
+    box_lo, box_hi, has_box = resolve_box(box, config)
+    it_stop = None if num_iters is None else state.it + jnp.int32(num_iters)
+
     def cond(s: _TronState):
-        return (s.reason == ConvergenceReason.NOT_CONVERGED.value) & (s.it < max_iter)
+        c = (s.reason == ConvergenceReason.NOT_CONVERGED.value) & (s.it < max_iter)
+        if it_stop is not None:
+            c = c & (s.it < it_stop)
+        return c
 
     def body(s: _TronState) -> _TronState:
         hv = lambda v: objective.hessian_vec(s.w, v, data, l2_weight)
@@ -202,8 +227,8 @@ def tron_solve(
         g_new = jnp.where(accept, g_try, s.g)
 
         it = s.it + 1
-        g_conv = gradient_converged(jnp.linalg.norm(g_new), abs_g_tol)
-        f_conv = accept & function_values_converged(s.f, f_new, abs_f_tol)
+        g_conv = gradient_converged(jnp.linalg.norm(g_new), s.abs_g_tol)
+        f_conv = accept & function_values_converged(s.f, f_new, s.abs_f_tol)
         too_many_failures = failures >= config.max_improvement_failures
         degenerate = (prered <= 0) & (actred <= 0)
         reason = jnp.where(
@@ -238,20 +263,41 @@ def tron_solve(
                 if config.track_coefficients
                 else s.w_hist
             ),
+            abs_f_tol=s.abs_f_tol,
+            abs_g_tol=s.abs_g_tol,
         )
 
-    out = jax.lax.while_loop(cond, body, init)
+    return jax.lax.while_loop(cond, body, state)
+
+
+def tron_finalize(
+    state: _TronState, config: OptimizerConfig = OptimizerConfig.tron()
+) -> SolveResult:
+    """Convert a (fully run) loop state into the public SolveResult."""
     reason = jnp.where(
-        out.reason == ConvergenceReason.NOT_CONVERGED.value,
+        state.reason == ConvergenceReason.NOT_CONVERGED.value,
         jnp.int32(ConvergenceReason.MAX_ITERATIONS.value),
-        out.reason,
+        state.reason,
     )
     return SolveResult(
-        w=out.w,
-        value=out.f,
-        grad_norm=jnp.linalg.norm(out.g),
-        iterations=out.it,
+        w=state.w,
+        value=state.f,
+        grad_norm=jnp.linalg.norm(state.g),
+        iterations=state.it,
         reason=reason,
-        value_history=out.history,
-        w_history=out.w_hist if config.track_coefficients else None,
+        value_history=state.history,
+        w_history=state.w_hist if config.track_coefficients else None,
     )
+
+
+def tron_solve(
+    objective: GlmObjective,
+    w0: jax.Array,
+    data,
+    l2_weight: jax.Array,
+    config: OptimizerConfig = OptimizerConfig.tron(),
+    box=None,
+) -> SolveResult:
+    state = tron_init(objective, w0, data, l2_weight, config)
+    state = tron_chunk(objective, state, data, l2_weight, config, box=box)
+    return tron_finalize(state, config)

@@ -138,3 +138,40 @@ def test_bench_failure_without_lastgood_is_zero(tmp_path):
     payload = json.loads(proc.stdout.strip().splitlines()[-1])
     assert payload["value"] == 0.0
     assert payload["error"]
+
+
+def test_bench_re_adaptive_contract():
+    """``--re-adaptive`` emits one JSON line with the lane-efficiency and
+    speedup fields the driver parses, and the adaptive path must beat
+    lockstep on executed lane-iterations even at smoke scale."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--re-adaptive"],
+        capture_output=True, text=True, timeout=900, env=_smoke_env(),
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+
+    assert payload["metric"] == "re_adaptive_speedup"
+    assert "error" not in payload
+    assert payload["unit"] == "x_vs_oneshot"
+    assert payload["value"] > 0
+    assert payload["adaptive_wall_s"] > 0
+    assert payload["oneshot_wall_s"] > 0
+    assert payload["executed_lane_iterations"] > 0
+    # lane compaction must shed work relative to the lockstep equivalent
+    assert payload["lane_iteration_savings"] is not None
+    assert payload["lane_iteration_savings"] > 1.0
+    assert 0.0 <= payload["wasted_lane_fraction"] < 1.0
+    # one entry per bucket; widths start at the bucket size and descend
+    # through powers of two
+    for widths, rounds in zip(payload["dispatch_widths"], payload["rounds"]):
+        assert len(widths) == rounds
+        assert widths == sorted(widths, reverse=True)
+        for w in widths[1:]:
+            assert w & (w - 1) == 0
+    assert payload["chunk_iters"] >= 1
+    # smoke mode must not leave an artifact behind (BENCH_RE_ADAPTIVE_WRITE
+    # gates the file write, mirroring the other sub-benches)
+    assert not os.path.exists(os.path.join(REPO, "BENCH_RE_ADAPTIVE.json"))

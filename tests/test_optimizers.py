@@ -434,3 +434,42 @@ def test_solvers_survive_ill_conditioned_data(task_name, solver_name):
     # the solve must improve on w=0
     f0 = float(obj.value(jnp.zeros(8), data, l2))
     assert float(res.value) <= f0 + 1e-6
+
+
+@pytest.mark.parametrize("name", ["lbfgs", "owlqn", "tron"])
+def test_chunked_resume_matches_oneshot(rng, name):
+    """init -> chunk(K) ... -> finalize must follow the EXACT trajectory of
+    the uninterrupted solve: the chunk boundary only caps the while_loop's
+    trip count, it never perturbs the carried state (L-BFGS history ring,
+    TRON trust radius, OWL-QN pseudo-gradient bookkeeping)."""
+    from photon_ml_tpu.opt import solve, solve_chunk, solve_finalize, solve_init
+
+    if name == "tron":
+        data, _ = _linreg_problem(rng)
+        obj = make_glm_objective(SquaredLoss)
+        configuration = GlmOptimizationConfiguration(
+            optimizer_config=OptimizerConfig.tron(),
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=0.1,
+        )
+    else:
+        data, _ = _logreg_problem(rng)
+        obj = make_glm_objective(LogisticLoss)
+        reg = RegularizationType.L1 if name == "owlqn" else RegularizationType.L2
+        configuration = GlmOptimizationConfiguration(
+            regularization=RegularizationContext(reg),
+            regularization_weight=0.01 if name == "owlqn" else 0.1,
+        )
+    d = data.features.matrix.shape[1]
+    w0 = jnp.zeros(d)
+
+    ref = solve(obj, w0, data, configuration)
+    state = solve_init(obj, w0, data, configuration)
+    for _ in range(40):  # 40 chunks x 3 iters covers max_iterations=100
+        state = solve_chunk(obj, state, data, configuration, num_iters=3)
+    res = solve_finalize(state, configuration)
+
+    np.testing.assert_allclose(np.asarray(res.w), np.asarray(ref.w),
+                               rtol=0, atol=1e-6)
+    assert int(res.iterations) == int(ref.iterations)
+    assert int(res.reason) == int(ref.reason)
