@@ -1059,6 +1059,207 @@ def _re_adaptive_bench():
         sys.exit(1)
 
 
+N_CD_USERS = 64 if _SMOKE else 1500         # per-user RE entities
+N_CD_ITEMS = 32 if _SMOKE else 400          # per-item RE entities
+N_CD_ROWS_PER_USER = 12 if _SMOKE else 80   # rows per user
+D_CD_FE = 32 if _SMOKE else 256             # global feature dim
+D_CD_RE = 8                                 # per-entity feature dim
+_CD_SCORES_PATH = os.path.join(_REPO, "BENCH_CD_SCORES.json")
+
+
+def _cd_scores_bench():
+    """Benchmark the device-resident CD score plane against the host numpy
+    plane on a 1-FE + 2-RE GLMix fit. Solver time (train_glm /
+    train_random_effects, block_until_ready'd) is measured separately and
+    subtracted, so the reported reduction isolates the CD driver's own
+    overhead: score-plane algebra, residual regrouping, and host<->device
+    row transfers. Writes BENCH_CD_SCORES.json. Emits ONE JSON line; an
+    exception emits an error line instead."""
+    import sys
+    import time as _time
+
+    try:
+        import jax
+
+        if _SMOKE:
+            jax.config.update("jax_platforms", "cpu")
+        from photon_ml_tpu.algorithm import coordinate as coord_mod
+        from photon_ml_tpu.data.game_data import FeatureShard, GameData
+        from photon_ml_tpu.data.random_effect import (
+            RandomEffectDataConfiguration,
+        )
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+            RandomEffectCoordinateConfiguration,
+        )
+        from photon_ml_tpu.opt import (
+            GlmOptimizationConfiguration,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.opt.config import OptimizerConfig
+        from photon_ml_tpu.types import RegularizationType, TaskType
+
+        rng = np.random.default_rng(SEED)
+        n = N_CD_USERS * N_CD_ROWS_PER_USER
+        Xg = rng.normal(size=(n, D_CD_FE)).astype(np.float32) * 0.3
+        if not _SMOKE:
+            # realistic sparse global shard (~5% density) — keeps the FE
+            # solve and dataset build proportionate at 100k+ rows
+            Xg *= rng.random(size=Xg.shape) < 0.05
+        Xu = rng.normal(size=(n, D_CD_RE)).astype(np.float32)
+        Xi = rng.normal(size=(n, D_CD_RE)).astype(np.float32)
+        user_ids = np.repeat(
+            [f"u{i:05d}" for i in range(N_CD_USERS)], N_CD_ROWS_PER_USER
+        )
+        # skewed item popularity — realistic RE bucket spread
+        item_ids = np.array([
+            f"i{int(v):05d}"
+            for v in np.minimum(
+                rng.zipf(1.7, size=n) - 1, N_CD_ITEMS - 1
+            )
+        ])
+        w_fixed = rng.normal(size=D_CD_FE).astype(np.float32) * 0.1
+        z = Xg @ w_fixed + 0.3 * rng.normal(size=n).astype(np.float32)
+        y = z.astype(np.float32)
+
+        def _coo(X):
+            rows, cols = np.nonzero(X)
+            return FeatureShard(
+                rows=rows, cols=cols, vals=X[rows, cols], dim=X.shape[1]
+            )
+
+        data = GameData(
+            labels=y,
+            feature_shards={
+                "global": _coo(Xg), "per_user": _coo(Xu), "per_item": _coo(Xi),
+            },
+            id_tags={"userId": user_ids, "itemId": item_ids},
+        )
+        # cheap solves: the bench isolates DRIVER overhead, so solver time
+        # (subtracted below) is kept small relative to the plane work
+        opt = GlmOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=1.0,
+            optimizer_config=OptimizerConfig.lbfgs(max_iterations=4),
+        )
+        coords = {
+            "fixed": FixedEffectCoordinateConfiguration("global", opt),
+            "per-user": RandomEffectCoordinateConfiguration(
+                feature_shard="per_user",
+                data=RandomEffectDataConfiguration(random_effect_type="userId"),
+                optimizer=opt,
+            ),
+            "per-item": RandomEffectCoordinateConfiguration(
+                feature_shard="per_item",
+                data=RandomEffectDataConfiguration(random_effect_type="itemId"),
+                optimizer=opt,
+            ),
+        }
+
+        # monkeypatched timing wrappers isolate solver wall-clock
+        solver_s = [0.0]
+        real_glm, real_re = coord_mod.train_glm, coord_mod.train_random_effects
+
+        def _timed(fn):
+            # block on the ARRAYS inside the result: train_glm returns
+            # [GlmFit] (a plain dataclass, opaque to block_until_ready — a
+            # bare block on it returns immediately and the solve's async
+            # compute would leak into the driver-overhead measurement),
+            # train_random_effects returns (RandomEffectModel, diag)
+            def wrapper(*a, **kw):
+                t0 = _time.perf_counter()
+                out = fn(*a, **kw)
+                head = out[0]
+                if hasattr(head, "model"):        # GlmFit
+                    jax.block_until_ready((head.model, head.result))
+                elif hasattr(head, "coefficients"):  # RandomEffectModel
+                    jax.block_until_ready(head.coefficients)
+                else:
+                    jax.block_until_ready(head)
+                solver_s[0] += _time.perf_counter() - t0
+                return out
+            return wrapper
+
+        # datasets are built ONCE and shared (the one-time entity grouping is
+        # not CD driver overhead); only _run_fit is timed
+        builder = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates=coords,
+            num_outer_iterations=3,
+        )
+        built = {
+            cid: builder._build_coordinate(cid, cfg, data)
+            for cid, cfg in builder.coordinate_configs.items()
+        }
+
+        coord_mod.train_glm = _timed(real_glm)
+        coord_mod.train_random_effects = _timed(real_re)
+        try:
+            def _fit(plane):
+                est = GameEstimator(
+                    task=TaskType.LINEAR_REGRESSION,
+                    coordinates=coords,
+                    num_outer_iterations=3,
+                    score_plane=plane,
+                )
+                solver_s[0] = 0.0
+                t0 = _time.perf_counter()
+                fit = est._run_fit(built, data, None, None, None)
+                wall = _time.perf_counter() - t0
+                return est, fit, wall, solver_s[0]
+
+            _fit("host")      # warmup: compiles + caches for both planes
+            _fit("device")
+            reps = 2 if _SMOKE else 3
+            runs = {}
+            for plane in ("host", "device"):
+                best = None
+                for _ in range(reps):
+                    est, fit, wall, solve = _fit(plane)
+                    overhead = wall - solve
+                    if best is None or overhead < best[3]:
+                        best = (est, fit, wall, overhead)
+                runs[plane] = best
+        finally:
+            coord_mod.train_glm = real_glm
+            coord_mod.train_random_effects = real_re
+
+        est_h, fit_h, wall_h, over_h = runs["host"]
+        est_d, fit_d, wall_d, over_d = runs["device"]
+        parity = float(np.max(np.abs(
+            np.asarray(fit_h.model.score(data))
+            - np.asarray(fit_d.model.score(data))
+        )))
+        reduction = 1.0 - over_d / over_h if over_h > 0 else None
+        payload = {
+            "metric": "cd_score_plane_overhead_reduction",
+            "value": round(reduction, 4) if reduction is not None else None,
+            "unit": "fraction_vs_host_plane",
+            "host_wall_s": round(wall_h, 6),
+            "device_wall_s": round(wall_d, 6),
+            "host_overhead_s": round(over_h, 6),
+            "device_overhead_s": round(over_d, 6),
+            "parity_max_abs_diff": parity,
+            "host_transfers": est_h.last_transfer_stats.snapshot(),
+            "device_transfers": est_d.last_transfer_stats.snapshot(),
+            "num_rows": n,
+            "num_coordinates": len(coords),
+            "outer_iterations": 3,
+            "backend": jax.default_backend(),
+        }
+        print(json.dumps(payload))
+        if not _SMOKE or _env_flag("BENCH_CD_SCORES_WRITE"):
+            with open(_CD_SCORES_PATH, "w") as f:
+                json.dump(payload, f, indent=2)
+    except Exception as e:  # noqa: BLE001 - one JSON line per exit path
+        print(json.dumps({
+            "metric": "cd_score_plane_overhead_reduction",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
+
+
 def main():
     """Every exit path emits one JSON line: an uncaught exception anywhere
     (e.g. the tunnel dying mid-phase with the headline already measured)
@@ -1114,6 +1315,14 @@ def _main():
              "reports wall-clock speedup and lane-iteration savings, and "
              "writes BENCH_RE_ADAPTIVE.json",
     )
+    ap.add_argument(
+        "--cd-scores", action="store_true",
+        help="run the CD score-plane benchmark instead of the training "
+             "bench: device-resident running-total score plane vs the host "
+             "numpy plane on a 1-FE + 2-RE fit; reports driver overhead "
+             "reduction (wall minus solver time), row-transfer counts and "
+             "host/device parity, and writes BENCH_CD_SCORES.json",
+    )
     args = ap.parse_args()
 
     if args.serving:
@@ -1124,6 +1333,9 @@ def _main():
         return
     if args.re_adaptive:
         _re_adaptive_bench()
+        return
+    if args.cd_scores:
+        _cd_scores_bench()
         return
 
     watchdog_s = int(os.environ.get("BENCH_WATCHDOG_S", "2700"))

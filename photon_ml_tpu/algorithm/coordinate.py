@@ -27,6 +27,7 @@ from photon_ml_tpu.data.random_effect import RandomEffectDataset
 from photon_ml_tpu.estimators.model_training import train_glm
 from photon_ml_tpu.estimators.random_effect import (
     score_random_effects,
+    score_random_effects_device,
     train_random_effects,
 )
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
@@ -45,6 +46,12 @@ from photon_ml_tpu.types import TaskType
 class Coordinate(abc.ABC):
     """One block of the GAME model (reference Coordinate.scala:27)."""
 
+    # True when score_device/update_model_device avoid ALL row-length
+    # host<->device transfers (overridden by the concrete coordinates that
+    # implement a real device path); the CD driver falls back through the
+    # host methods — and counts the transfers — when False.
+    supports_device_plane = False
+
     @abc.abstractmethod
     def update_model(self, model, residual_scores: np.ndarray):
         """Train this coordinate against residual scores from the others
@@ -55,6 +62,29 @@ class Coordinate(abc.ABC):
     def score(self, model) -> np.ndarray:
         """Raw scores x.w per row of THIS coordinate's training data,
         aligned to global row order, zeros for rows it does not cover."""
+
+    def update_model_device(self, model, residual_scores: jax.Array):
+        """``update_model`` with a device-resident residual plane. The base
+        implementation round-trips through host (coordinates without a
+        device path, e.g. the factored RE block); FE/RE override it with
+        zero-row-transfer versions."""
+        return self.update_model(model, np.asarray(residual_scores))
+
+    def score_device(self, model) -> jax.Array:
+        """``score`` as a device-resident [num_rows] array. Base
+        implementation uploads the host scores; overridden with direct
+        device programs where the coordinate's data is device-resident."""
+        return jnp.asarray(self.score(model))
+
+
+@jax.jit
+def _fused_residual_offsets(base: jax.Array, residual: jax.Array) -> jax.Array:
+    """base_offsets + residual in one program, zero-padding the residual up
+    to the (device-grid) padded batch length when needed. Shapes are static
+    at trace time, so the pad + add fuse into a single XLA computation."""
+    if residual.shape[0] < base.shape[0]:
+        residual = jnp.pad(residual, (0, base.shape[0] - residual.shape[0]))
+    return base + residual
 
 
 @dataclasses.dataclass
@@ -93,6 +123,8 @@ class FixedEffectCoordinate(Coordinate):
         default=None, repr=False
     )
 
+    supports_device_plane = True
+
     def update_model(
         self, model: Optional[GeneralizedLinearModel], residual_scores: np.ndarray
     ) -> GeneralizedLinearModel:
@@ -100,9 +132,24 @@ class FixedEffectCoordinate(Coordinate):
         n_pad = self.data.num_rows
         if residual.shape[0] < n_pad:
             residual = np.pad(residual, (0, n_pad - residual.shape[0]))
-        data = self.data.replace(
-            offsets=self.data.offsets + jnp.asarray(residual)
+        return self._update_with_offsets(
+            model, self.data.offsets + jnp.asarray(residual)
         )
+
+    def update_model_device(
+        self, model: Optional[GeneralizedLinearModel], residual_scores: jax.Array
+    ) -> GeneralizedLinearModel:
+        """Device-plane update: the residual stays on device and the pad +
+        base-offset add run as ONE fused jit program feeding the solve — no
+        row-length host transfer anywhere on this path."""
+        return self._update_with_offsets(
+            model, _fused_residual_offsets(self.data.offsets, residual_scores)
+        )
+
+    def _update_with_offsets(
+        self, model: Optional[GeneralizedLinearModel], offsets: jax.Array
+    ) -> GeneralizedLinearModel:
+        data = self.data.replace(offsets=offsets)
         rate = self.configuration.down_sampling_rate
         if rate < 1.0:
             # runWithSampling (reference DistributedOptimizationProblem
@@ -146,14 +193,10 @@ class FixedEffectCoordinate(Coordinate):
         sharded cache."""
         if model is None or self.num_real_cols is None:
             return model
-        d_pad = self.data.dim
-        w = self._cached_padded_w(model)
-        if w is None:
-            w = jnp.asarray(model.coefficients.means)
-            if w.shape[0] < d_pad:
-                w = jnp.pad(w, (0, d_pad - w.shape[0]))
         return model.replace(
-            coefficients=model.coefficients.replace(means=w, variances=None)
+            coefficients=model.coefficients.replace(
+                means=self._padded_w(model), variances=None
+            )
         )
 
     def _trim_model(self, model: GeneralizedLinearModel) -> GeneralizedLinearModel:
@@ -170,13 +213,29 @@ class FixedEffectCoordinate(Coordinate):
             )
         )
 
-    def score(self, model: GeneralizedLinearModel) -> np.ndarray:
+    def _padded_w(self, model: GeneralizedLinearModel) -> jax.Array:
+        """The [d_pad] solve-space weight vector for ``model``, cached by
+        model identity: a miss pads once and REFILLS the cache, so repeated
+        score calls against the same trimmed model (every CD residual uses
+        the other coordinates' scores) never re-pad."""
         w = self._cached_padded_w(model)
         if w is None:
             w = jnp.asarray(model.coefficients.means)
             if self.num_real_cols is not None and w.shape[0] < self.data.dim:
                 w = jnp.pad(w, (0, self.data.dim - w.shape[0]))
-        scores = fetch_global(self.data.features.matvec(w))
+            self._w_padded_cache = (model, w)
+        return w
+
+    def score(self, model: GeneralizedLinearModel) -> np.ndarray:
+        scores = fetch_global(self.data.features.matvec(self._padded_w(model)))
+        if self.num_real_rows is not None:
+            scores = scores[: self.num_real_rows]
+        return scores
+
+    def score_device(self, model: GeneralizedLinearModel) -> jax.Array:
+        """Device-plane ``score``: the matvec result never leaves the mesh;
+        padded batch rows are sliced off on device."""
+        scores = self.data.features.matvec(self._padded_w(model))
         if self.num_real_rows is not None:
             scores = scores[: self.num_real_rows]
         return scores
@@ -208,6 +267,13 @@ class RandomEffectCoordinate(Coordinate):
     # per-entity coefficient variances from the local Hessian diagonals
     # (reference COMPUTE_VARIANCE; SingleNodeOptimizationProblem variances)
     compute_variances: bool = False
+    # base_offsets uploaded once; every device-plane update reuses it in the
+    # jitted regroup instead of re-pushing a row-length host array
+    _base_offsets_dev: Optional[jax.Array] = dataclasses.field(
+        default=None, repr=False
+    )
+
+    supports_device_plane = True
 
     def _place(self, ds: RandomEffectDataset) -> RandomEffectDataset:
         if self.mesh is None:
@@ -222,6 +288,28 @@ class RandomEffectCoordinate(Coordinate):
         ds = self._place(
             self.dataset.update_offsets(self.base_offsets + residual_scores)
         )
+        return self._train(ds, model)
+
+    def update_model_device(
+        self, model: Optional[RandomEffectModel], residual_scores: jax.Array
+    ) -> RandomEffectModel:
+        """Device-plane update: base + residual offsets are regrouped into
+        the entity-grouped blocks by the precomputed (bucket, lane, slot)
+        gather on device — the per-update host rebuild disappears."""
+        if self._base_offsets_dev is None:
+            self._base_offsets_dev = jnp.asarray(
+                np.asarray(self.base_offsets, dtype=np.float32)
+            )
+        ds = self._place(
+            self.dataset.update_offsets_device(
+                _fused_residual_offsets(self._base_offsets_dev, residual_scores)
+            )
+        )
+        return self._train(ds, model)
+
+    def _train(
+        self, ds: RandomEffectDataset, model: Optional[RandomEffectModel]
+    ) -> RandomEffectModel:
         stats: list = []
         new_model, results = train_random_effects(
             ds, self.task, self.configuration, initial_model=model,
@@ -239,3 +327,6 @@ class RandomEffectCoordinate(Coordinate):
 
     def score(self, model: RandomEffectModel) -> np.ndarray:
         return score_random_effects(model, self.dataset)
+
+    def score_device(self, model: RandomEffectModel) -> jax.Array:
+        return score_random_effects_device(model, self.dataset)

@@ -5,22 +5,66 @@ Reference parity: algorithm/CoordinateDescent.scala:40 (run :57, optimize
 the coordinate's own score (:183), retrain the coordinate against the
 residual, rescore, log the objective (:247-258), evaluate validation after
 each coordinate update (:265-294), and keep the best full model seen by the
-first evaluator (:299-307). The reference's aggressive RDD persist/unpersist
-choreography disappears: scores are small device/host arrays.
+first evaluator (:299-307).
+
+Score plane: the reference's aggressive RDD persist/unpersist choreography
+becomes per-coordinate score arrays — but at production row counts those are
+NOT small, so where they live matters. Two planes are supported:
+
+- ``score_plane="device"`` (default): scores are device-resident
+  ``jax.Array``s on the training mesh. The driver maintains a RUNNING total
+  updated incrementally (``total += new_own - old_own``) and computes
+  ``residual = total - own`` inside jitted programs with donated buffers —
+  O(C·N) device work per outer iteration, ZERO row-length host transfers in
+  the steady state, and the training objective re-uses the running total
+  (one plane pass per update instead of two full C-way re-sums).
+- ``score_plane="host"``: the numpy plane, kept for fallback and parity
+  testing (and auto-selected under multi-controller runs, where the host
+  path's ``fetch_global`` collectives are the proven ordering). It runs the
+  SAME incremental algebra in numpy — bitwise-identical IEEE f32 ops, so
+  the two planes train bitwise-equal models — but pays two row-length
+  boundary crossings per update (score pull, residual push) plus the host
+  memory traffic of the numpy adds.
+
+``transfer_stats`` (opt.tracking.TransferStats) counts every row-length
+array crossing the host/device boundary plus host plane re-sums; a
+``TransferStatsEvent`` with per-iteration deltas is emitted after each outer
+iteration.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.algorithm.coordinate import Coordinate
 from photon_ml_tpu.evaluation.evaluators import nan_aware_better_than
+from photon_ml_tpu.opt.tracking import TransferStats
 
 logger = logging.getLogger("photon_ml_tpu")
+
+SCORE_PLANES = ("device", "host")
+
+
+@functools.lru_cache(maxsize=None)
+def _plane_programs():
+    """Jitted score-plane algebra, cached per process. ``apply`` donates the
+    running total so each incremental update writes in place instead of
+    copying a row-length buffer (CPU ignores donation and warns, so it is
+    only requested on accelerators)."""
+    donate = () if jax.default_backend() == "cpu" else (0,)
+    apply_ = jax.jit(
+        lambda total, new_own, old_own: total + new_own - old_own,
+        donate_argnums=donate,
+    )
+    residual_ = jax.jit(lambda total, own: total - own)
+    return apply_, residual_
 
 
 @dataclasses.dataclass
@@ -48,9 +92,14 @@ class CoordinateDescent:
         validate: Optional[Callable[[Dict[str, object]], float]] = None,
         validation_better_than: Optional[Callable[[float, float], bool]] = None,
         emitter: Optional[object] = None,
+        score_plane: str = "device",
     ) -> None:
         if not coordinates:
             raise ValueError("need at least one coordinate")
+        if score_plane not in SCORE_PLANES:
+            raise ValueError(
+                f"score_plane must be one of {SCORE_PLANES}, got {score_plane!r}"
+            )
         self.coordinates = coordinates
         self.num_rows = num_rows
         self.update_order = list(update_order) if update_order else list(coordinates)
@@ -67,8 +116,14 @@ class CoordinateDescent:
         # policy) come from the evaluator itself; default: larger is better.
         self.validation_better_than = validation_better_than or nan_aware_better_than
         # optional event.EventEmitter: per-bucket SolverStatsEvent after each
-        # random-effect coordinate update (adaptive-solve lane telemetry)
+        # random-effect coordinate update (adaptive-solve lane telemetry) and
+        # a TransferStatsEvent per outer iteration
         self.emitter = emitter
+        self.score_plane = score_plane
+        # transfer accounting of the most recent (or in-flight) run
+        self.transfer_stats = TransferStats(
+            score_plane=score_plane, num_rows=num_rows
+        )
 
     def _emit_solver_stats(self, cid: str, coord: Coordinate) -> None:
         stats = getattr(coord, "last_solver_stats", None)
@@ -83,6 +138,37 @@ class CoordinateDescent:
         for s in stats:
             self.emitter.send_event(SolverStatsEvent.from_stats(cid, s))
 
+    def _emit_transfer_stats(self, outer: int, prev: Dict[str, object]) -> None:
+        """One TransferStatsEvent with THIS iteration's deltas."""
+        t = self.transfer_stats
+        t.outer_iterations += 1
+        if self.emitter is None:
+            return
+        from photon_ml_tpu.event import TransferStatsEvent
+
+        cur = t.snapshot()
+        per_row = t.bytes_per_row_array
+        d_h2d = int(cur["row_transfers_h2d"]) - int(prev["row_transfers_h2d"])
+        d_d2h = int(cur["row_transfers_d2h"]) - int(prev["row_transfers_d2h"])
+        self.emitter.send_event(
+            TransferStatsEvent(
+                score_plane=t.score_plane,
+                outer_iteration=outer,
+                num_rows=t.num_rows,
+                row_transfers_h2d=d_h2d,
+                row_transfers_d2h=d_d2h,
+                row_bytes_h2d=d_h2d * per_row,
+                row_bytes_d2h=d_d2h * per_row,
+                host_score_sums=(
+                    int(cur["host_score_sums"]) - int(prev["host_score_sums"])
+                ),
+                device_plane_updates=(
+                    int(cur["device_plane_updates"])
+                    - int(prev["device_plane_updates"])
+                ),
+            )
+        )
+
     def run(
         self,
         num_iterations: int,
@@ -95,18 +181,49 @@ class CoordinateDescent:
         checkpoint-resume: the callback fires after each outer iteration with
         the running result; resume passes the restored models and best-so-far
         back in and skips completed iterations."""
+        device = self.score_plane == "device"
+        stats = self.transfer_stats = TransferStats(
+            score_plane=self.score_plane, num_rows=self.num_rows
+        )
         models: Dict[str, object] = dict(initial_models or {})
-        scores: Dict[str, np.ndarray] = {}
+        scores: Dict[str, object] = {}
+
+        def _score(cid: str, model) -> object:
+            """One coordinate's [num_rows] scores on the active plane."""
+            coord = self.coordinates[cid]
+            if not device:
+                stats.record_d2h()  # host plane pulls every score to numpy
+                return coord.score(model)
+            if coord.supports_device_plane:
+                return coord.score_device(model)
+            # fallback coordinate (e.g. factored RE): its host scores are
+            # pulled down then pushed back up onto the device plane
+            stats.record_d2h()
+            stats.record_h2d()
+            return coord.score_device(model)
 
         # initial scoring for warm-started models
         for cid, model in models.items():
-            scores[cid] = self.coordinates[cid].score(model)
+            scores[cid] = _score(cid, model)
 
-        def total_score() -> np.ndarray:
-            out = np.zeros(self.num_rows, dtype=np.float32)
+        # Both planes maintain a RUNNING total (the legacy driver re-summed
+        # all C coordinates TWICE per update — once for the residual, once
+        # for the objective; host_score_sums stays 0 now and the regression
+        # test pins that down). The two planes execute the same sequence of
+        # IEEE f32 elementwise adds/subs — np on host, XLA on device — so
+        # their residuals (and therefore the trained models) match bitwise.
+        if device:
+            apply_, residual_ = _plane_programs()
+            zeros = jnp.zeros(self.num_rows, dtype=jnp.float32)
+            # fresh buffer: ``apply_`` donates its first argument, and the
+            # shared ``zeros`` must outlive every first-update residual
+            total = jnp.zeros_like(zeros)
             for s in scores.values():
-                out += s
-            return out
+                total = total + s
+        else:
+            total_np = np.zeros(self.num_rows, dtype=np.float32)
+            for s in scores.values():
+                total_np = total_np + s
 
         objective_history: List[Tuple[str, float]] = []
         validation_history: List[Tuple[str, float]] = []
@@ -116,20 +233,60 @@ class CoordinateDescent:
             best_models, best_metric = dict(initial_best[0]), initial_best[1]
 
         for outer in range(start_iteration, num_iterations):
+            prev_transfers = stats.snapshot()
             for cid in self.update_order:
                 coord = self.coordinates[cid]
+                stats.coordinate_updates += 1
                 # partialScore = fullScore - ownScore (reference
                 # CoordinateDescent.scala:183)
-                residual = total_score()
-                if cid in scores:
-                    residual -= scores[cid]
-                model = coord.update_model(models.get(cid), residual)
-                models[cid] = model
-                scores[cid] = coord.score(model)
+                if device:
+                    old_own = scores.get(cid)
+                    residual = residual_(
+                        total, old_own if old_own is not None else zeros
+                    )
+                    if coord.supports_device_plane:
+                        model = coord.update_model_device(
+                            models.get(cid), residual
+                        )
+                    else:
+                        stats.record_d2h()
+                        model = coord.update_model(
+                            models.get(cid), np.asarray(residual)
+                        )
+                    models[cid] = model
+                    new_own = _score(cid, model)
+                    # incremental running total: O(N) per update instead of
+                    # a C-way re-sum; the old total's buffer is donated
+                    total = apply_(
+                        total,
+                        new_own,
+                        old_own if old_own is not None else zeros,
+                    )
+                    stats.device_plane_updates += 1
+                    scores[cid] = new_own
+                else:
+                    old_own = scores.get(cid)
+                    residual = (
+                        total_np - old_own if old_own is not None else total_np.copy()
+                    )
+                    stats.record_h2d()  # the coordinate pushes the residual
+                    model = coord.update_model(models.get(cid), residual)
+                    models[cid] = model
+                    new_own = _score(cid, model)
+                    # same incremental algebra as the device plane, in numpy
+                    total_np = (
+                        total_np + new_own - old_own
+                        if old_own is not None
+                        else total_np + new_own
+                    )
+                    scores[cid] = new_own
                 self._emit_solver_stats(cid, coord)
 
                 if self.training_objective is not None:
-                    loss_val = float(self.training_objective(total_score()))
+                    # both planes re-use the running total — the legacy
+                    # second full re-sum per update is gone
+                    plane_total = total if device else total_np
+                    loss_val = float(self.training_objective(plane_total))
                     if self.regularization_term is not None:
                         # objective = loss + regularization (reference
                         # CoordinateDescent.scala:247-258); the history and
@@ -167,6 +324,7 @@ class CoordinateDescent:
                         best_metric = metric
                         best_models = dict(models)
 
+            self._emit_transfer_stats(outer, prev_transfers)
             if on_iteration_end is not None:
                 on_iteration_end(
                     outer,
@@ -179,6 +337,7 @@ class CoordinateDescent:
                     ),
                 )
 
+        logger.info("CD %s", stats.to_summary_string())
         if self.validate is None or not best_models:
             best_models = dict(models)
         return CoordinateDescentResult(

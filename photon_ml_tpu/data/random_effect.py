@@ -123,6 +123,14 @@ class RandomEffectDataset:
     entity_to_loc: Dict[str, Tuple[int, int]]  # id -> (bucket, row)
     num_rows: int                            # total rows in the source data
     global_dim: int
+    # row -> slot in the concatenation of per-bucket flattened active score
+    # blocks [E*S] (bucket order, each followed by its passive block [P]),
+    # with one trailing zero slot for rows no bucket covers. The inverse of
+    # the sample_pos scatter: scoring becomes a single gather, which stays
+    # vectorized on backends (CPU, TPU) where scatter-add serializes.
+    row_gather: Optional[jax.Array] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     @property
     def num_entities(self) -> int:
@@ -166,6 +174,80 @@ class RandomEffectDataset:
             off = np.where(wt > 0, offsets[pos], 0.0).astype(np.float32)
             new_buckets.append(b.replace(offsets=jnp.asarray(off)))
         return dataclasses.replace(self, buckets=new_buckets)
+
+    def gather_index(self) -> jax.Array:
+        """The cached ``row_gather`` permutation, built from host copies of
+        the bucket layout on first use for datasets that were not produced by
+        :func:`build_random_effect_dataset` (which precomputes it so the
+        steady-state training loop never touches host memory)."""
+        if self.row_gather is None:
+            from photon_ml_tpu.parallel.mesh import fetch_global
+
+            self.row_gather = _build_row_gather(
+                self.num_rows,
+                [
+                    (fetch_global(b.sample_pos), fetch_global(b.weights))
+                    for b in self.buckets
+                ],
+                [
+                    None if p is None else np.asarray(fetch_global(p.sample_pos))
+                    for p in self.passive
+                ],
+            )
+        return self.row_gather
+
+    def update_offsets_device(self, offsets: jax.Array) -> "RandomEffectDataset":
+        """Device-plane ``update_offsets``: regroup a full-data device offset
+        vector into the entity-grouped [E, S] blocks with one jitted gather
+        per bucket. ``sample_pos`` IS the precomputed row -> (bucket, lane,
+        slot) permutation from build time, so no host rebuild happens — the
+        whole regroup is a device gather masked by the active-slot mask."""
+        new_buckets = [
+            b.replace(
+                offsets=_regroup_offsets(offsets, b.sample_pos, b.weights)
+            )
+            for b in self.buckets
+        ]
+        return dataclasses.replace(self, buckets=new_buckets)
+
+
+def _build_row_gather(
+    num_rows: int,
+    actives: List[Tuple[np.ndarray, np.ndarray]],
+    passive_pos: List[Optional[np.ndarray]],
+) -> jax.Array:
+    """Invert the (sample_pos, weights>0) scatter into a row -> source-slot
+    index over the concatenation [active_b0 | passive_b0 | active_b1 | ...]
+    plus one trailing zero slot (rows outside every bucket gather 0.0).
+    Active rows are unique across (bucket, lane, slot), so each row has
+    exactly one source and the gather reproduces the scatter bitwise."""
+    total = sum(pos.size for pos, _ in actives) + sum(
+        0 if sp is None else sp.size for sp in passive_pos
+    )
+    inv = np.full(num_rows, total, dtype=np.int32)
+    base = 0
+    for (pos, wt), sp in zip(actives, passive_pos):
+        flat_pos = np.asarray(pos).ravel()
+        m = np.asarray(wt).ravel() > 0
+        inv[flat_pos[m]] = (base + np.nonzero(m)[0]).astype(np.int32)
+        base += flat_pos.size
+        if sp is not None:
+            inv[np.asarray(sp)] = (
+                base + np.arange(sp.size, dtype=np.int32)
+            )
+            base += sp.size
+    return jnp.asarray(inv)
+
+
+@jax.jit
+def _regroup_offsets(
+    offsets: jax.Array, sample_pos: jax.Array, weights: jax.Array
+) -> jax.Array:
+    """offsets[sample_pos] masked to active slots — the device-resident
+    equivalent of the host rebuild in :meth:`RandomEffectDataset
+    .update_offsets` (padding slots carry sample_pos 0; the mask keeps their
+    offsets at exactly 0 like the host path)."""
+    return jnp.where(weights > 0, offsets[sample_pos], 0.0)
 
 
 def _expand_nnz(
@@ -522,6 +604,8 @@ def build_random_effect_dataset(
     passives: List[Optional[RePassiveRows]] = []
     bucket_ids: List[List[str]] = []
     entity_to_loc: Dict[str, Tuple[int, int]] = {}
+    host_actives: List[Tuple[np.ndarray, np.ndarray]] = []
+    host_passive_pos: List[Optional[np.ndarray]] = []
 
     for b in range(nb):
         ent_m = bucket_of == b
@@ -628,6 +712,10 @@ def build_random_effect_dataset(
             else None
         )
         bucket_ids.append(ids_b)
+        host_actives.append((pos, wt))
+        host_passive_pos.append(
+            pas_b.astype(np.int32) if n_pas else None
+        )
 
     return RandomEffectDataset(
         config=config,
@@ -637,6 +725,7 @@ def build_random_effect_dataset(
         entity_to_loc=entity_to_loc,
         num_rows=n,
         global_dim=int(global_dim),
+        row_gather=_build_row_gather(n, host_actives, host_passive_pos),
     )
 
 
@@ -650,11 +739,13 @@ def pad_entities_to_multiple(
     if multiple <= 1:
         return dataset
     new_buckets = []
+    padded_any = False
     for b in dataset.buckets:
         pad = (-b.num_entities) % multiple
         if pad == 0:
             new_buckets.append(b)
             continue
+        padded_any = True
         def pad0(a):
             return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
         new_buckets.append(
@@ -668,7 +759,13 @@ def pad_entities_to_multiple(
                 proj_valid=pad0(b.proj_valid),
             )
         )
-    return dataclasses.replace(dataset, buckets=new_buckets)
+    if not padded_any:
+        return dataset
+    # entity padding grows the flattened [E*S] blocks: the cached row_gather
+    # slots shift, so drop it and let gather_index() rebuild lazily
+    return dataclasses.replace(
+        dataset, buckets=new_buckets, row_gather=None
+    )
 
 
 def place_dataset(dataset: RandomEffectDataset, mesh, axis_names) -> "RandomEffectDataset":

@@ -17,6 +17,7 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -25,7 +26,10 @@ from photon_ml_tpu.algorithm.coordinate import (
     FixedEffectCoordinate,
     RandomEffectCoordinate,
 )
-from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.algorithm.coordinate_descent import (
+    SCORE_PLANES,
+    CoordinateDescent,
+)
 from photon_ml_tpu.algorithm.factored_random_effect import (
     FactoredRandomEffectCoordinate,
     MFOptimizationConfiguration,
@@ -188,6 +192,7 @@ class GameEstimator:
         extra_evaluators: Sequence[Evaluator] = (),
         compute_variance: bool = False,
         emitter: Optional[object] = None,
+        score_plane: str = "device",
     ) -> None:
         """``normalization``/``intercept_indices`` are per-feature-shard;
         they apply to fixed-effect coordinates (training runs in normalized
@@ -218,8 +223,30 @@ class GameEstimator:
         # optional event.EventEmitter for SolverStatsEvent telemetry from the
         # CD driver (adaptive random-effect lane efficiency)
         self.emitter = emitter
+        # where the CD score plane lives: "device" keeps per-coordinate score
+        # arrays resident on the training mesh with scalar-only host
+        # transfers; "host" is the legacy numpy plane. Multi-controller runs
+        # always use the host plane — its fetch_global collectives are the
+        # proven cross-process ordering.
+        if score_plane not in SCORE_PLANES:
+            raise ValueError(
+                f"score_plane must be one of {SCORE_PLANES}, got {score_plane!r}"
+            )
+        self.score_plane = score_plane
         # per-bucket SolverStats from the most recent resolve_coordinate call
         self.last_resolve_stats: list = []
+        # TransferStats from the most recent _run_fit / resolve_coordinate
+        self.last_transfer_stats = None
+        self.last_resolve_transfers = None
+
+    def _effective_score_plane(self) -> str:
+        """Device plane requires fully-addressable score arrays; under a
+        multi-controller runtime eager per-row ops on globally-sharded
+        arrays are not safe, so fall back to the host plane (whose
+        fetch_global collectives run in identical order on every process)."""
+        if jax.process_count() > 1:
+            return "host"
+        return self.score_plane
 
     def _build_coordinate(
         self, cid: str, cfg: CoordinateConfiguration, data: GameData
@@ -466,7 +493,23 @@ class GameEstimator:
             from photon_ml_tpu.estimators.random_effect import align_warm_start
 
             model0 = align_warm_start(model0, coord.dataset)
-        updated = coord.update_model(model0, residual)
+        from photon_ml_tpu.opt.tracking import TransferStats
+
+        effective_plane = self._effective_score_plane()
+        transfers = TransferStats(
+            score_plane=effective_plane, num_rows=data.num_rows
+        )
+        transfers.coordinate_updates = 1
+        if effective_plane == "device" and coord.supports_device_plane:
+            # one residual upload; the offset regroup onto the coordinate's
+            # padded blocks happens on device (no further row transfers)
+            transfers.record_h2d()
+            transfers.device_plane_updates = 1
+            updated = coord.update_model_device(model0, jnp.asarray(residual))
+        else:
+            transfers.record_h2d()
+            updated = coord.update_model(model0, residual)
+        self.last_resolve_transfers = transfers
         # warm-started nearline re-solves have the largest iteration skew —
         # surface the adaptive driver's lane telemetry to the caller
         self.last_resolve_stats = list(getattr(coord, "last_solver_stats", []))
@@ -617,7 +660,9 @@ class GameEstimator:
         weights = jnp.asarray(data.weights)
         offsets = jnp.asarray(data.offsets)
 
-        def training_objective(total_scores: np.ndarray) -> float:
+        def training_objective(total_scores) -> float:
+            # accepts the device plane's running total (jax.Array) or the
+            # host plane's numpy sum; exactly ONE scalar crosses to the host
             z = offsets + jnp.asarray(total_scores)
             terms = loss.value(z, labels)
             return float(jnp.sum(jnp.where(weights > 0, weights * terms, 0.0)))
@@ -679,6 +724,7 @@ class GameEstimator:
             validate=validate,
             validation_better_than=self.evaluator.better_than,
             emitter=self.emitter,
+            score_plane=self._effective_score_plane(),
         )
 
         start_iteration = 0
@@ -738,6 +784,7 @@ class GameEstimator:
             initial_best=initial_best,
             on_iteration_end=on_iteration_end,
         )
+        self.last_transfer_stats = cd.transfer_stats
         model = GameModel(models=result.best_models, meta=meta, task=self.task)
         return GameFit(
             model=model,

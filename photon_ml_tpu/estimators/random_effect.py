@@ -471,6 +471,39 @@ def _fit_entity_axis(w: jax.Array, num_entities: int) -> jax.Array:
     return w[:num_entities]
 
 
+@jax.jit
+def _gathered_scores(coeffs, buckets, passives, row_gather):
+    """All buckets' active + passive scores assembled into the row-order
+    plane with one gather through the precomputed row -> source-slot index
+    (``RandomEffectDataset.row_gather``). XLA scatter-add serializes on CPU
+    (and degrades on TPU); every row has exactly one source slot, so the
+    gather is its fast dual and reproduces the scatter bitwise — padding
+    slots and inactive lanes are simply never referenced."""
+    parts = []
+    for w, bucket, p in zip(coeffs, buckets, passives):
+        w_b = _fit_entity_axis(w, bucket.num_entities)
+        parts.append(jnp.einsum("esd,ed->es", bucket.X, w_b).reshape(-1))
+        if p is not None:
+            parts.append(jnp.einsum("pd,pd->p", p.X, w[p.entity_index]))
+    flat = jnp.concatenate(parts + [jnp.zeros(1, dtype=jnp.float32)])
+    return flat[row_gather]
+
+
+def score_random_effects_device(
+    model: RandomEffectModel, dataset: RandomEffectDataset
+) -> jax.Array:
+    """Device-plane :func:`score_random_effects`: the same active + passive
+    scores, assembled into a device-resident [num_rows] plane — no host
+    round trip. Numerically identical to the host path (each row has
+    exactly one source bucket/slot)."""
+    return _gathered_scores(
+        list(model.coefficients),
+        dataset.buckets,
+        dataset.passive,
+        dataset.gather_index(),
+    )
+
+
 def score_random_effects(
     model: RandomEffectModel, dataset: RandomEffectDataset
 ) -> np.ndarray:

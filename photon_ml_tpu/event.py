@@ -127,6 +127,24 @@ class SolverStatsEvent(Event):
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class TransferStatsEvent(Event):
+    """Per-outer-iteration score-plane transfer accounting from the
+    coordinate-descent driver (opt.tracking.TransferStats deltas): row-length
+    score arrays moved host<->device plus host score-plane re-sums. On the
+    device plane the steady state is all-zero row transfers."""
+
+    score_plane: str
+    outer_iteration: int
+    num_rows: int
+    row_transfers_h2d: int
+    row_transfers_d2h: int
+    row_bytes_h2d: int
+    row_bytes_d2h: int
+    host_score_sums: int
+    device_plane_updates: int
+
+
 class EventListener:
     """Receives every event from an emitter (EventListener.scala)."""
 

@@ -58,6 +58,10 @@ class IncrementalUpdate:
     # re-solves — the convergence-adaptive driver's lane telemetry; nearline
     # batches have the largest iteration skew so the savings show up here
     solver_stats: Dict[str, list] = dataclasses.field(default_factory=dict)
+    # per-coordinate TransferStats (opt.tracking) from the same re-solves:
+    # on the device score plane each re-solve uploads exactly one residual
+    # array and regroups offsets on device (zero further row transfers)
+    transfer_stats: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def game_model(self, estimator: GameEstimator) -> GameModel:
         return GameModel(
@@ -133,6 +137,7 @@ def incremental_update(
     touched: Dict[str, Tuple[str, ...]] = {}
     new: Dict[str, Tuple[str, ...]] = {}
     solver_stats: Dict[str, list] = {}
+    transfer_stats: Dict[str, object] = {}
     for cid in re_cids:
         old = models.get(cid)
         if old is not None and not isinstance(old, RandomEffectModel):
@@ -143,6 +148,8 @@ def incremental_update(
         sub = estimator.resolve_coordinate(cid, events, models)
         if estimator.last_resolve_stats:
             solver_stats[cid] = list(estimator.last_resolve_stats)
+        if estimator.last_resolve_transfers is not None:
+            transfer_stats[cid] = estimator.last_resolve_transfers
         rows = {str(eid): coefs for eid, coefs in sub.items()}
         touched[cid] = tuple(sorted(rows))
         known = set(old.entity_to_loc) if old is not None else set()
@@ -171,4 +178,5 @@ def incremental_update(
         new_entities=new,
         num_events=events.num_rows,
         solver_stats=solver_stats,
+        transfer_stats=transfer_stats,
     )

@@ -159,6 +159,24 @@ def _gather_fn(sharding: NamedSharding):
     return jax.jit(lambda x: x, out_shardings=sharding)
 
 
+# Device->host fetch observers: callbacks invoked with the byte size of
+# every array fetch_global materializes on host. The zero-row-transfer
+# steady-state tests of the device score plane install one to prove no code
+# path (driver OR coordinate internals) silently pulls a row-length score
+# array; fetches of genuinely-host numpy inputs are not device transfers and
+# are only observed when the input was a jax.Array.
+_FETCH_OBSERVERS: list = []
+
+
+def add_fetch_observer(callback) -> None:
+    """Register ``callback(nbytes)`` to fire on every device->host fetch."""
+    _FETCH_OBSERVERS.append(callback)
+
+
+def remove_fetch_observer(callback) -> None:
+    _FETCH_OBSERVERS.remove(callback)
+
+
 def fetch_global(a):
     """``np.asarray`` for device arrays that may span processes: a sharded
     global array is all-gathered to a replicated layout first (every shard
@@ -169,10 +187,15 @@ def fetch_global(a):
     In a multi-host run this is a cross-process COLLECTIVE: every process
     must call it in the same order (never behind data-dependent branches).
     """
+    was_device = isinstance(a, jax.Array)
     if (
-        isinstance(a, jax.Array)
+        was_device
         and jax.process_count() > 1
         and not a.is_fully_addressable
     ):
         a = _gather_fn(NamedSharding(a.sharding.mesh, P()))(a)
-    return np.asarray(a)
+    out = np.asarray(a)
+    if was_device and _FETCH_OBSERVERS:
+        for cb in list(_FETCH_OBSERVERS):
+            cb(out.nbytes)
+    return out

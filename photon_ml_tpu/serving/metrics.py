@@ -4,14 +4,19 @@ The batcher feeds per-request latencies (enqueue → scored) and per-batch
 fill/queue observations; ``snapshot`` renders everything as one plain dict
 so it can be logged, JSON-dumped by the CLI/bench, or attached to a
 ``ScoringFinishEvent``. Latencies additionally land in a fixed log-spaced
-histogram (100µs … 10s) whose bucket counts survive in the snapshot even
-if a future caller decides to drop the raw samples.
+histogram (100µs … 10s) whose bucket counts are EXACT for the lifetime of
+the collector.
+
+Memory is bounded: a long-lived scorer observes millions of requests, so
+raw per-observation lists would grow without limit. Percentile estimates
+come from fixed-size uniform reservoirs (Vitter's Algorithm R); counts,
+sums, maxima, and the histogram are exact running aggregates.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -21,16 +26,66 @@ LATENCY_BUCKET_BOUNDS = tuple(
     float(b) for b in np.logspace(-4, 1, num=5 * 8 + 1)
 )
 
+# Reservoir capacity for percentile estimation. Below this many
+# observations the samples are exact; beyond it each kept sample is a
+# uniform draw, so a p99 over 4096 samples has ~40 tail points — stable to
+# well under a histogram bucket width.
+RESERVOIR_SIZE = 4096
+
+
+class _Reservoir:
+    """Uniform fixed-size sample of a stream (Vitter's Algorithm R) plus
+    exact running count/sum/max. Deterministic for a given observation
+    sequence (seeded generator) so snapshots are reproducible in tests."""
+
+    __slots__ = ("capacity", "count", "total", "maximum", "_samples", "_rng")
+
+    def __init__(self, capacity: int = RESERVOIR_SIZE, seed: int = 0):
+        self.capacity = int(capacity)
+        self.count = 0
+        self.total = 0.0
+        self.maximum = 0.0
+        self._samples: np.ndarray = np.empty(self.capacity, dtype=np.float64)
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return min(self.count, self.capacity)
+
+    def add(self, value: float) -> None:
+        value = float(value)
+        if self.count == 0 or value > self.maximum:
+            self.maximum = value
+        self.total += value
+        if self.count < self.capacity:
+            self._samples[self.count] = value
+        else:
+            j = int(self._rng.integers(0, self.count + 1))
+            if j < self.capacity:
+                self._samples[j] = value
+        self.count += 1
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def samples(self) -> np.ndarray:
+        return self._samples[: len(self)]
+
+    def percentile(self, q) -> np.ndarray:
+        return np.percentile(self.samples(), q)
+
 
 class ServingMetrics:
     def __init__(self, clock: Callable[[], float] = time.perf_counter):
         self._clock = clock
-        self._latencies: List[float] = []
+        self._latencies = _Reservoir(seed=0)
         self._hist = np.zeros(len(LATENCY_BUCKET_BOUNDS) + 1, dtype=np.int64)
         self._fill_real = 0
         self._fill_padded = 0
-        self._queue_depths: List[int] = []
-        self._queue_waits: List[float] = []
+        self._queue_depth_sum = 0
+        self._queue_depth_count = 0
+        self._queue_depth_max = 0
+        self._queue_waits = _Reservoir(seed=1)
         self.num_requests = 0
         self.num_batches = 0
         self._t_first: Optional[float] = None
@@ -55,17 +110,19 @@ class ServingMetrics:
         self.num_requests += n_real
         self._fill_real += n_real
         self._fill_padded += bucket_size
-        self._queue_depths.append(queue_depth)
+        self._queue_depth_sum += int(queue_depth)
+        self._queue_depth_count += 1
+        self._queue_depth_max = max(self._queue_depth_max, int(queue_depth))
 
     def observe_latency(self, seconds: float) -> None:
-        self._latencies.append(float(seconds))
+        self._latencies.add(seconds)
         self._hist[np.searchsorted(LATENCY_BUCKET_BOUNDS, seconds)] += 1
 
     def observe_queue_wait(self, seconds: float) -> None:
         """Time a request sat in the batcher queue before its batch was
         drained — tracked separately from total latency so queueing policy
         (deadline vs. fill) is visible independently of scoring cost."""
-        self._queue_waits.append(float(seconds))
+        self._queue_waits.add(seconds)
 
     def observe_swap(
         self,
@@ -97,7 +154,6 @@ class ServingMetrics:
         cache_stats: Optional[Dict[str, Dict[str, float]]] = None,
         compile_count: Optional[int] = None,
     ) -> dict:
-        lat = np.asarray(self._latencies, dtype=np.float64)
         out: dict = {
             "num_requests": self.num_requests,
             "num_batches": self.num_batches,
@@ -107,22 +163,22 @@ class ServingMetrics:
                 else 0.0
             ),
             "queue_depth_mean": (
-                round(float(np.mean(self._queue_depths)), 3)
-                if self._queue_depths
+                round(self._queue_depth_sum / self._queue_depth_count, 3)
+                if self._queue_depth_count
                 else 0.0
             ),
-            "queue_depth_max": (
-                int(max(self._queue_depths)) if self._queue_depths else 0
-            ),
+            "queue_depth_max": self._queue_depth_max,
         }
-        if lat.size:
-            p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+        if self._latencies.count:
+            # percentiles from the reservoir sample (exact below capacity);
+            # mean/max are exact running aggregates
+            p50, p95, p99 = self._latencies.percentile([50, 95, 99])
             out.update(
                 latency_p50_s=round(float(p50), 6),
                 latency_p95_s=round(float(p95), 6),
                 latency_p99_s=round(float(p99), 6),
-                latency_mean_s=round(float(lat.mean()), 6),
-                latency_max_s=round(float(lat.max()), 6),
+                latency_mean_s=round(self._latencies.mean, 6),
+                latency_max_s=round(self._latencies.maximum, 6),
             )
             nz = np.nonzero(self._hist)[0]
             out["latency_histogram"] = {
@@ -133,13 +189,12 @@ class ServingMetrics:
                 ): int(self._hist[i])
                 for i in nz
             }
-        if self._queue_waits:
-            qw = np.asarray(self._queue_waits, dtype=np.float64)
-            q50, q99 = np.percentile(qw, [50, 99])
+        if self._queue_waits.count:
+            q50, q99 = self._queue_waits.percentile([50, 99])
             out.update(
                 queue_wait_p50_s=round(float(q50), 6),
                 queue_wait_p99_s=round(float(q99), 6),
-                queue_wait_max_s=round(float(qw.max()), 6),
+                queue_wait_max_s=round(self._queue_waits.maximum, 6),
             )
         if self.num_swaps:
             out["swaps"] = {

@@ -140,10 +140,21 @@ def test_bench_failure_without_lastgood_is_zero(tmp_path):
     assert payload["error"]
 
 
+def _artifact_fingerprint(path):
+    """(exists, content) of a bench artifact — smoke runs must leave the
+    committed full-scale record untouched."""
+    if not os.path.exists(path):
+        return (False, None)
+    with open(path) as f:
+        return (True, f.read())
+
+
 def test_bench_re_adaptive_contract():
     """``--re-adaptive`` emits one JSON line with the lane-efficiency and
     speedup fields the driver parses, and the adaptive path must beat
     lockstep on executed lane-iterations even at smoke scale."""
+    artifact = os.path.join(REPO, "BENCH_RE_ADAPTIVE.json")
+    before = _artifact_fingerprint(artifact)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--re-adaptive"],
         capture_output=True, text=True, timeout=900, env=_smoke_env(),
@@ -172,6 +183,52 @@ def test_bench_re_adaptive_contract():
         for w in widths[1:]:
             assert w & (w - 1) == 0
     assert payload["chunk_iters"] >= 1
-    # smoke mode must not leave an artifact behind (BENCH_RE_ADAPTIVE_WRITE
-    # gates the file write, mirroring the other sub-benches)
-    assert not os.path.exists(os.path.join(REPO, "BENCH_RE_ADAPTIVE.json"))
+    # smoke mode must not touch the committed full-scale artifact
+    # (BENCH_RE_ADAPTIVE_WRITE gates the file write, mirroring the other
+    # sub-benches)
+    assert _artifact_fingerprint(artifact) == before
+
+
+def test_bench_cd_scores_contract():
+    """``--cd-scores`` emits one JSON line with the score-plane fields the
+    driver parses. The overhead-reduction ratio is noisy at smoke scale, so
+    the gate pins the DETERMINISTIC claims: zero row transfers per steady
+    iteration on the device plane, exact parity, and no host re-sums."""
+    artifact = os.path.join(REPO, "BENCH_CD_SCORES.json")
+    before = _artifact_fingerprint(artifact)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--cd-scores"],
+        capture_output=True, text=True, timeout=900, env=_smoke_env(),
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+
+    assert payload["metric"] == "cd_score_plane_overhead_reduction"
+    assert "error" not in payload
+    assert payload["unit"] == "fraction_vs_host_plane"
+    assert payload["value"] is not None
+    assert payload["host_wall_s"] > 0
+    assert payload["device_wall_s"] > 0
+    assert payload["host_overhead_s"] > 0
+    assert payload["device_overhead_s"] > 0
+    # host and device planes must train the same model
+    assert payload["parity_max_abs_diff"] <= 1e-6
+    dev = payload["device_transfers"]
+    host = payload["host_transfers"]
+    # device plane: zero row-length transfers in the steady state
+    assert dev["score_plane"] == "device"
+    assert dev["row_transfers_h2d"] == 0
+    assert dev["row_transfers_d2h"] == 0
+    assert dev["row_transfers_per_iter"] == 0.0
+    assert dev["device_plane_updates"] == dev["coordinate_updates"]
+    # host plane: 2 row arrays per update (score pull + residual push)
+    assert host["score_plane"] == "host"
+    assert host["row_transfers_h2d"] == host["coordinate_updates"]
+    assert host["row_transfers_d2h"] == host["coordinate_updates"]
+    # the double-total_score() fix: no full C-way re-sums on either plane
+    assert host["host_score_sums"] == 0
+    assert dev["host_score_sums"] == 0
+    # smoke mode must not touch the committed full-scale artifact
+    assert _artifact_fingerprint(artifact) == before

@@ -1,0 +1,253 @@
+"""Device-resident CD score plane: host/device parity, zero row transfers
+in steady state, and the double-score-sum regression fix.
+
+The two planes execute the same sequence of IEEE f32 elementwise ops (numpy
+on host, XLA on device), so parity is expected to be EXACT on CPU — the
+1e-6 assertions are the contract, the observed diff is 0.0.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.data.game_data import FeatureShard, GameData
+from photon_ml_tpu.data.random_effect import RandomEffectDataConfiguration
+from photon_ml_tpu.estimators.game import (
+    FixedEffectCoordinateConfiguration,
+    GameEstimator,
+    RandomEffectCoordinateConfiguration,
+)
+from photon_ml_tpu.event import EventEmitter, EventListener, TransferStatsEvent
+from photon_ml_tpu.incremental.trainer import incremental_update
+from photon_ml_tpu.parallel import mesh as mesh_mod
+from photon_ml_tpu.types import TaskType
+
+N_USERS, N_ITEMS, ROWS_PER_USER = 18, 7, 24
+D_FE, D_RE = 10, 5
+N_OUTER = 3
+
+
+def _problem(seed=0):
+    rng = np.random.default_rng(seed)
+    n = N_USERS * ROWS_PER_USER
+    Xg = rng.normal(size=(n, D_FE)).astype(np.float32)
+    Xu = rng.normal(size=(n, D_RE)).astype(np.float32)
+    Xi = rng.normal(size=(n, D_RE)).astype(np.float32)
+    user_ids = np.repeat([f"u{i:03d}" for i in range(N_USERS)], ROWS_PER_USER)
+    item_ids = np.array([f"i{int(v):03d}" for v in rng.integers(0, N_ITEMS, n)])
+    w = rng.normal(size=D_FE).astype(np.float32)
+    y = (Xg @ w + 0.1 * rng.normal(size=n)).astype(np.float32)
+
+    def coo(X):
+        rows, cols = np.nonzero(X)
+        return FeatureShard(rows=rows, cols=cols, vals=X[rows, cols], dim=X.shape[1])
+
+    return GameData(
+        labels=y,
+        feature_shards={"global": coo(Xg), "per_user": coo(Xu), "per_item": coo(Xi)},
+        id_tags={"userId": user_ids, "itemId": item_ids},
+    )
+
+
+def _coords():
+    return {
+        "fixed": FixedEffectCoordinateConfiguration("global"),
+        "per-user": RandomEffectCoordinateConfiguration(
+            feature_shard="per_user",
+            data=RandomEffectDataConfiguration(random_effect_type="userId"),
+        ),
+        "per-item": RandomEffectCoordinateConfiguration(
+            feature_shard="per_item",
+            data=RandomEffectDataConfiguration(random_effect_type="itemId"),
+        ),
+    }
+
+
+def _fit(plane, data, initial_models=None, emitter=None):
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinates=_coords(),
+        num_outer_iterations=N_OUTER,
+        score_plane=plane,
+        emitter=emitter,
+    )
+    fit = est.fit(data, initial_models=initial_models)
+    return est, fit
+
+
+class _Recorder(EventListener):
+    def __init__(self):
+        self.events = []
+
+    def on_event(self, event):
+        self.events.append(event)
+
+
+def test_host_device_parity_fe_plus_two_re():
+    """3 outer iterations over FE + 2 RE coordinates: final model scores
+    from the two planes must agree to 1e-6 (they match bitwise on CPU)."""
+    data = _problem()
+    _, fit_h = _fit("host", data)
+    _, fit_d = _fit("device", data)
+    sh = np.asarray(fit_h.model.score(data))
+    sd = np.asarray(fit_d.model.score(data))
+    assert np.max(np.abs(sh - sd)) <= 1e-6
+    # the training objective trajectories agree too — the device plane's
+    # objective is computed from the running device total
+    for (cid_h, oh), (cid_d, od) in zip(
+        fit_h.objective_history, fit_d.objective_history
+    ):
+        assert cid_h == cid_d
+        assert abs(oh - od) <= 1e-6 * max(1.0, abs(oh))
+
+
+def test_host_device_parity_warm_start():
+    """Warm-started fits (initial models from a previous fit) follow the
+    initial-scoring path — parity must hold there as well."""
+    data = _problem()
+    _, first = _fit("device", data)
+    warm = dict(first.model.models)
+    _, fit_h = _fit("host", data, initial_models=warm)
+    _, fit_d = _fit("device", data, initial_models=warm)
+    sh = np.asarray(fit_h.model.score(data))
+    sd = np.asarray(fit_d.model.score(data))
+    assert np.max(np.abs(sh - sd)) <= 1e-6
+
+
+def test_resolve_coordinate_device_parity():
+    """resolve_coordinate on the device plane (fused residual upload +
+    on-device offset regroup) matches the host re-solve."""
+    data = _problem()
+    est_d, fit_d = _fit("device", data)
+    models = dict(fit_d.model.models)
+    events = _problem(seed=7)
+
+    est_h = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION, coordinates=_coords(),
+        score_plane="host",
+    )
+    sub_h = est_h.resolve_coordinate("per-user", events, models)
+    sub_d = est_d.resolve_coordinate("per-user", events, models)
+    assert est_h.last_resolve_transfers.score_plane == "host"
+    assert est_d.last_resolve_transfers.score_plane == "device"
+    assert est_d.last_resolve_transfers.device_plane_updates == 1
+    rows_h = {eid: coefs for eid, coefs in sub_h.items()}
+    rows_d = {eid: coefs for eid, coefs in sub_d.items()}
+    assert set(rows_h) == set(rows_d)
+    for eid in rows_h:
+        for j in set(rows_h[eid]) | set(rows_d[eid]):
+            assert abs(rows_h[eid].get(j, 0.0) - rows_d[eid].get(j, 0.0)) <= 1e-6
+
+
+def test_incremental_trainer_device_parity_and_transfer_stats():
+    """The nearline incremental trainer produces the same touched-entity
+    updates on either plane and surfaces per-coordinate TransferStats."""
+    data = _problem()
+    est_d, fit_d = _fit("device", data)
+    events = _problem(seed=11)
+
+    est_h = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION, coordinates=_coords(),
+        score_plane="host",
+    )
+    upd_h = incremental_update(est_h, fit_d.model, events)
+    upd_d = incremental_update(est_d, fit_d.model, events)
+    assert upd_h.touched_entities == upd_d.touched_entities
+    for cid in upd_h.re_updates:
+        assert cid in upd_d.transfer_stats
+        assert upd_d.transfer_stats[cid].score_plane == "device"
+        assert upd_h.transfer_stats[cid].score_plane == "host"
+        for eid, coefs_h in upd_h.re_updates[cid].items():
+            coefs_d = upd_d.re_updates[cid][eid]
+            for j in set(coefs_h) | set(coefs_d):
+                assert abs(coefs_h.get(j, 0.0) - coefs_d.get(j, 0.0)) <= 1e-6
+
+
+def test_device_plane_zero_row_transfers_steady_state():
+    """On the device plane, NO row-length array crosses the host/device
+    boundary during CD: TransferStats reads zero, and the fetch_global
+    observer (which sees every device->host materialization) records no
+    row-length pulls between the first and last coordinate update."""
+    data = _problem()
+    est = GameEstimator(
+        task=TaskType.LINEAR_REGRESSION,
+        coordinates=_coords(),
+        num_outer_iterations=N_OUTER,
+        score_plane="device",
+    )
+    # build the coordinates first: the observer must watch ONLY the CD run,
+    # not the one-time dataset construction
+    built = {
+        cid: est._build_coordinate(cid, cfg, data)
+        for cid, cfg in est.coordinate_configs.items()
+    }
+    fetched = []
+    mesh_mod.add_fetch_observer(fetched.append)
+    try:
+        est._run_fit(built, data, None, None, None)
+    finally:
+        mesh_mod.remove_fetch_observer(fetched.append)
+    t = est.last_transfer_stats
+    assert t.score_plane == "device"
+    assert t.row_transfers_h2d == 0
+    assert t.row_transfers_d2h == 0
+    assert t.coordinate_updates == 3 * N_OUTER
+    assert t.device_plane_updates == 3 * N_OUTER
+    # no full-row device->host pull has the plane's row length (scalar and
+    # coefficient-sized fetches are fine; the score plane itself never moves)
+    row_bytes = data.num_rows * 4
+    assert row_bytes not in fetched
+
+    # host plane, for contrast, moves 2 row arrays per update
+    est_h, _ = _fit("host", data)
+    th = est_h.last_transfer_stats
+    assert th.row_transfers_h2d == 3 * N_OUTER
+    assert th.row_transfers_d2h == 3 * N_OUTER
+
+
+def test_single_plane_pass_per_update_regression():
+    """Regression for the double total_score() evaluation: the legacy
+    driver re-summed all C coordinates once for the residual and once for
+    the objective (2 full host sums per update). Both planes now maintain a
+    running total: host_score_sums must stay 0 while the objective history
+    still records one entry per coordinate update."""
+    data = _problem()
+    for plane in ("host", "device"):
+        est, fit = _fit(plane, data)
+        t = est.last_transfer_stats
+        assert t.host_score_sums == 0
+        assert t.coordinate_updates == 3 * N_OUTER
+        assert len(fit.objective_history) == 3 * N_OUTER
+        per_iter = t.per_outer_iteration()
+        assert per_iter["host_score_sums_per_iter"] == 0.0
+        if plane == "device":
+            assert per_iter["row_transfers_per_iter"] == 0.0
+            assert per_iter["row_bytes_per_iter"] == 0.0
+
+
+def test_transfer_stats_event_emitted_per_outer_iteration():
+    data = _problem()
+    emitter = EventEmitter()
+    rec = _Recorder()
+    emitter.register_listener(rec)
+    _fit("device", data, emitter=emitter)
+    tevents = [e for e in rec.events if isinstance(e, TransferStatsEvent)]
+    assert len(tevents) == N_OUTER
+    for i, e in enumerate(tevents):
+        assert e.outer_iteration == i
+        assert e.score_plane == "device"
+        assert e.row_transfers_h2d == 0
+        assert e.row_transfers_d2h == 0
+        assert e.device_plane_updates == 3
+        assert e.num_rows == data.num_rows
+
+
+def test_score_plane_validation():
+    with pytest.raises(ValueError, match="score_plane"):
+        GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinates=_coords(),
+            score_plane="gpu",
+        )
+    with pytest.raises(ValueError, match="score_plane"):
+        CoordinateDescent({"x": object()}, num_rows=4, score_plane="np")

@@ -295,6 +295,55 @@ class TestMetrics:
         )
         assert snap["latency_p50_s"] == pytest.approx(0.010)
 
+    def test_bounded_memory_under_sustained_load(self):
+        """A long-lived scorer must not grow per-observation state without
+        limit: after 100k observations the reservoirs stay at their fixed
+        capacity while counts/means/maxima stay exact and the percentile
+        estimates stay stable."""
+        from photon_ml_tpu.serving.metrics import RESERVOIR_SIZE
+
+        metrics = ServingMetrics()
+        rng = np.random.default_rng(42)
+        n = 100_000
+        lats = rng.lognormal(mean=-6.0, sigma=0.5, size=n)
+        for i, lat in enumerate(lats):
+            metrics.observe_latency(float(lat))
+            metrics.observe_queue_wait(float(lat) * 0.25)
+            if i % 8 == 0:
+                metrics.observe_batch(n_real=7, bucket_size=8, queue_depth=i % 5)
+        # bounded: the retained sample arrays never exceed capacity
+        assert len(metrics._latencies) == RESERVOIR_SIZE
+        assert len(metrics._queue_waits) == RESERVOIR_SIZE
+        assert metrics._latencies.samples().size == RESERVOIR_SIZE
+
+        snap = metrics.snapshot()
+        # exact aggregates survive the sampling
+        assert metrics._latencies.count == n
+        assert sum(snap["latency_histogram"].values()) == n
+        # snapshot rounds to 6 decimals
+        assert snap["latency_mean_s"] == pytest.approx(lats.mean(), abs=1e-6)
+        assert snap["latency_max_s"] == pytest.approx(lats.max(), abs=1e-6)
+        assert snap["queue_depth_mean"] == pytest.approx(2.0, abs=0.01)
+        assert snap["queue_depth_max"] == 4
+        # percentile ESTIMATES stay close to the exact stream percentiles
+        p50, p99 = np.percentile(lats, [50, 99])
+        assert snap["latency_p50_s"] == pytest.approx(p50, rel=0.05)
+        assert snap["latency_p99_s"] == pytest.approx(p99, rel=0.10)
+        assert snap["queue_wait_p50_s"] == pytest.approx(p50 * 0.25, rel=0.05)
+
+    def test_small_counts_stay_exact(self):
+        """Below reservoir capacity nothing is sampled: percentiles are
+        computed from every observation, as before the bound."""
+        metrics = ServingMetrics()
+        vals = [0.001 * (i + 1) for i in range(30)]
+        for v in vals:
+            metrics.observe_latency(v)
+        snap = metrics.snapshot()
+        assert snap["latency_p50_s"] == pytest.approx(
+            float(np.percentile(vals, 50))
+        )
+        assert snap["latency_max_s"] == pytest.approx(0.030)
+
     def test_swap_counters(self):
         metrics = ServingMetrics()
         metrics.observe_swap(
