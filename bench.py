@@ -620,6 +620,43 @@ def _backend_preflight(timeout_s: int = 300, watchdog_s: int = 2700) -> None:
     _emit_failure(f"backend preflight failed after {attempts} attempts: {last}")
 
 
+def _bench_telemetry():
+    """Enable span tracing for a sub-bench and return a summarizer.
+
+    The summarizer stops tracing and returns the compact telemetry block
+    embedded in the bench JSON artifact: jit compile/retrace counts, the
+    top-level span tree, and any transfer.* totals absorbed into the
+    registry. device_sync stays OFF so instrumented barrier requests cannot
+    perturb the measured numbers."""
+    from photon_ml_tpu.telemetry import (
+        disable_tracing,
+        enable_tracing,
+        get_registry,
+        jit_trace_counts,
+        span_tree_summary,
+    )
+
+    get_registry().reset()
+    tracer = enable_tracing(device_sync=False)
+
+    def summarize():
+        disable_tracing()
+        counters = get_registry().snapshot()["counters"]
+        transfers = {
+            k[len("transfer."):]: v
+            for k, v in counters.items()
+            if k.startswith("transfer.")
+        }
+        return {
+            "num_spans": len(tracer),
+            "jit_traces": jit_trace_counts(),
+            "span_tree": span_tree_summary(tracer.spans(), max_depth=2),
+            **({"transfers": transfers} if transfers else {}),
+        }
+
+    return summarize
+
+
 # ---- online serving benchmark (bench.py --serving) ----
 
 N_SRV_REQ = 400 if _SMOKE else 20_000       # replayed requests
@@ -658,6 +695,7 @@ def _serving_bench():
         from photon_ml_tpu.serving.scorer import ScoreRequest
         from photon_ml_tpu.types import TaskType
 
+        summarize_telemetry = _bench_telemetry()
         rng = np.random.default_rng(SEED)
         fe_w = (rng.standard_normal(D_SRV_FE) * 0.1).astype(np.float32)
         re_table = (
@@ -747,6 +785,7 @@ def _serving_bench():
         }
         if "caches" in snapshot:
             payload["cache_stats"] = snapshot["caches"]
+        payload["telemetry"] = summarize_telemetry()
         print(json.dumps(payload))
         if not _SMOKE or _env_flag("BENCH_SERVING_WRITE"):
             with open(_SERVING_PATH, "w") as f:
@@ -813,6 +852,7 @@ def _incremental_bench():
         )
         from photon_ml_tpu.types import RegularizationType, TaskType
 
+        summarize_telemetry = _bench_telemetry()
         l2 = lambda lam: GlmOptimizationConfiguration(  # noqa: E731
             regularization=RegularizationContext(RegularizationType.L2),
             regularization_weight=lam,
@@ -910,6 +950,7 @@ def _incremental_bench():
             "n_entities": N_INC_ENT,
             "num_events": update.num_events,
             "backend": jax.default_backend(),
+            "telemetry": summarize_telemetry(),
         }
         print(json.dumps(payload))
         if not _SMOKE or _env_flag("BENCH_INCREMENTAL_WRITE"):
@@ -960,6 +1001,7 @@ def _re_adaptive_bench():
         )
         from photon_ml_tpu.types import RegularizationType, TaskType
 
+        summarize_telemetry = _bench_telemetry()
         rng = np.random.default_rng(SEED)
         rows, cols, vals, ids = [], [], [], []
         labels_base, labels_fresh = [], []
@@ -1046,6 +1088,7 @@ def _re_adaptive_bench():
             "n_entities": N_AD_ENT,
             "n_hard": N_AD_HARD,
             "backend": jax.default_backend(),
+            "telemetry": summarize_telemetry(),
         }
         print(json.dumps(payload))
         if not _SMOKE or _env_flag("BENCH_RE_ADAPTIVE_WRITE"):
@@ -1100,6 +1143,7 @@ def _cd_scores_bench():
         from photon_ml_tpu.opt.config import OptimizerConfig
         from photon_ml_tpu.types import RegularizationType, TaskType
 
+        summarize_telemetry = _bench_telemetry()
         rng = np.random.default_rng(SEED)
         n = N_CD_USERS * N_CD_ROWS_PER_USER
         Xg = rng.normal(size=(n, D_CD_FE)).astype(np.float32) * 0.3
@@ -1248,6 +1292,11 @@ def _cd_scores_bench():
             "outer_iterations": 3,
             "backend": jax.default_backend(),
         }
+        from photon_ml_tpu.telemetry import get_registry
+
+        # the telemetry transfer totals reflect the device-plane winner
+        get_registry().record_transfer_stats(est_d.last_transfer_stats)
+        payload["telemetry"] = summarize_telemetry()
         print(json.dumps(payload))
         if not _SMOKE or _env_flag("BENCH_CD_SCORES_WRITE"):
             with open(_CD_SCORES_PATH, "w") as f:

@@ -40,6 +40,7 @@ from photon_ml_tpu.opt.tracking import (
     RandomEffectOptimizationTracker,
 )
 from photon_ml_tpu.sampler import down_sampler_for
+from photon_ml_tpu.telemetry import span
 from photon_ml_tpu.types import TaskType
 
 
@@ -147,6 +148,16 @@ class FixedEffectCoordinate(Coordinate):
         )
 
     def _update_with_offsets(
+        self, model: Optional[GeneralizedLinearModel], offsets: jax.Array
+    ) -> GeneralizedLinearModel:
+        with span(
+            "fe/solve",
+            device_sync=True,
+            optimizer=self.configuration.optimizer_config.optimizer.name,
+        ):
+            return self._solve_with_offsets(model, offsets)
+
+    def _solve_with_offsets(
         self, model: Optional[GeneralizedLinearModel], offsets: jax.Array
     ) -> GeneralizedLinearModel:
         data = self.data.replace(offsets=offsets)
@@ -311,10 +322,11 @@ class RandomEffectCoordinate(Coordinate):
         self, ds: RandomEffectDataset, model: Optional[RandomEffectModel]
     ) -> RandomEffectModel:
         stats: list = []
-        new_model, results = train_random_effects(
-            ds, self.task, self.configuration, initial_model=model,
-            compute_variances=self.compute_variances, stats_out=stats,
-        )
+        with span("re/train", buckets=len(ds.buckets)):
+            new_model, results = train_random_effects(
+                ds, self.task, self.configuration, initial_model=model,
+                compute_variances=self.compute_variances, stats_out=stats,
+            )
         self.last_solver_stats = stats
         # entity lanes beyond the real ids (mesh padding) carry zero weights
         # and all-invalid projections: their solves are trivial, their

@@ -46,6 +46,7 @@ import numpy as np
 from photon_ml_tpu.algorithm.coordinate import Coordinate
 from photon_ml_tpu.evaluation.evaluators import nan_aware_better_than
 from photon_ml_tpu.opt.tracking import TransferStats
+from photon_ml_tpu.telemetry import note_jit_trace, span
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -59,11 +60,17 @@ def _plane_programs():
     copying a row-length buffer (CPU ignores donation and warns, so it is
     only requested on accelerators)."""
     donate = () if jax.default_backend() == "cpu" else (0,)
-    apply_ = jax.jit(
-        lambda total, new_own, old_own: total + new_own - old_own,
-        donate_argnums=donate,
-    )
-    residual_ = jax.jit(lambda total, own: total - own)
+
+    def _apply(total, new_own, old_own):
+        note_jit_trace("cd_plane", "apply")  # fires only on (re)trace
+        return total + new_own - old_own
+
+    def _residual(total, own):
+        note_jit_trace("cd_plane", "residual")
+        return total - own
+
+    apply_ = jax.jit(_apply, donate_argnums=donate)
+    residual_ = jax.jit(_residual)
     return apply_, residual_
 
 
@@ -181,6 +188,28 @@ class CoordinateDescent:
         checkpoint-resume: the callback fires after each outer iteration with
         the running result; resume passes the restored models and best-so-far
         back in and skips completed iterations."""
+        with span(
+            "cd/run",
+            score_plane=self.score_plane,
+            num_rows=self.num_rows,
+            iterations=num_iterations,
+        ):
+            return self._run(
+                num_iterations,
+                initial_models,
+                start_iteration,
+                initial_best,
+                on_iteration_end,
+            )
+
+    def _run(
+        self,
+        num_iterations: int,
+        initial_models: Optional[Dict[str, object]],
+        start_iteration: int,
+        initial_best: Optional[Tuple[Dict[str, object], float]],
+        on_iteration_end: Optional[Callable[[int, "CoordinateDescentResult"], None]],
+    ) -> CoordinateDescentResult:
         device = self.score_plane == "device"
         stats = self.transfer_stats = TransferStats(
             score_plane=self.score_plane, num_rows=self.num_rows
@@ -233,109 +262,128 @@ class CoordinateDescent:
             best_models, best_metric = dict(initial_best[0]), initial_best[1]
 
         for outer in range(start_iteration, num_iterations):
-            prev_transfers = stats.snapshot()
-            for cid in self.update_order:
-                coord = self.coordinates[cid]
-                stats.coordinate_updates += 1
-                # partialScore = fullScore - ownScore (reference
-                # CoordinateDescent.scala:183)
-                if device:
-                    old_own = scores.get(cid)
-                    residual = residual_(
-                        total, old_own if old_own is not None else zeros
-                    )
-                    if coord.supports_device_plane:
-                        model = coord.update_model_device(
-                            models.get(cid), residual
-                        )
-                    else:
-                        stats.record_d2h()
-                        model = coord.update_model(
-                            models.get(cid), np.asarray(residual)
-                        )
-                    models[cid] = model
-                    new_own = _score(cid, model)
-                    # incremental running total: O(N) per update instead of
-                    # a C-way re-sum; the old total's buffer is donated
-                    total = apply_(
-                        total,
-                        new_own,
-                        old_own if old_own is not None else zeros,
-                    )
-                    stats.device_plane_updates += 1
-                    scores[cid] = new_own
-                else:
-                    old_own = scores.get(cid)
-                    residual = (
-                        total_np - old_own if old_own is not None else total_np.copy()
-                    )
-                    stats.record_h2d()  # the coordinate pushes the residual
-                    model = coord.update_model(models.get(cid), residual)
-                    models[cid] = model
-                    new_own = _score(cid, model)
-                    # same incremental algebra as the device plane, in numpy
-                    total_np = (
-                        total_np + new_own - old_own
-                        if old_own is not None
-                        else total_np + new_own
-                    )
-                    scores[cid] = new_own
-                self._emit_solver_stats(cid, coord)
-
-                if self.training_objective is not None:
-                    # both planes re-use the running total — the legacy
-                    # second full re-sum per update is gone
-                    plane_total = total if device else total_np
-                    loss_val = float(self.training_objective(plane_total))
-                    if self.regularization_term is not None:
-                        # objective = loss + regularization (reference
-                        # CoordinateDescent.scala:247-258); the history and
-                        # the log agree on what "objective" means
-                        reg = float(self.regularization_term(models))
-                        obj = loss_val + reg
-                        objective_history.append((cid, obj))
-                        logger.info(
-                            "CD iter %d coordinate %s: loss %.6f + "
-                            "regularization %.6f = objective %.6f",
-                            outer, cid, loss_val, reg, obj,
-                        )
-                    else:
-                        objective_history.append((cid, loss_val))
-                        logger.info(
-                            "CD iter %d coordinate %s: training objective %.6f",
-                            outer, cid, loss_val,
-                        )
-                if self.validate is not None:
-                    metric = float(self.validate(models))
-                    validation_history.append((cid, metric))
-                    logger.info(
-                        "CD iter %d coordinate %s: validation %.6f", outer, cid, metric
-                    )
-                    # best-model tracking starts once EVERY coordinate has
-                    # trained: a mid-first-iteration snapshot would be a
-                    # partial model (missing whole coordinates on disk) —
-                    # the reference's snapshots always carry all
-                    # coordinates (CoordinateDescent.scala:265-294, its
-                    # models hold initial coefficients from the start)
-                    if all(c in models for c in self.update_order) and (
-                        best_metric is None
-                        or self.validation_better_than(metric, best_metric)
+            with span("cd/outer_iter", outer=outer):
+                prev_transfers = stats.snapshot()
+                for cid in self.update_order:
+                    coord = self.coordinates[cid]
+                    stats.coordinate_updates += 1
+                    # partialScore = fullScore - ownScore (reference
+                    # CoordinateDescent.scala:183)
+                    with span(
+                        "cd/coordinate",
+                        device_sync=True,
+                        coordinate=cid,
+                        outer=outer,
                     ):
-                        best_metric = metric
-                        best_models = dict(models)
+                        if device:
+                            old_own = scores.get(cid)
+                            residual = residual_(
+                                total, old_own if old_own is not None else zeros
+                            )
+                            if coord.supports_device_plane:
+                                model = coord.update_model_device(
+                                    models.get(cid), residual
+                                )
+                            else:
+                                stats.record_d2h()
+                                model = coord.update_model(
+                                    models.get(cid), np.asarray(residual)
+                                )
+                            models[cid] = model
+                            new_own = _score(cid, model)
+                            # incremental running total: O(N) per update
+                            # instead of a C-way re-sum; the old total's
+                            # buffer is donated
+                            total = apply_(
+                                total,
+                                new_own,
+                                old_own if old_own is not None else zeros,
+                            )
+                            stats.device_plane_updates += 1
+                            scores[cid] = new_own
+                        else:
+                            old_own = scores.get(cid)
+                            residual = (
+                                total_np - old_own
+                                if old_own is not None
+                                else total_np.copy()
+                            )
+                            # the coordinate pushes the residual
+                            stats.record_h2d()
+                            model = coord.update_model(models.get(cid), residual)
+                            models[cid] = model
+                            new_own = _score(cid, model)
+                            # same incremental algebra as the device plane,
+                            # in numpy
+                            total_np = (
+                                total_np + new_own - old_own
+                                if old_own is not None
+                                else total_np + new_own
+                            )
+                            scores[cid] = new_own
+                    self._emit_solver_stats(cid, coord)
 
-            self._emit_transfer_stats(outer, prev_transfers)
-            if on_iteration_end is not None:
-                on_iteration_end(
-                    outer,
-                    CoordinateDescentResult(
-                        models=dict(models),
-                        best_models=dict(best_models) if best_models else dict(models),
-                        best_metric=best_metric,
-                        objective_history=list(objective_history),
-                        validation_history=list(validation_history),
-                    ),
-                )
+                    if self.training_objective is not None:
+                        with span("cd/objective", coordinate=cid, outer=outer):
+                            # both planes re-use the running total — the
+                            # legacy second full re-sum per update is gone
+                            plane_total = total if device else total_np
+                            loss_val = float(self.training_objective(plane_total))
+                            if self.regularization_term is not None:
+                                # objective = loss + regularization (reference
+                                # CoordinateDescent.scala:247-258); the history
+                                # and the log agree on what "objective" means
+                                reg = float(self.regularization_term(models))
+                                obj = loss_val + reg
+                                objective_history.append((cid, obj))
+                                logger.info(
+                                    "CD iter %d coordinate %s: loss %.6f + "
+                                    "regularization %.6f = objective %.6f",
+                                    outer, cid, loss_val, reg, obj,
+                                )
+                            else:
+                                objective_history.append((cid, loss_val))
+                                logger.info(
+                                    "CD iter %d coordinate %s: training "
+                                    "objective %.6f",
+                                    outer, cid, loss_val,
+                                )
+                    if self.validate is not None:
+                        with span("cd/validate", coordinate=cid, outer=outer):
+                            metric = float(self.validate(models))
+                            validation_history.append((cid, metric))
+                            logger.info(
+                                "CD iter %d coordinate %s: validation %.6f",
+                                outer, cid, metric,
+                            )
+                            # best-model tracking starts once EVERY coordinate
+                            # has trained: a mid-first-iteration snapshot would
+                            # be a partial model (missing whole coordinates on
+                            # disk) — the reference's snapshots always carry
+                            # all coordinates (CoordinateDescent.scala:265-294,
+                            # its models hold initial coefficients from the
+                            # start)
+                            if all(c in models for c in self.update_order) and (
+                                best_metric is None
+                                or self.validation_better_than(metric, best_metric)
+                            ):
+                                best_metric = metric
+                                best_models = dict(models)
+
+                self._emit_transfer_stats(outer, prev_transfers)
+                if on_iteration_end is not None:
+                    on_iteration_end(
+                        outer,
+                        CoordinateDescentResult(
+                            models=dict(models),
+                            best_models=(
+                                dict(best_models) if best_models else dict(models)
+                            ),
+                            best_metric=best_metric,
+                            objective_history=list(objective_history),
+                            validation_history=list(validation_history),
+                        ),
+                    )
 
         logger.info("CD %s", stats.to_summary_string())
         if self.validate is None or not best_models:

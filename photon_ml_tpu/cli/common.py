@@ -9,6 +9,7 @@ and the config mini-languages replaced by JSON (GLMOptimizationConfiguration
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
@@ -29,6 +30,7 @@ from photon_ml_tpu.indexmap import IndexMap
 from photon_ml_tpu.indexmap.offheap import OffHeapIndexMap
 from photon_ml_tpu.io.data_reader import FeatureShardConfiguration
 from photon_ml_tpu.opt.config import (
+    AdaptiveSolveConfig,
     GlmOptimizationConfiguration,
     OptimizerConfig,
     OptimizerType,
@@ -38,17 +40,43 @@ from photon_ml_tpu.projector import ProjectorType
 from photon_ml_tpu.types import RegularizationType
 
 
+_logger_atexit_registered = False
+
+
+def _close_logger_handlers() -> None:
+    """Flush/close any handlers still attached at interpreter exit — a
+    FileHandler left open otherwise loses its tail on shutdown."""
+    logger = logging.getLogger("photon_ml_tpu")
+    for h in list(logger.handlers):
+        try:
+            h.flush()
+            if isinstance(h, logging.FileHandler):
+                logger.removeHandler(h)
+                h.close()
+        except Exception:
+            pass
+
+
 def setup_logger(log_file: Optional[str] = None, level: str = "INFO") -> logging.Logger:
     """PhotonLogger-style driver logging: stderr + optional buffered file
-    (reference util/PhotonLogger.scala:36 writes a per-job log file)."""
+    (reference util/PhotonLogger.scala:36 writes a per-job log file).
+
+    The ``PHOTON_LOG_LEVEL`` environment variable overrides ``level``
+    (handy for turning on DEBUG in a driver without a flag change)."""
+    global _logger_atexit_registered
     logger = logging.getLogger("photon_ml_tpu")
-    logger.setLevel(getattr(logging, level.upper()))
+    level = os.environ.get("PHOTON_LOG_LEVEL", level)
+    resolved = getattr(logging, str(level).upper(), None)
+    if not isinstance(resolved, int):
+        logger.warning("unknown log level %r, falling back to INFO", level)
+        resolved = logging.INFO
+    logger.setLevel(resolved)
     # idempotent: a second driver run in the same process must not stack
     # handlers (duplicate lines, leaked file descriptors)
     for h in list(logger.handlers):
         logger.removeHandler(h)
         h.close()
-    fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+    fmt = logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(fmt)
     logger.addHandler(handler)
@@ -59,7 +87,56 @@ def setup_logger(log_file: Optional[str] = None, level: str = "INFO") -> logging
         fh = logging.FileHandler(log_file)
         fh.setFormatter(fmt)
         logger.addHandler(fh)
+    if not _logger_atexit_registered:
+        atexit.register(_close_logger_handlers)
+        _logger_atexit_registered = True
     return logger
+
+
+def add_telemetry_args(parser) -> None:
+    """``--telemetry-out`` / ``--trace-out``: shared by all five drivers."""
+    parser.add_argument(
+        "--telemetry-out",
+        default=None,
+        metavar="LEDGER.jsonl",
+        help="write a JSONL run ledger (spans, events, metrics snapshot) "
+        "to this path; enables span tracing for the run",
+    )
+    parser.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="TRACE.json",
+        help="write a Chrome trace-event file (load in Perfetto or "
+        "chrome://tracing) to this path; enables span tracing for the run",
+    )
+
+
+def start_telemetry(args, label: str, emitter=None):
+    """Start a telemetry run when the driver asked for one (either flag);
+    returns None otherwise. ``emitter`` gets the event->ledger bridge."""
+    ledger_path = getattr(args, "telemetry_out", None)
+    trace_path = getattr(args, "trace_out", None)
+    if not ledger_path and not trace_path:
+        return None
+    from photon_ml_tpu.telemetry import start_run
+
+    run = start_run(label, ledger_path=ledger_path, trace_path=trace_path)
+    if emitter is not None:
+        run.attach(emitter)
+    return run
+
+
+def finish_telemetry(run, **extra):
+    """Finish a run from ``start_telemetry`` (None-safe); disables the
+    tracer again so later driver runs in-process start clean."""
+    if run is None:
+        return None
+    from photon_ml_tpu.telemetry import disable_tracing
+
+    try:
+        return run.finish(extra=extra or None)
+    finally:
+        disable_tracing()
 
 
 def parse_optimizer_config(cfg: dict) -> GlmOptimizationConfiguration:
@@ -102,11 +179,25 @@ def parse_optimizer_config(cfg: dict) -> GlmOptimizationConfiguration:
         # single-config default
         ws = cfg.get("regularization_weights")
         weight = ws[0] if ws else 0.0
+    adaptive = AdaptiveSolveConfig()
+    adaptive_cfg = cfg.get("adaptive")
+    if adaptive_cfg is not None:
+        # {"enabled": bool, "chunk_iters": int, "min_lanes": int} — knobs
+        # for the convergence-adaptive random-effect driver
+        akw = {}
+        if "enabled" in adaptive_cfg:
+            akw["enabled"] = bool(adaptive_cfg["enabled"])
+        if "chunk_iters" in adaptive_cfg:
+            akw["chunk_iters"] = int(adaptive_cfg["chunk_iters"])
+        if "min_lanes" in adaptive_cfg:
+            akw["min_lanes"] = int(adaptive_cfg["min_lanes"])
+        adaptive = AdaptiveSolveConfig(**akw)
     return GlmOptimizationConfiguration(
         optimizer_config=opt,
         regularization=reg,
         regularization_weight=float(weight),
         down_sampling_rate=float(cfg.get("down_sampling_rate", 1.0)),
+        adaptive=adaptive,
     )
 
 

@@ -19,9 +19,12 @@ from typing import List, Optional
 import numpy as np
 
 from photon_ml_tpu.cli.common import (
+    add_telemetry_args,
     delete_dirs_if_exist,
+    finish_telemetry,
     parse_input_columns,
     setup_logger,
+    start_telemetry,
 )
 from photon_ml_tpu.cli.train_game import _make_evaluator
 from photon_ml_tpu.io.data_reader import (
@@ -91,7 +94,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="log dataset stats (rows, per-id-tag entity counts "
                         "and samples-per-entity) and per-coordinate model "
                         "sizes (reference --log-game-dataset-and-model-stats)")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   metavar="module.Class",
+                   help="EventListener classes to register")
     p.add_argument("--log-file", default=None)
+    add_telemetry_args(p)
     return p.parse_args(argv)
 
 
@@ -161,8 +168,30 @@ def _check_missing_entities(model, data) -> None:
 
 
 def run(args: argparse.Namespace) -> Optional[float]:
+    import time
+
+    from photon_ml_tpu.event import EventEmitter
+
     logger = setup_logger(args.log_file)
     timer = Timer()
+    emitter = EventEmitter()
+    for name in args.event_listeners:
+        emitter.register_listener_class(name)
+    telemetry = start_telemetry(args, "score_game", emitter=emitter)
+    t_start = time.perf_counter()
+    try:
+        return _run_scoring(args, logger, timer, emitter, t_start)
+    finally:
+        # listeners must flush/close even when the run fails; telemetry
+        # finishes after them so every bridged event is in the ledger
+        emitter.clear_listeners()
+        finish_telemetry(telemetry, phases=dict(timer.durations))
+
+
+def _run_scoring(args, logger, timer, emitter, t_start) -> Optional[float]:
+    import time
+
+    from photon_ml_tpu.event import ScoringFinishEvent, ScoringStartEvent
 
     # a bad date spec must fail before the (possibly huge) model load
     from photon_ml_tpu.cli.common import expand_data_dirs
@@ -239,6 +268,9 @@ def run(args: argparse.Namespace) -> Optional[float]:
             id_tags=id_tags, is_response_required=False, **col_names,
         )
     logger.info("scoring rows: %d", data.num_rows)
+    emitter.send_event(
+        ScoringStartEvent(model_id=model_id, num_requests=data.num_rows)
+    )
 
     if args.log_data_and_model_stats:
         _log_data_and_model_stats(logger, data, model, id_tags)
@@ -297,6 +329,12 @@ def run(args: argparse.Namespace) -> Optional[float]:
                 data.weights[have_labels],
             )
             logger.info("%s: %.6f", ev.name, metric)
+    emitter.send_event(ScoringFinishEvent(
+        model_id=model_id,
+        num_requests=data.num_rows,
+        wall_seconds=time.perf_counter() - t_start,
+        metrics={} if metric is None else {"evaluator_metric": metric},
+    ))
     for name, seconds in timer.durations.items():
         logger.info("timing %-20s %.3fs", name, seconds)
     return metric

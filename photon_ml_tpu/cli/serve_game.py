@@ -34,7 +34,13 @@ import json
 import sys
 from typing import List, Optional
 
-from photon_ml_tpu.cli.common import parse_input_columns, setup_logger
+from photon_ml_tpu.cli.common import (
+    add_telemetry_args,
+    finish_telemetry,
+    parse_input_columns,
+    setup_logger,
+    start_telemetry,
+)
 from photon_ml_tpu.utils.timer import Timer
 
 DEFAULT_BUCKETS = "1,2,4,8,16,32"
@@ -83,6 +89,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--input-columns-names", default=None,
                    help="JSON map overriding input field names")
     p.add_argument("--log-file", default=None)
+    add_telemetry_args(p)
     return p.parse_args(argv)
 
 
@@ -118,9 +125,24 @@ def _load_or_pack(args, logger, timer):
 
 
 def run(args: argparse.Namespace) -> Optional[dict]:
+    from photon_ml_tpu.event import EventEmitter
+
     logger = setup_logger(args.log_file)
     timer = Timer()
+    emitter = EventEmitter()
+    for name in args.event_listeners:
+        emitter.register_listener_class(name)
+    telemetry = start_telemetry(args, "serve_game", emitter=emitter)
+    try:
+        return _run_serving(args, logger, timer, emitter)
+    finally:
+        # listeners must flush/close even when the run fails; telemetry
+        # finishes after them so every bridged event is in the ledger
+        emitter.clear_listeners()
+        finish_telemetry(telemetry, phases=dict(timer.durations))
 
+
+def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
     bucket_sizes = tuple(
         int(b) for b in str(args.bucket_sizes).split(",") if b.strip()
     )
@@ -137,7 +159,6 @@ def run(args: argparse.Namespace) -> Optional[dict]:
 
     snapshot: Optional[dict] = None
     if args.data_dirs:
-        from photon_ml_tpu.event import EventEmitter
         from photon_ml_tpu.io.data_reader import (
             FeatureShardConfiguration,
             read_game_data,
@@ -185,10 +206,6 @@ def run(args: argparse.Namespace) -> Optional[dict]:
             )
         logger.info("replaying %d requests", len(requests))
 
-        emitter = EventEmitter()
-        for name in args.event_listeners:
-            emitter.register_listener_class(name)
-
         scorer = GameScorer(
             artifact,
             max_nnz=args.max_nnz if args.max_nnz else max_nnz_of(requests),
@@ -228,7 +245,6 @@ def run(args: argparse.Namespace) -> Optional[dict]:
                 watch_dir=args.watch_deltas,
                 poll_every=args.watch_chunk,
             )
-        emitter.clear_listeners()
         if manager is not None:
             logger.info(
                 "served through generation %d (%d swap(s))",

@@ -27,13 +27,16 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from photon_ml_tpu.cli.common import (
+    add_telemetry_args,
     coordinate_weight_sweeps,
     delete_dirs_if_exist,
+    finish_telemetry,
     id_tags_needed,
     load_game_config,
     load_index_maps,
     parse_input_columns,
     setup_logger,
+    start_telemetry,
 )
 from photon_ml_tpu.estimators.game import GameEstimator, GameFit
 from photon_ml_tpu.estimators.tuning import run_hyperparameter_tuning
@@ -160,6 +163,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="write a jax profiler trace of the fit phase here "
                         "(view with TensorBoard / xprof)")
     p.add_argument("--log-file", default=None)
+    add_telemetry_args(p)
     args = p.parse_args(argv)
     if args.parallel_data < 0 or args.parallel_feat < 1:
         p.error("--parallel-data must be >= 0 and --parallel-feat >= 1")
@@ -285,6 +289,7 @@ def run(args: argparse.Namespace) -> GameFit:
     emitter = EventEmitter()
     for name in args.event_listeners:
         emitter.register_listener_class(name)
+    telemetry = start_telemetry(args, "train_game", emitter=emitter)
     emitter.send_event(PhotonSetupEvent(params=vars(args)))
     t_start = time.perf_counter()
     try:
@@ -451,6 +456,7 @@ def run(args: argparse.Namespace) -> GameFit:
             intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
             parallel=parallel,
             compute_variance=args.compute_variance,
+            emitter=emitter,
         )
 
         emitter.send_event(TrainingStartEvent(task=task.name))
@@ -641,8 +647,10 @@ def run(args: argparse.Namespace) -> GameFit:
             logger.info("timing %-28s %.3fs", name, seconds)
         return best
     finally:
-        # listeners must flush/close even when the run fails
+        # listeners must flush/close even when the run fails; telemetry
+        # finishes after them so every bridged event is in the ledger
         emitter.clear_listeners()
+        finish_telemetry(telemetry, phases=dict(timer.durations))
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -26,9 +26,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.cli.common import (
+    add_telemetry_args,
+    finish_telemetry,
     load_index_maps,
     parse_optimizer_config,
     setup_logger,
+    start_telemetry,
 )
 from photon_ml_tpu.data.validators import (
     DataValidationType,
@@ -130,6 +133,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "(Hosmer-Lemeshow, error independence, feature "
                         "importance), ALL both")
     p.add_argument("--log-file", default=None)
+    add_telemetry_args(p)
     return p.parse_args(argv)
 
 
@@ -222,6 +226,7 @@ def run(args: argparse.Namespace) -> dict:
     emitter = EventEmitter()
     for name in args.event_listeners:
         emitter.register_listener_class(name)
+    telemetry = start_telemetry(args, "train_glm", emitter=emitter)
     emitter.send_event(PhotonSetupEvent(params=vars(args)))
     t_start = time.perf_counter()
     try:
@@ -462,8 +467,10 @@ def run(args: argparse.Namespace) -> dict:
             logger.info("timing %-12s %.3fs", name, seconds)
         return {"best_lambda": best_lambda, "metrics": metrics, "fits": fits}
     finally:
-        # listeners must flush/close even when the run fails
+        # listeners must flush/close even when the run fails; telemetry
+        # finishes after them so every bridged event is in the ledger
         emitter.clear_listeners()
+        finish_telemetry(telemetry, phases=dict(timer.durations))
 
 
 def _diagnose(

@@ -35,10 +35,13 @@ import time
 from typing import List, Optional
 
 from photon_ml_tpu.cli.common import (
+    add_telemetry_args,
+    finish_telemetry,
     id_tags_needed,
     load_game_config,
     parse_input_columns,
     setup_logger,
+    start_telemetry,
 )
 from photon_ml_tpu.utils.timer import Timer
 
@@ -78,6 +81,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--input-columns-names", default=None,
                    help="JSON map overriding input field names")
     p.add_argument("--log-file", default=None)
+    add_telemetry_args(p)
     return p.parse_args(argv)
 
 
@@ -99,16 +103,28 @@ def _chain_head(output_dir: str, base_artifact_dir: str):
 
 
 def run(args: argparse.Namespace) -> dict:
+    from photon_ml_tpu.event import EventEmitter, PhotonSetupEvent
+
     logger = setup_logger(args.log_file)
     timer = Timer()
+    emitter = EventEmitter()
+    for name in args.event_listeners:
+        emitter.register_listener_class(name)
+    telemetry = start_telemetry(args, "update_game", emitter=emitter)
+    emitter.send_event(PhotonSetupEvent(params=vars(args)))
+    t_start = time.perf_counter()
+    try:
+        return _run_update(args, logger, timer, emitter, t_start)
+    finally:
+        # listeners must flush/close even when the run fails; telemetry
+        # finishes after them so every bridged event is in the ledger
+        emitter.clear_listeners()
+        finish_telemetry(telemetry, phases=dict(timer.durations))
 
+
+def _run_update(args, logger, timer, emitter, t_start) -> dict:
     from photon_ml_tpu.estimators.game import GameEstimator
-    from photon_ml_tpu.event import (
-        EventEmitter,
-        PhotonSetupEvent,
-        TrainingFinishEvent,
-        TrainingStartEvent,
-    )
+    from photon_ml_tpu.event import TrainingFinishEvent, TrainingStartEvent
     from photon_ml_tpu.incremental import (
         build_delta,
         compact,
@@ -119,12 +135,6 @@ def run(args: argparse.Namespace) -> dict:
     )
     from photon_ml_tpu.io.data_reader import read_game_data
     from photon_ml_tpu.serving import load_artifact
-
-    emitter = EventEmitter()
-    for name in args.event_listeners:
-        emitter.register_listener_class(name)
-    emitter.send_event(PhotonSetupEvent(params=vars(args)))
-    t_start = time.perf_counter()
 
     shard_configs, coordinates, update_order, _ = load_game_config(
         args.coordinate_config
@@ -155,6 +165,7 @@ def run(args: argparse.Namespace) -> dict:
         coordinates=coordinates,
         update_order=update_order,
         num_outer_iterations=1,
+        emitter=emitter,
     )
 
     if args.model_dir:
@@ -208,7 +219,6 @@ def run(args: argparse.Namespace) -> dict:
         task=artifact.task.name,
         wall_seconds=time.perf_counter() - t_start,
     ))
-    emitter.clear_listeners()
 
     summary = {
         "delta_dir": delta_dir,

@@ -46,6 +46,7 @@ from photon_ml_tpu.models.game import CoordinateMeta, GameModel
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops.data import LabeledData
 from photon_ml_tpu.opt.config import GlmOptimizationConfiguration
+from photon_ml_tpu.telemetry import span
 from photon_ml_tpu.types import TaskType
 
 logger = logging.getLogger("photon_ml_tpu")
@@ -249,6 +250,14 @@ class GameEstimator:
         return self.score_plane
 
     def _build_coordinate(
+        self, cid: str, cfg: CoordinateConfiguration, data: GameData
+    ) -> Coordinate:
+        with span(
+            "game/build_coordinate", coordinate=cid, kind=type(cfg).__name__
+        ):
+            return self._build_coordinate_impl(cid, cfg, data)
+
+    def _build_coordinate_impl(
         self, cid: str, cfg: CoordinateConfiguration, data: GameData
     ) -> Coordinate:
         shard = data.feature_shards[cfg.feature_shard]
@@ -474,6 +483,14 @@ class GameEstimator:
                 f"coordinate {cid!r} is factored — single-coordinate re-solve "
                 "supports fixed-effect and plain random-effect coordinates"
             )
+        with span(
+            "game/resolve_coordinate", coordinate=cid, num_rows=data.num_rows
+        ):
+            return self._resolve_coordinate_impl(
+                cid, cfg, data, models, initial_model
+            )
+
+    def _resolve_coordinate_impl(self, cid, cfg, data, models, initial_model):
         coord = self._build_coordinate(cid, cfg, data)
         meta = self._meta()
         others = {
@@ -777,13 +794,19 @@ class GameEstimator:
                     ),
                 )
 
-        result = cd.run(
-            self.num_outer_iterations,
-            initial_models=initial_models,
-            start_iteration=start_iteration,
-            initial_best=initial_best,
-            on_iteration_end=on_iteration_end,
-        )
+        with span(
+            "game/fit",
+            coordinates=len(coordinates),
+            num_rows=data.num_rows,
+            score_plane=cd.score_plane,
+        ):
+            result = cd.run(
+                self.num_outer_iterations,
+                initial_models=initial_models,
+                start_iteration=start_iteration,
+                initial_best=initial_best,
+                on_iteration_end=on_iteration_end,
+            )
         self.last_transfer_stats = cd.transfer_stats
         model = GameModel(models=result.best_models, meta=meta, task=self.task)
         return GameFit(

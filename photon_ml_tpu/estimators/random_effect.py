@@ -50,6 +50,7 @@ from photon_ml_tpu.opt.solve import (
 )
 from photon_ml_tpu.opt.state import SolveResult
 from photon_ml_tpu.opt.tracking import SolverStats
+from photon_ml_tpu.telemetry import note_jit_trace, span
 from photon_ml_tpu.types import ConvergenceReason, TaskType
 
 _NOT_CONVERGED = ConvergenceReason.NOT_CONVERGED.value
@@ -64,6 +65,14 @@ _TRACE_COUNTS: "collections.Counter[Tuple[str, str]]" = collections.Counter()
 def solver_trace_counts() -> Dict[Tuple[str, str], int]:
     """Snapshot of the RE solver jit trace counters (testing/telemetry)."""
     return dict(_TRACE_COUNTS)
+
+
+def _note_trace(program: str, kind: str) -> None:
+    """Trace-time side effect shared by every RE program: the local
+    counter tests assert on, plus the global telemetry jit.traces.*
+    counter (telemetry.metrics.note_jit_trace)."""
+    _TRACE_COUNTS[(program, kind)] += 1
+    note_jit_trace(program, kind)
 
 
 def _bucket_data(bucket: ReBucket) -> LabeledData:
@@ -152,23 +161,23 @@ def _re_programs(
         return res, w, var
 
     def _oneshot(w0, data, pv, l2, l1):
-        _TRACE_COUNTS[("re_oneshot", kind)] += 1
+        _note_trace("re_oneshot", kind)
         return jax.vmap(oneshot_one, in_axes=(0, 0, 0, None, None))(w0, data, pv, l2, l1)
 
     def _init(w0, data, l2, l1):
-        _TRACE_COUNTS[("re_init", kind)] += 1
+        _note_trace("re_init", kind)
         return jax.vmap(init_one, in_axes=(0, 0, None, None))(w0, data, l2, l1)
 
     def _chunk(state, data, l2):
-        _TRACE_COUNTS[("re_chunk", kind)] += 1
+        _note_trace("re_chunk", kind)
         return jax.vmap(chunk_one, in_axes=(0, 0, None))(state, data, l2)
 
     def _extract(state, data, pv, l2):
-        _TRACE_COUNTS[("re_extract", kind)] += 1
+        _note_trace("re_extract", kind)
         return jax.vmap(extract_one, in_axes=(0, 0, 0, None))(state, data, pv, l2)
 
     def _compact(tree, idx):
-        _TRACE_COUNTS[("re_compact", kind)] += 1
+        _note_trace("re_compact", kind)
         return jax.tree.map(lambda a: a[idx], tree)
 
     # Donate the carried solver state so each round updates in place instead
@@ -234,31 +243,37 @@ def _solve_bucket_adaptive(
     # converged-at-init case where the first chunk advances nothing.
     max_rounds = -(-max_iterations // K) + 1
 
-    for _ in range(max_rounds):
-        state = progs.chunk(state, data, l2)
-        widths.append(width)
-        # host-side bookkeeping below overlaps the async device dispatch
-        its_after = np.asarray(jax.device_get(state.it)).astype(np.int64)
-        reasons = np.asarray(jax.device_get(state.reason))
-        executed += width * int(np.max(its_after - its_before)) if width else 0
-        done = (reasons != _NOT_CONVERGED) | (its_after >= max_iterations)
-        n_live = int(np.sum(~done))
-        if n_live == 0:
-            _scatter_extract(progs, state, data, pv, l2, live, buffers, E)
-            break
-        new_width = _next_pow2(max(n_live, min_lanes))
-        if new_width < width:
-            # freeze current results, then compact survivors (+ filler done
-            # lanes up to the pow2 width) into a dense prefix on device
-            _scatter_extract(progs, state, data, pv, l2, live, buffers, E)
-            keep = np.argsort(done, kind="stable")[:new_width]
-            idx = jnp.asarray(keep, dtype=jnp.int32)
-            state, data, pv = progs.compact((state, data, pv), idx)
-            live = live[keep]
-            its_before = its_after[keep]
-            width = new_width
-        else:
-            its_before = its_after
+    for round_index in range(max_rounds):
+        with span(
+            "re/adaptive_round",
+            bucket=bucket_index,
+            round=round_index,
+            width=width,
+        ):
+            state = progs.chunk(state, data, l2)
+            widths.append(width)
+            # host-side bookkeeping below overlaps the async device dispatch
+            its_after = np.asarray(jax.device_get(state.it)).astype(np.int64)
+            reasons = np.asarray(jax.device_get(state.reason))
+            executed += width * int(np.max(its_after - its_before)) if width else 0
+            done = (reasons != _NOT_CONVERGED) | (its_after >= max_iterations)
+            n_live = int(np.sum(~done))
+            if n_live == 0:
+                _scatter_extract(progs, state, data, pv, l2, live, buffers, E)
+                break
+            new_width = _next_pow2(max(n_live, min_lanes))
+            if new_width < width:
+                # freeze current results, then compact survivors (+ filler done
+                # lanes up to the pow2 width) into a dense prefix on device
+                _scatter_extract(progs, state, data, pv, l2, live, buffers, E)
+                keep = np.argsort(done, kind="stable")[:new_width]
+                idx = jnp.asarray(keep, dtype=jnp.int32)
+                state, data, pv = progs.compact((state, data, pv), idx)
+                live = live[keep]
+                its_before = its_after[keep]
+                width = new_width
+            else:
+                its_before = its_after
     else:
         _scatter_extract(progs, state, data, pv, l2, live, buffers, E)
 
@@ -364,12 +379,22 @@ def train_random_effects(
             and bucket.num_entities > adaptive.min_lanes
             and not _is_multi_device(bucket.X)
         )
-        if use_adaptive:
-            res, w, var, stats = _solve_bucket_adaptive(
-                progs, bucket, w0, l2, l1, max_iter, adaptive.min_lanes, b
-            )
-        else:
-            res, w, var, stats = _solve_bucket_oneshot(progs, bucket, w0, l2, l1, b)
+        with span(
+            "re/solve_bucket",
+            device_sync=True,
+            bucket=b,
+            mode="adaptive" if use_adaptive else "oneshot",
+            entities=bucket.num_entities,
+            optimizer=progs.kind,
+        ):
+            if use_adaptive:
+                res, w, var, stats = _solve_bucket_adaptive(
+                    progs, bucket, w0, l2, l1, max_iter, adaptive.min_lanes, b
+                )
+            else:
+                res, w, var, stats = _solve_bucket_oneshot(
+                    progs, bucket, w0, l2, l1, b
+                )
         coeffs.append(w)
         variances.append(var)
         results.append(res)

@@ -163,6 +163,10 @@ class EventEmitter:
 
     def __init__(self) -> None:
         self._listeners: List[EventListener] = []
+        #: Count of listener exceptions swallowed by ``send_event`` /
+        #: ``clear_listeners`` (isolation keeps training alive; this keeps
+        #: the failures observable — telemetry ledgers assert it is zero).
+        self.listener_errors: int = 0
 
     def register_listener(self, listener: EventListener) -> None:
         self._listeners.append(listener)
@@ -187,13 +191,26 @@ class EventEmitter:
                 f"cannot register event listener {dotted_name!r}: module "
                 f"{module_name!r} has no attribute {class_name!r}"
             ) from None
-        self.register_listener(cls())
+        try:
+            listener = cls()
+        except TypeError as e:
+            raise ValueError(
+                f"cannot register event listener {dotted_name!r}: "
+                f"{class_name!r} is not an instantiable listener class ({e})"
+            ) from e
+        if not hasattr(listener, "on_event"):
+            raise ValueError(
+                f"cannot register event listener {dotted_name!r}: "
+                f"{class_name!r} has no on_event method"
+            )
+        self.register_listener(listener)
 
     def send_event(self, event: Event) -> None:
         for listener in self._listeners:
             try:
                 listener.on_event(event)
             except Exception:  # noqa: BLE001 - listener isolation
+                self.listener_errors += 1
                 _log.exception("event listener %r failed", listener)
 
     def clear_listeners(self) -> None:
@@ -201,5 +218,6 @@ class EventEmitter:
             try:
                 listener.close()
             except Exception:  # noqa: BLE001
+                self.listener_errors += 1
                 _log.exception("event listener %r failed to close", listener)
         self._listeners = []

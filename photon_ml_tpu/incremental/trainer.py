@@ -35,6 +35,7 @@ from photon_ml_tpu.models.game import GameModel
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
 from photon_ml_tpu.parallel.mesh import fetch_global
+from photon_ml_tpu.telemetry import span
 
 
 @dataclasses.dataclass
@@ -105,6 +106,24 @@ def incremental_update(
     cheap mode for delta-publishing pipelines that never score the merged
     model host-side.
     """
+    with span(
+        "incremental/update",
+        num_events=events.num_rows,
+        refresh_fixed_iterations=int(refresh_fixed_iterations),
+        merge=merge,
+    ):
+        return _incremental_update_impl(
+            estimator, model, events, refresh_fixed_iterations, merge
+        )
+
+
+def _incremental_update_impl(
+    estimator: GameEstimator,
+    model: Union[GameModel, Dict[str, object], str],
+    events: GameData,
+    refresh_fixed_iterations: int,
+    merge: bool,
+) -> IncrementalUpdate:
     models = _load_models(model)
     fe_cids = [
         cid
@@ -126,7 +145,8 @@ def incremental_update(
     fe_updates: Dict[str, np.ndarray] = {}
     for _ in range(max(0, int(refresh_fixed_iterations))):
         for cid in fe_cids:
-            sub = estimator.resolve_coordinate(cid, events, models)
+            with span("incremental/resolve", coordinate=cid, kind="fixed"):
+                sub = estimator.resolve_coordinate(cid, events, models)
             assert isinstance(sub, GeneralizedLinearModel)
             models[cid] = sub
             fe_updates[cid] = np.asarray(
@@ -145,7 +165,8 @@ def incremental_update(
                 f"coordinate {cid!r}: expected a RandomEffectModel, got "
                 f"{type(old).__name__}"
             )
-        sub = estimator.resolve_coordinate(cid, events, models)
+        with span("incremental/resolve", coordinate=cid, kind="random"):
+            sub = estimator.resolve_coordinate(cid, events, models)
         if estimator.last_resolve_stats:
             solver_stats[cid] = list(estimator.last_resolve_stats)
         if estimator.last_resolve_transfers is not None:

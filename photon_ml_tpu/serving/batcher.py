@@ -23,6 +23,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
+from photon_ml_tpu.telemetry import span
 
 DEFAULT_BUCKET_SIZES = (1, 2, 4, 8, 16, 32)
 
@@ -105,7 +106,8 @@ class MicroBatcher:
         batch = [self._pending.popleft() for _ in range(n)]
         dequeued = self._clock()
         bucket = self._bucket_for(n)
-        results = self._scorer.score_batch([req for req, _ in batch], bucket)
+        with span("serve/drain", n=n, bucket=bucket):
+            results = self._scorer.score_batch([req for req, _ in batch], bucket)
         done = self._clock()
         if self._metrics is not None:
             self._metrics.observe_batch(

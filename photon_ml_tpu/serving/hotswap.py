@@ -34,6 +34,7 @@ from photon_ml_tpu.serving.artifact import ServingArtifact
 from photon_ml_tpu.serving.cache import HotEntityCache
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest
+from photon_ml_tpu.telemetry import span
 
 _log = logging.getLogger("photon_ml_tpu.serving.hotswap")
 
@@ -135,6 +136,12 @@ class HotSwapManager:
         into the live scorer. Raises on a broken fingerprint chain; returns
         a report (``rolled_back=True`` when the validation gate rejected
         the candidate and the previous generation was restored)."""
+        with span(
+            "serve/hotswap_apply", model_id=self._model_id, generation=self.generation
+        ):
+            return self._apply_delta_impl(delta)
+
+    def _apply_delta_impl(self, delta) -> SwapReport:
         from photon_ml_tpu.incremental.delta import (
             DeltaArtifact,
             apply_delta as fold_delta,

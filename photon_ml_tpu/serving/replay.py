@@ -20,6 +20,7 @@ from photon_ml_tpu.serving.artifact import ServingArtifact
 from photon_ml_tpu.serving.batcher import DEFAULT_BUCKET_SIZES, MicroBatcher
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
+from photon_ml_tpu.telemetry import span
 
 
 def requests_from_game_data(
@@ -113,12 +114,13 @@ def replay_requests(
     swap_reports: List[object] = []
     t0 = time.perf_counter()
     results: List[ScoreResult] = []
-    for i, req in enumerate(requests):
-        if watching and i % poll_every == 0:
-            results.extend(batcher.flush())
-            swap_reports.extend(swap_manager.poll_directory(watch_dir))
-        results.extend(batcher.submit(req))
-    results.extend(batcher.flush())
+    with span("serve/replay", num_requests=len(requests), model_id=model_id):
+        for i, req in enumerate(requests):
+            if watching and i % poll_every == 0:
+                results.extend(batcher.flush())
+                swap_reports.extend(swap_manager.poll_directory(watch_dir))
+            results.extend(batcher.submit(req))
+        results.extend(batcher.flush())
     wall = time.perf_counter() - t0
     snapshot = metrics.snapshot(
         cache_stats=scorer.cache_stats() or None,

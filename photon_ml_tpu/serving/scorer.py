@@ -28,6 +28,7 @@ import numpy as np
 
 from photon_ml_tpu.serving.artifact import ServingArtifact
 from photon_ml_tpu.serving.cache import HotEntityCache
+from photon_ml_tpu.telemetry import note_jit_trace, span
 
 
 @dataclasses.dataclass
@@ -189,6 +190,7 @@ class GameScorer:
         def _score(params, batch):
             # trace-time side effect: runs once per compiled shape signature
             self._compiles += 1
+            note_jit_trace("serving_score")
             z = batch["offsets"]
             for cid, shard in fe_specs:
                 vals, idx = batch["shards"][shard]
@@ -361,6 +363,17 @@ class GameScorer:
             return []
         if n > bucket:
             raise ValueError(f"{n} requests do not fit bucket size {bucket}")
+
+        with span("serve/score_batch", n=n, bucket=bucket):
+            return self._score_batch_impl(requests, n, bucket)
+
+    def _score_batch_impl(
+        self,
+        requests: Sequence[ScoreRequest],
+        n: int,
+        bucket: int,
+    ) -> List[ScoreResult]:
+        import jax.numpy as jnp
 
         shards, offsets = self._featurize(requests, bucket)
         slots: Dict[str, np.ndarray] = {}

@@ -1,0 +1,69 @@
+"""Unified telemetry: span tracing, metrics registry, exportable ledgers.
+
+The training and serving stacks grew their timing/counting signals
+piecemeal (``utils/timer.py``, ``opt/tracking.py``, ``serving/metrics.py``,
+the ``Event`` pub/sub). This package is the single place they all land:
+
+* :func:`span` — hierarchical, contextvar-scoped timing spans. Near-free
+  when disabled (the default); see :mod:`photon_ml_tpu.telemetry.span`.
+* :func:`get_registry` — process-global counters/gauges/histograms,
+  including the :func:`note_jit_trace` compile/retrace counter and
+  :func:`record_memory_watermarks`.
+* sinks — JSONL run ledger, Chrome trace-event (Perfetto) export, terminal
+  summary table, and the :class:`TelemetryEventListener` bridge.
+* :func:`start_run` — one handle tying the above together for a CLI/bench
+  run (``--telemetry-out`` / ``--trace-out``).
+
+See docs/OBSERVABILITY.md for the span model, metric names, and schemas.
+"""
+from photon_ml_tpu.telemetry.span import (
+    NOOP_SPAN,
+    SpanRecord,
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+    span,
+)
+from photon_ml_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    get_registry,
+    jit_trace_counts,
+    note_jit_trace,
+    record_memory_watermarks,
+)
+from photon_ml_tpu.telemetry.sinks import (
+    RunLedger,
+    TelemetryEventListener,
+    chrome_trace_events,
+    format_summary_table,
+    span_tree_summary,
+    write_chrome_trace,
+)
+from photon_ml_tpu.telemetry.session import TelemetryRun, start_run
+from photon_ml_tpu.telemetry.validate import validate_chrome_trace, validate_ledger
+
+__all__ = [
+    "NOOP_SPAN",
+    "SpanRecord",
+    "Tracer",
+    "disable_tracing",
+    "enable_tracing",
+    "get_tracer",
+    "span",
+    "MetricsRegistry",
+    "get_registry",
+    "jit_trace_counts",
+    "note_jit_trace",
+    "record_memory_watermarks",
+    "RunLedger",
+    "TelemetryEventListener",
+    "chrome_trace_events",
+    "format_summary_table",
+    "span_tree_summary",
+    "write_chrome_trace",
+    "TelemetryRun",
+    "start_run",
+    "validate_chrome_trace",
+    "validate_ledger",
+]

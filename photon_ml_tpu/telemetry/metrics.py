@@ -1,0 +1,223 @@
+"""Process-global metrics registry: counters, gauges, histograms.
+
+One :class:`MetricsRegistry` per process (via :func:`get_registry`)
+absorbs every numeric signal the codebase already produces piecemeal —
+``SolverStats``/``TransferStats`` from the optimizers, serving
+latency/hit-rate snapshots, hot-swap blackouts — plus two new ones:
+
+* **jit compile/retrace counting** — :func:`note_jit_trace` generalizes the
+  per-module ``solver_trace_counts()`` counter: any jitted program whose
+  Python body calls it at trace time shows up under ``jit.traces.*``.
+  Python side effects inside a traced function only run when XLA actually
+  (re)traces, so the counters move exactly on compile-cache misses.
+* **memory watermarks** — :func:`record_memory_watermarks` records the host
+  peak RSS and, where the backend reports it, per-device peak bytes.
+
+Histograms reuse the seeded bounded reservoir from
+``serving/metrics.py`` (Vitter's Algorithm R), so percentile snapshots are
+deterministic and memory stays fixed no matter how many observations land.
+All mutators are thread-safe and cheap (one lock + dict update), so the
+registry stays on even when span tracing is off.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "MetricsRegistry",
+    "get_registry",
+    "note_jit_trace",
+    "jit_trace_counts",
+    "record_memory_watermarks",
+]
+
+
+def _new_reservoir(seed: int):
+    # Imported lazily: ``photon_ml_tpu.serving`` imports modules that
+    # themselves import telemetry, so a module-level import here would be
+    # circular during package init.
+    from photon_ml_tpu.serving.metrics import _Reservoir
+
+    return _Reservoir(seed=seed)
+
+
+class MetricsRegistry:
+    """Thread-safe named counters, gauges (last value + peak watermark),
+    and reservoir-backed histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._gauge_peaks: Dict[str, float] = {}
+        self._hists: Dict[str, Any] = {}
+        self._next_seed = 0
+
+    # ----------------------------------------------------------- mutators
+    def count(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+            peak = self._gauge_peaks.get(name)
+            if peak is None or value > peak:
+                self._gauge_peaks[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = _new_reservoir(seed=self._next_seed)
+                self._next_seed += 1
+                self._hists[name] = hist
+            hist.add(value)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._gauge_peaks.clear()
+            self._hists.clear()
+            self._next_seed = 0
+
+    # ------------------------------------------------------------ readers
+    def counter_value(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Everything as one plain JSON-serializable dict."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = {
+                name: {"last": value, "peak": self._gauge_peaks[name]}
+                for name, value in self._gauges.items()
+            }
+            hists = {}
+            for name, res in self._hists.items():
+                entry = {
+                    "count": int(res.count),
+                    "mean": float(res.mean),
+                    "max": float(res.maximum),
+                }
+                if len(res):
+                    p50, p95, p99 = (
+                        float(x) for x in res.percentile([50, 95, 99])
+                    )
+                    entry.update(p50=p50, p95=p95, p99=p99)
+                hists[name] = entry
+        return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+    # --------------------------------------------------------- absorbers
+    def record_solver_stats(self, stats, coordinate: Optional[str] = None) -> None:
+        """Fold a ``SolverStats`` (duck-typed; see opt/tracking.py) into
+        solver.* counters/histograms."""
+        prefix = f"solver.{coordinate}" if coordinate else "solver"
+        self.count(f"{prefix}.buckets")
+        self.count(f"{prefix}.entities", getattr(stats, "num_entities", 0))
+        self.count(f"{prefix}.rounds", getattr(stats, "rounds", 0))
+        self.count(
+            f"{prefix}.executed_lane_iterations",
+            getattr(stats, "executed_lane_iterations", 0),
+        )
+        self.count(
+            f"{prefix}.lockstep_lane_iterations",
+            getattr(stats, "lockstep_lane_iterations", 0),
+        )
+        self.count(f"{prefix}.chunk_retraces", getattr(stats, "chunk_retraces", 0))
+        self.observe(f"{prefix}.iterations_p99", getattr(stats, "iterations_p99", 0))
+        if not getattr(stats, "converged", True):
+            self.count(f"{prefix}.unconverged_buckets")
+
+    def record_transfer_stats(self, transfers) -> None:
+        """Fold a full ``TransferStats`` (duck-typed; opt/tracking.py) into
+        transfer.* counters (one CD run's totals)."""
+        for field in (
+            "row_transfers_h2d",
+            "row_transfers_d2h",
+            "row_bytes_h2d",
+            "row_bytes_d2h",
+            "host_score_sums",
+            "device_plane_updates",
+            "coordinate_updates",
+            "outer_iterations",
+        ):
+            self.count(f"transfer.{field}", getattr(transfers, field, 0))
+
+    def record_serving_snapshot(self, snap: Dict[str, Any]) -> None:
+        """Fold a ``ServingMetrics.snapshot()`` dict into serving.* gauges."""
+        for key in (
+            "num_requests",
+            "num_batches",
+            "latency_p50_ms",
+            "latency_p99_ms",
+            "batch_fill",
+            "cache_hit_rate",
+            "compile_count",
+            "num_swaps",
+            "swap_blackout_max_ms",
+        ):
+            value = snap.get(key)
+            if isinstance(value, (int, float)):
+                self.gauge(f"serving.{key}", value)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry."""
+    return _REGISTRY
+
+
+def note_jit_trace(program: str, kind: str = "") -> None:
+    """Global jit compile/retrace hook. Call from *inside* a traced
+    function body: the Python side effect fires only on a compile-cache
+    miss, so ``jit.traces.<program>[/<kind>]`` counts actual (re)traces."""
+    key = f"{program}/{kind}" if kind else program
+    _REGISTRY.count("jit.traces")
+    _REGISTRY.count(f"jit.traces.{key}")
+
+
+def jit_trace_counts() -> Dict[str, int]:
+    """Per-program trace counts recorded via :func:`note_jit_trace`."""
+    snap = _REGISTRY.snapshot()["counters"]
+    prefix = "jit.traces."
+    return {
+        name[len(prefix):]: int(value)
+        for name, value in snap.items()
+        if name.startswith(prefix)
+    }
+
+
+def record_memory_watermarks(registry: Optional[MetricsRegistry] = None) -> Dict[str, float]:
+    """Record host peak RSS and per-device peak bytes as mem.* gauges.
+    Best-effort: backends without memory_stats (CPU) just skip devices."""
+    reg = registry if registry is not None else _REGISTRY
+    out: Dict[str, float] = {}
+    try:
+        import resource
+
+        peak_kib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        out["mem.host_peak_rss_bytes"] = float(peak_kib) * 1024.0  # Linux: KiB
+    except Exception:
+        pass
+    try:
+        import jax
+
+        for dev in jax.local_devices():
+            stats = getattr(dev, "memory_stats", None)
+            stats = stats() if callable(stats) else None
+            if not stats:
+                continue
+            peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+            if peak:
+                out[f"mem.device{dev.id}_peak_bytes"] = float(peak)
+    except Exception:
+        pass
+    for name, value in out.items():
+        reg.gauge(name, value)
+    return out

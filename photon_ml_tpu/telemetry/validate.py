@@ -1,0 +1,86 @@
+"""Schema checks for telemetry artifacts (used by tests and the CI gate).
+
+These are deliberately dependency-free structural validators — no
+jsonschema in the image — that raise ``ValueError`` with a precise message
+on the first violation and return the parsed payload on success.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+__all__ = ["validate_chrome_trace", "validate_ledger"]
+
+_REQUIRED_TRACE_KEYS = ("name", "cat", "ph", "ts", "dur", "pid", "tid")
+
+# ledger record type -> required extra fields ("type" and "ts" are
+# required on every record)
+_LEDGER_SCHEMAS: Dict[str, tuple] = {
+    "meta": ("phase",),
+    "event": ("event", "fields"),
+    "span": ("name", "path", "span_id", "duration_s", "failed"),
+    "metrics": ("snapshot",),
+}
+
+
+def validate_chrome_trace(path: str) -> Dict[str, Any]:
+    """Check ``path`` is valid Chrome trace-event JSON (object form with a
+    ``traceEvents`` list of complete events). Returns the parsed doc."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: trace must be a JSON object, got {type(doc).__name__}")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: missing traceEvents list")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"{path}: traceEvents[{i}] is not an object")
+        for key in _REQUIRED_TRACE_KEYS:
+            if key not in ev:
+                raise ValueError(f"{path}: traceEvents[{i}] missing {key!r}")
+        if ev["ph"] != "X":
+            raise ValueError(
+                f"{path}: traceEvents[{i}] has phase {ev['ph']!r}, expected 'X'"
+            )
+        for key in ("ts", "dur"):
+            if not isinstance(ev[key], (int, float)) or ev[key] < 0:
+                raise ValueError(
+                    f"{path}: traceEvents[{i}][{key!r}] must be a non-negative "
+                    f"number, got {ev[key]!r}"
+                )
+    return doc
+
+
+def validate_ledger(path: str) -> List[Dict[str, Any]]:
+    """Check every line of ``path`` is a typed JSONL record matching the
+    ledger schema. Returns the parsed records."""
+    records: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: invalid JSON ({e})") from e
+            if not isinstance(rec, dict):
+                raise ValueError(f"{path}:{lineno}: record is not an object")
+            rec_type = rec.get("type")
+            if rec_type not in _LEDGER_SCHEMAS:
+                raise ValueError(
+                    f"{path}:{lineno}: unknown record type {rec_type!r} "
+                    f"(expected one of {sorted(_LEDGER_SCHEMAS)})"
+                )
+            if not isinstance(rec.get("ts"), (int, float)):
+                raise ValueError(f"{path}:{lineno}: missing numeric 'ts'")
+            for field in _LEDGER_SCHEMAS[rec_type]:
+                if field not in rec:
+                    raise ValueError(
+                        f"{path}:{lineno}: {rec_type} record missing {field!r}"
+                    )
+            records.append(rec)
+    if not records:
+        raise ValueError(f"{path}: ledger is empty")
+    return records

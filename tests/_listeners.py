@@ -14,3 +14,20 @@ class CollectingListener(EventListener):
 
     def close(self) -> None:
         CollectingListener.closed += 1
+
+
+class FailingListener(EventListener):
+    """Raises on every event AND on close: drivers must isolate listener
+    failures (run to completion, count them on ``emitter.listener_errors``)."""
+
+    raised = 0
+
+    def on_event(self, event: Event) -> None:
+        FailingListener.raised += 1
+        raise RuntimeError("listener boom")
+
+    def close(self) -> None:
+        raise RuntimeError("close boom")
+
+
+NOT_A_LISTENER = object()  # register_listener_class must reject non-classes

@@ -1,0 +1,691 @@
+"""Telemetry subsystem tests: span tracer, metrics registry, sinks and
+validators (unit, fast lane), plus the driver-level smoke gate — tiny CPU
+train/score/serve/update runs with --telemetry-out/--trace-out whose ledger
+and Chrome trace are schema-validated (slow lane; CI runs this file whole
+as the telemetry smoke gate)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.telemetry import (
+    MetricsRegistry,
+    RunLedger,
+    TelemetryEventListener,
+    chrome_trace_events,
+    format_summary_table,
+    get_registry,
+    get_tracer,
+    jit_trace_counts,
+    span_tree_summary,
+    validate_chrome_trace,
+    validate_ledger,
+    write_chrome_trace,
+)
+from photon_ml_tpu.telemetry.span import (
+    NOOP_SPAN,
+    disable_tracing,
+    enable_tracing,
+    span,
+    timed_span,
+)
+
+
+@pytest.fixture()
+def tracer():
+    """Enabled global tracer, wall-clock only; always disabled afterwards."""
+    t = enable_tracing(device_sync=False, clear=True)
+    get_registry().reset()
+    yield t
+    disable_tracing()
+
+
+class TestSpans:
+    def test_disabled_returns_noop_singleton(self):
+        disable_tracing()
+        s = span("anything", key=1)
+        assert s is NOOP_SPAN
+        with s:
+            pass  # no-op context manager works and records nothing
+        assert s.set_attrs(more=2) is s
+
+    def test_nesting_parent_path_depth(self, tracer):
+        with span("outer", a=1):
+            with span("inner"):
+                pass
+        recs = {r.name: r for r in tracer.spans()}
+        assert recs["inner"].parent_id == recs["outer"].span_id
+        assert recs["inner"].path == "outer/inner"
+        assert recs["inner"].depth == 2
+        assert recs["outer"].parent_id is None
+        assert recs["outer"].depth == 1
+        assert recs["outer"].attrs == {"a": 1}
+        assert recs["outer"].duration_s >= recs["inner"].duration_s >= 0
+
+    def test_exception_tagged_not_swallowed(self, tracer):
+        with pytest.raises(KeyError):
+            with span("boom"):
+                raise KeyError("x")
+        (rec,) = tracer.spans()
+        assert rec.failed and rec.error == "KeyError"
+
+    def test_threads_nest_independently(self, tracer):
+        def worker(i):
+            with span(f"w{i}"):
+                with span("child"):
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        with span("main_parent"):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        children = [r for r in tracer.spans() if r.name == "child"]
+        assert len(children) == 4
+        # thread spans chain to their own thread's root, never to the main
+        # thread's open span (contextvars do not leak across threads)
+        by_id = {r.span_id: r for r in tracer.spans()}
+        for c in children:
+            assert by_id[c.parent_id].name.startswith("w")
+
+    def test_set_attrs_during_block(self, tracer):
+        with span("s") as s:
+            s.set_attrs(rows=10)
+        (rec,) = tracer.spans()
+        assert rec.attrs == {"rows": 10}
+
+    def test_timed_span_measures_when_disabled(self):
+        disable_tracing()
+        sp = timed_span("phase")
+        with sp:
+            pass
+        assert sp.duration_s >= 0.0 and not sp.failed
+        assert len(get_tracer().spans()) == 0 or all(
+            r.name != "phase" for r in get_tracer().spans()
+        )
+
+
+class TestTimerShims:
+    def test_timer_accumulates_and_counts_failures(self):
+        from photon_ml_tpu.utils.timer import Timer
+
+        disable_tracing()
+        timer = Timer()
+        with timer.time("ok"):
+            pass
+        with timer.time("ok"):
+            pass
+        with pytest.raises(ValueError):
+            with timer.time("bad"):
+                raise ValueError("x")
+        assert timer.durations["ok"] >= 0.0
+        assert "bad" in timer.durations  # failed phases still accumulate
+        assert timer.failures == {"bad": 1}
+        assert timer.failed("bad") and not timer.failed("ok")
+
+    def test_timer_thread_safe(self):
+        from photon_ml_tpu.utils.timer import Timer
+
+        timer = Timer()
+
+        def work():
+            for _ in range(50):
+                with timer.time("p"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert timer.durations["p"] >= 0.0 and not timer.failures
+
+    def test_timed_lands_as_span_when_tracing(self, tracer):
+        from photon_ml_tpu.utils.timer import Timed
+
+        with Timed("load model"):
+            pass
+        assert [r.name for r in tracer.spans()] == ["load model"]
+
+
+class TestMetricsRegistry:
+    def test_counters_gauges_histograms(self):
+        reg = MetricsRegistry()
+        reg.count("c")
+        reg.count("c", 4)
+        reg.gauge("g", 2.0)
+        reg.gauge("g", 1.0)  # peak stays at 2
+        for v in range(100):
+            reg.observe("h", float(v))
+        snap = reg.snapshot()
+        assert snap["counters"]["c"] == 5
+        assert snap["gauges"]["g"] == {"last": 1.0, "peak": 2.0}
+        h = snap["histograms"]["h"]
+        assert h["count"] == 100 and h["max"] == 99.0
+        assert 40 <= h["p50"] <= 60
+        json.dumps(snap)  # snapshot must be plain JSON
+        reg.reset()
+        assert reg.snapshot() == {
+            "counters": {}, "gauges": {}, "histograms": {}
+        }
+
+    def test_absorbers_duck_typed(self):
+        class Stats:
+            num_entities = 7
+            rounds = 2
+            executed_lane_iterations = 30
+            lockstep_lane_iterations = 90
+            chunk_retraces = 1
+            iterations_p99 = 12.0
+            converged = False
+
+        class Transfers:
+            row_transfers_h2d = 3
+            row_transfers_d2h = 1
+            row_bytes_h2d = 300
+            row_bytes_d2h = 100
+            host_score_sums = 0
+            device_plane_updates = 6
+            coordinate_updates = 6
+            outer_iterations = 2
+
+        reg = MetricsRegistry()
+        reg.record_solver_stats(Stats(), coordinate="per_user")
+        reg.record_transfer_stats(Transfers())
+        reg.record_serving_snapshot({"latency_p99_ms": 4.5, "caches": {}})
+        snap = reg.snapshot()
+        assert snap["counters"]["solver.per_user.entities"] == 7
+        assert snap["counters"]["solver.per_user.unconverged_buckets"] == 1
+        assert snap["counters"]["transfer.row_bytes_h2d"] == 300
+        assert snap["gauges"]["serving.latency_p99_ms"]["last"] == 4.5
+        assert "serving.caches" not in snap["gauges"]  # non-numeric skipped
+
+    def test_note_jit_trace_counts_retraces_only(self):
+        import jax
+
+        reg = get_registry()
+        reg.reset()
+        from photon_ml_tpu.telemetry import note_jit_trace
+
+        @jax.jit
+        def f(x):
+            note_jit_trace("test_prog", "unit")
+            return x + 1
+
+        f(np.float32(1.0))
+        f(np.float32(2.0))  # cache hit: no retrace, no count
+        assert jit_trace_counts()["test_prog/unit"] == 1
+        f(np.ones((2,), np.float32))  # new shape → retrace
+        assert jit_trace_counts()["test_prog/unit"] == 2
+        assert reg.counter_value("jit.traces") == 2
+
+
+class TestSinksAndValidators:
+    def test_ledger_round_trip(self, tmp_path, tracer):
+        with span("a"):
+            with span("b"):
+                pass
+        path = tmp_path / "sub" / "ledger.jsonl"  # parent dir auto-created
+        ledger = RunLedger(str(path))
+        ledger.write("meta", phase="start", label="t")
+        for rec in tracer.spans():
+            ledger.write_span(rec, tracer.origin_unix)
+        ledger.write("metrics", snapshot=get_registry().snapshot())
+        ledger.write("meta", phase="finish", label="t")
+        ledger.close()
+        records = validate_ledger(str(path))
+        assert [r["type"] for r in records] == [
+            "meta", "span", "span", "metrics", "meta"
+        ]
+        spans = [r for r in records if r["type"] == "span"]
+        assert {s["path"] for s in spans} == {"a", "a/b"}
+        assert all(not s["failed"] for s in spans)
+
+    def test_ledger_validator_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text('{"type": "span", "ts": 1.0}\n')  # missing span fields
+        with pytest.raises(ValueError, match="span"):
+            validate_ledger(str(p))
+        p.write_text("not json\n")
+        with pytest.raises(ValueError, match="invalid JSON"):
+            validate_ledger(str(p))
+        p.write_text("")
+        with pytest.raises(ValueError, match="empty"):
+            validate_ledger(str(p))
+
+    def test_chrome_trace_round_trip(self, tmp_path, tracer):
+        with span("cd/run", plane="device"):
+            with pytest.raises(RuntimeError):
+                with span("cd/outer_iter"):
+                    raise RuntimeError("x")
+        out = tmp_path / "trace.json"
+        n = write_chrome_trace(str(out), tracer.spans(), metadata={"k": 1})
+        assert n == 2
+        doc = validate_chrome_trace(str(out))
+        events = {e["name"]: e for e in doc["traceEvents"]}
+        assert events["cd/run"]["cat"] == "cd"
+        assert events["cd/outer_iter"]["args"]["error"] == "RuntimeError"
+        assert all(e["ph"] == "X" for e in doc["traceEvents"])
+
+    def test_chrome_trace_validator_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"traceEvents": [{"name": "x"}]}))
+        with pytest.raises(ValueError):
+            validate_chrome_trace(str(p))
+        p.write_text(json.dumps({"nope": []}))
+        with pytest.raises(ValueError):
+            validate_chrome_trace(str(p))
+
+    def test_span_tree_summary_depth_filter(self, tracer):
+        with span("cd/run"):  # slash in the NAME is not extra depth
+            with span("cd/outer_iter"):
+                with span("cd/coordinate"):
+                    pass
+        full = span_tree_summary(tracer.spans())
+        assert set(full) == {
+            "cd/run", "cd/run/cd/outer_iter",
+            "cd/run/cd/outer_iter/cd/coordinate",
+        }
+        top2 = span_tree_summary(tracer.spans(), max_depth=2)
+        assert set(top2) == {"cd/run", "cd/run/cd/outer_iter"}
+        assert top2["cd/run"]["count"] == 1
+
+    def test_format_summary_table(self, tracer):
+        with span("fit"):
+            pass
+        get_registry().count("jit.traces.prog", 3)
+        table = format_summary_table(
+            tracer.spans(), get_registry().snapshot(), "unit"
+        )
+        assert "fit" in table and "prog" in table
+
+
+class TestEventBridge:
+    def test_events_land_in_ledger_and_registry(self, tmp_path):
+        from photon_ml_tpu.event import (
+            EventEmitter,
+            ModelSwapEvent,
+            ScoringFinishEvent,
+            SolverStatsEvent,
+            TrainingStartEvent,
+        )
+
+        reg = MetricsRegistry()
+        ledger = RunLedger(str(tmp_path / "ledger.jsonl"))
+        emitter = EventEmitter()
+        emitter.register_listener(
+            TelemetryEventListener(ledger=ledger, registry=reg)
+        )
+        emitter.send_event(TrainingStartEvent(task="LOGISTIC_REGRESSION"))
+        emitter.send_event(SolverStatsEvent(
+            coordinate_id="per_user", bucket=0, optimizer="lbfgs",
+            num_entities=4, rounds=1, dispatch_widths=(4,),
+            iterations_p50=3.0, iterations_p99=5.0,
+            executed_lane_iterations=12, lockstep_lane_iterations=20,
+            wasted_lane_fraction=0.4,
+        ))
+        emitter.send_event(ScoringFinishEvent(
+            model_id="m", num_requests=10, wall_seconds=0.5,
+            metrics={"latency_p99_ms": 3.0},
+        ))
+        emitter.send_event(ModelSwapEvent(
+            model_id="m", generation=1, fingerprint=None,
+            coordinates=("per_user",), rows_updated=5, blackout_s=0.01,
+        ))
+        emitter.clear_listeners()
+        assert emitter.listener_errors == 0
+        records = validate_ledger(str(ledger.path))
+        events = [r["event"] for r in records if r["type"] == "event"]
+        assert events == [
+            "TrainingStartEvent", "SolverStatsEvent",
+            "ScoringFinishEvent", "ModelSwapEvent",
+        ]
+        snap = reg.snapshot()
+        assert snap["counters"]["events.TrainingStartEvent"] == 1
+        assert snap["counters"]["solver.per_user.entities"] == 4
+        assert snap["gauges"]["serving.latency_p99_ms"]["last"] == 3.0
+        assert snap["counters"]["serving.swaps"] == 1
+
+    def test_failing_listener_isolated_from_bridge(self, tmp_path):
+        from photon_ml_tpu.event import EventEmitter, TrainingStartEvent
+        from tests._listeners import CollectingListener, FailingListener
+
+        CollectingListener.received = []
+        FailingListener.raised = 0
+        ledger = RunLedger(str(tmp_path / "ledger.jsonl"))
+        emitter = EventEmitter()
+        emitter.register_listener_class("tests._listeners.FailingListener")
+        emitter.register_listener(
+            TelemetryEventListener(ledger=ledger, registry=MetricsRegistry())
+        )
+        emitter.register_listener_class("tests._listeners.CollectingListener")
+        emitter.send_event(TrainingStartEvent(task="T"))
+        emitter.clear_listeners()
+        # the failing listener raised on the event AND on close, yet both
+        # other listeners saw everything
+        assert FailingListener.raised == 1
+        assert emitter.listener_errors == 2
+        assert len(CollectingListener.received) == 1
+        events = [
+            r for r in validate_ledger(str(ledger.path))
+            if r["type"] == "event"
+        ]
+        assert len(events) == 1
+
+    def test_register_listener_class_error_paths(self):
+        from photon_ml_tpu.event import EventEmitter
+
+        emitter = EventEmitter()
+        with pytest.raises(ValueError, match="dotted"):
+            emitter.register_listener_class("NoDots")
+        with pytest.raises(ValueError, match="failed to import"):
+            emitter.register_listener_class("no.such.module.Listener")
+        with pytest.raises(ValueError, match="no attribute"):
+            emitter.register_listener_class("tests._listeners.Missing")
+        with pytest.raises(ValueError, match="not an instantiable"):
+            emitter.register_listener_class("tests._listeners.NOT_A_LISTENER")
+        assert emitter._listeners == []
+
+
+# ---------------------------------------------------------------------------
+# Driver smoke gate: tiny CPU end-to-end runs through the real CLIs with
+# telemetry on. CI runs this whole file as the telemetry gate.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_avro(tmp_path_factory):
+    """Tiny GLMix logistic fixture (8 users) + a config whose RE coordinate
+    opts into the adaptive driver with min_lanes small enough to engage on
+    8 entities, so re/adaptive_round spans appear in the gate."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    root = tmp_path_factory.mktemp("telemetry_glmix")
+    rng = np.random.default_rng(3)
+    n_users, rows, dg, du = 8, 12, 5, 3
+    wg = rng.normal(size=dg)
+    wu = {f"user{i}": rng.normal(size=du) for i in range(n_users)}
+
+    def make(n_rows, seed):
+        r = np.random.default_rng(seed)
+        records = []
+        for i in range(n_rows):
+            user = f"user{i % n_users}"
+            xg = r.normal(size=dg)
+            xu = r.normal(size=du)
+            z = xg @ wg + xu @ wu[user]
+            y = 1.0 if 1 / (1 + np.exp(-z)) > r.random() else 0.0
+            records.append({
+                "uid": f"r{i}",
+                "label": y,
+                "features": [("g", str(j), xg[j]) for j in range(dg)],
+                "userFeatures": [("u", str(j), xu[j]) for j in range(du)],
+                "metadataMap": {"userId": user},
+            })
+        return records
+
+    train_dir = root / "train"
+    test_dir = root / "test"
+    train_dir.mkdir()
+    test_dir.mkdir()
+    write_training_examples(
+        str(train_dir / "part-00000.avro"), make(n_users * rows, 1)
+    )
+    write_training_examples(
+        str(test_dir / "part-00000.avro"), make(n_users * 4, 2)
+    )
+    config = {
+        "feature_shards": {
+            "global": {"feature_bags": ["features"], "add_intercept": True},
+            "per_user": {
+                "feature_bags": ["userFeatures"], "add_intercept": False,
+            },
+        },
+        "coordinates": {
+            "fixed": {
+                "type": "fixed",
+                "feature_shard": "global",
+                "optimizer": {
+                    "optimizer": "LBFGS",
+                    "regularization": "L2",
+                    "regularization_weight": 0.1,
+                },
+            },
+            "per_user": {
+                "type": "random",
+                "feature_shard": "per_user",
+                "random_effect_type": "userId",
+                "optimizer": {
+                    "optimizer": "LBFGS",
+                    "regularization": "L2",
+                    "regularization_weight": 1.0,
+                    "adaptive": {
+                        "enabled": True, "chunk_iters": 4, "min_lanes": 2,
+                    },
+                },
+            },
+        },
+        "update_order": ["fixed", "per_user"],
+    }
+    cfg_path = root / "game.json"
+    cfg_path.write_text(json.dumps(config))
+    return {"root": root, "train": train_dir, "test": test_dir,
+            "config": cfg_path}
+
+
+@pytest.mark.slow
+class TestDriverTelemetrySmoke:
+    @pytest.fixture(scope="class")
+    def trained(self, tiny_avro, tmp_path_factory):
+        """One traced train_game run shared by the downstream driver tests:
+        model dir + validated ledger/trace paths."""
+        from tests._listeners import CollectingListener
+
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        CollectingListener.received = []
+        out = tmp_path_factory.mktemp("telemetry_out")
+        ledger_path = out / "train.jsonl"
+        trace_path = out / "train-trace.json"
+        run(parse_args([
+            "--train-data-dirs", str(tiny_avro["train"]),
+            "--validation-data-dirs", str(tiny_avro["test"]),
+            "--coordinate-config", str(tiny_avro["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out / "model"),
+            "--evaluator", "AUC",
+            "--event-listeners", "tests._listeners.CollectingListener",
+            "--telemetry-out", str(ledger_path),
+            "--trace-out", str(trace_path),
+        ]))
+        return {
+            "out": out,
+            "model": out / "model" / "best",
+            "ledger": ledger_path,
+            "trace": trace_path,
+            "events": list(CollectingListener.received),
+        }
+
+    def test_train_ledger_and_trace_schemas(self, trained):
+        records = validate_ledger(str(trained["ledger"]))
+        doc = validate_chrome_trace(str(trained["trace"]))
+        span_paths = {r["path"] for r in records if r["type"] == "span"}
+        # spans from coordinate descent AND the adaptive RE driver
+        assert any("cd/outer_iter" in p for p in span_paths)
+        assert any("cd/coordinate" in p for p in span_paths)
+        assert any("re/adaptive_round" in p for p in span_paths)
+        assert any("re/solve_bucket" in p for p in span_paths)
+        assert len(doc["traceEvents"]) > 0
+        # every existing Event was bridged into the ledger
+        event_names = [r["event"] for r in records if r["type"] == "event"]
+        assert "PhotonSetupEvent" in event_names
+        assert "TrainingStartEvent" in event_names
+        assert "TrainingFinishEvent" in event_names
+        assert "SolverStatsEvent" in event_names
+        # zero listener errors, recorded in the finish meta record
+        finish = [
+            r for r in records
+            if r["type"] == "meta" and r.get("phase") == "finish"
+        ]
+        assert len(finish) == 1 and finish[0]["listener_errors"] == 0
+        assert finish[0]["num_spans"] == len(
+            [r for r in records if r["type"] == "span"]
+        )
+        # the user listener rode along untouched
+        assert len(trained["events"]) > 0
+
+    def test_train_failing_listener_isolated(self, tiny_avro, tmp_path):
+        """A listener that raises on every event must not fail the driver;
+        the swallowed count lands in the ledger's finish record."""
+        from tests._listeners import FailingListener
+
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        FailingListener.raised = 0
+        ledger_path = tmp_path / "ledger.jsonl"
+        run(parse_args([
+            "--train-data-dirs", str(tiny_avro["train"]),
+            "--coordinate-config", str(tiny_avro["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(tmp_path / "model"),
+            "--event-listeners", "tests._listeners.FailingListener",
+            "--telemetry-out", str(ledger_path),
+        ]))
+        assert FailingListener.raised > 0
+        records = validate_ledger(str(ledger_path))
+        finish = [
+            r for r in records
+            if r["type"] == "meta" and r.get("phase") == "finish"
+        ][0]
+        assert finish["listener_errors"] > 0
+
+    def test_train_bad_listener_fails_fast(self, tiny_avro, tmp_path):
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        with pytest.raises(ValueError, match="no attribute"):
+            run(parse_args([
+                "--train-data-dirs", str(tiny_avro["train"]),
+                "--coordinate-config", str(tiny_avro["config"]),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(tmp_path / "model"),
+                "--event-listeners", "tests._listeners.Missing",
+            ]))
+
+    def test_score_game_telemetry_and_listeners(self, trained, tiny_avro,
+                                                tmp_path):
+        from tests._listeners import CollectingListener
+
+        from photon_ml_tpu.cli.score_game import parse_args, run
+
+        CollectingListener.received = []
+        ledger_path = tmp_path / "score.jsonl"
+        trace_path = tmp_path / "score-trace.json"
+        run(parse_args([
+            "--data-dirs", str(tiny_avro["test"]),
+            "--model-dir", str(trained["model"]),
+            "--output-dir", str(tmp_path / "scores"),
+            "--evaluator", "AUC",
+            "--event-listeners", "tests._listeners.CollectingListener",
+            "--telemetry-out", str(ledger_path),
+            "--trace-out", str(trace_path),
+        ]))
+        records = validate_ledger(str(ledger_path))
+        validate_chrome_trace(str(trace_path))
+        event_names = [r["event"] for r in records if r["type"] == "event"]
+        assert "ScoringStartEvent" in event_names
+        assert "ScoringFinishEvent" in event_names
+        names = {n for n in (type(e).__name__
+                             for e in CollectingListener.received)}
+        assert "ScoringFinishEvent" in names
+        # Timer phases land as spans (score, save scores, ...)
+        span_names = {r["name"] for r in records if r["type"] == "span"}
+        assert "score" in span_names
+
+    def test_serve_game_telemetry(self, trained, tiny_avro, tmp_path):
+        from photon_ml_tpu.cli.serve_game import parse_args, run
+
+        ledger_path = tmp_path / "serve.jsonl"
+        trace_path = tmp_path / "serve-trace.json"
+        run(parse_args([
+            "--model-dir", str(trained["model"]),
+            "--data-dirs", str(tiny_avro["test"]),
+            "--max-requests", "16",
+            "--bucket-sizes", "1,2,4",
+            "--metrics-output", str(tmp_path / "metrics.json"),
+            "--telemetry-out", str(ledger_path),
+            "--trace-out", str(trace_path),
+        ]))
+        records = validate_ledger(str(ledger_path))
+        validate_chrome_trace(str(trace_path))
+        span_paths = {r["path"] for r in records if r["type"] == "span"}
+        assert any("serve/replay" in p for p in span_paths)
+        assert any("serve/score_batch" in p for p in span_paths)
+        event_names = [r["event"] for r in records if r["type"] == "event"]
+        assert "ScoringFinishEvent" in event_names
+        # the bridged snapshot landed as serving.* gauges in the metrics
+        # record
+        (metrics,) = [r for r in records if r["type"] == "metrics"]
+        assert "serving.num_requests" in metrics["snapshot"]["gauges"]
+
+    def test_update_game_telemetry(self, trained, tiny_avro, tmp_path):
+        from photon_ml_tpu.cli.serve_game import (
+            parse_args as serve_args,
+            run as serve_run,
+        )
+        from photon_ml_tpu.cli.update_game import parse_args, run
+
+        artifact_dir = tmp_path / "artifact"
+        serve_run(serve_args([
+            "--model-dir", str(trained["model"]),
+            "--export-artifact-dir", str(artifact_dir),
+        ]))
+        ledger_path = tmp_path / "update.jsonl"
+        run(parse_args([
+            "--base-artifact-dir", str(artifact_dir),
+            "--model-dir", str(trained["model"]),
+            "--coordinate-config", str(tiny_avro["config"]),
+            "--events-data-dirs", str(tiny_avro["test"]),
+            "--output-dir", str(tmp_path / "deltas"),
+            "--telemetry-out", str(ledger_path),
+        ]))
+        records = validate_ledger(str(ledger_path))
+        span_paths = {r["path"] for r in records if r["type"] == "span"}
+        assert any("incremental/update" in p for p in span_paths)
+        assert any("incremental/resolve" in p for p in span_paths)
+        finish = [
+            r for r in records
+            if r["type"] == "meta" and r.get("phase") == "finish"
+        ][0]
+        assert finish["listener_errors"] == 0
+
+    def test_disabled_default_bitwise_identical(self, tiny_avro, tmp_path):
+        """Telemetry must not perturb training: the same tiny fit with and
+        without tracing produces bitwise-identical coefficients."""
+        from photon_ml_tpu.cli.train_game import parse_args, run
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        def train(tag, telemetry):
+            out = tmp_path / tag
+            argv = [
+                "--train-data-dirs", str(tiny_avro["train"]),
+                "--coordinate-config", str(tiny_avro["config"]),
+                "--task", "LOGISTIC_REGRESSION",
+                "--output-dir", str(out),
+            ]
+            if telemetry:
+                argv += ["--telemetry-out", str(out / "ledger.jsonl")]
+            run(parse_args(argv))
+            model, _ = load_game_model(str(out / "best"))
+            return model
+
+        plain = train("plain", telemetry=False)
+        traced = train("traced", telemetry=True)
+        fixed_p = np.asarray(plain.models["fixed"].coefficients.means)
+        fixed_t = np.asarray(traced.models["fixed"].coefficients.means)
+        np.testing.assert_array_equal(fixed_p, fixed_t)
+        re_p = dict(plain.models["per_user"].items())
+        re_t = dict(traced.models["per_user"].items())
+        assert re_p == re_t  # exact per-entity sparse coefficient equality
