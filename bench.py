@@ -555,6 +555,34 @@ def _emit_failure(error: str) -> None:
     os._exit(2 if not payload.get("value") else 3)
 
 
+_HISTORY_PATH = os.path.join(_REPO, "BENCH_HISTORY.jsonl")
+
+
+def _append_history(payload: dict, mode: str) -> None:
+    """Perf-trajectory sentinel: append the headline numbers of every bench
+    artifact to BENCH_HISTORY.jsonl (one compact record per measurement).
+    dev-scripts/check_perf_trajectory.py walks this file. Smoke runs skip
+    the append (the bench contract: smoke must not touch committed
+    artifacts) unless BENCH_HISTORY_WRITE opts in."""
+    if _SMOKE and not _env_flag("BENCH_HISTORY_WRITE"):
+        return
+    rec = {
+        "ts": round(time.time(), 1),
+        "mode": mode,
+        "metric": payload.get("metric"),
+        "value": payload.get("value"),
+        "unit": payload.get("unit"),
+        "host": _host_fingerprint(),
+    }
+    if payload.get("error"):
+        rec["error"] = payload["error"]
+    try:
+        with open(_HISTORY_PATH, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    except OSError:
+        pass
+
+
 def _write_lastgood(payload: dict) -> None:
     """Record a successful full measurement in-repo: the stale-fallback
     source for a later run that cannot reach the backend at all."""
@@ -568,6 +596,7 @@ def _write_lastgood(payload: dict) -> None:
             json.dump(rec, f, indent=1)
     except OSError:
         pass
+    _append_history(rec, "headline")
 
 
 def _arm_watchdog(seconds: int = 2700) -> None:
@@ -620,27 +649,53 @@ def _backend_preflight(timeout_s: int = 300, watchdog_s: int = 2700) -> None:
     _emit_failure(f"backend preflight failed after {attempts} attempts: {last}")
 
 
-def _bench_telemetry():
-    """Enable span tracing for a sub-bench and return a summarizer.
+def _bench_telemetry(mode: str = "bench"):
+    """Enable span tracing + a run ledger for a sub-bench; return a
+    summarizer.
 
-    The summarizer stops tracing and returns the compact telemetry block
-    embedded in the bench JSON artifact: jit compile/retrace counts, the
-    top-level span tree, and any transfer.* totals absorbed into the
-    registry. device_sync stays OFF so instrumented barrier requests cannot
-    perturb the measured numbers."""
+    The summarizer finishes the telemetry run, VALIDATES its own ledger and
+    Chrome trace (schema checks from telemetry/validate.py — malformed
+    telemetry fails the bench loudly instead of silently shipping a BENCH
+    artifact), and returns the compact telemetry block embedded in the
+    bench JSON artifact: jit compile/retrace counts, the top-level span
+    tree, transfer.* totals, and the validated ledger/trace paths (so
+    ``analyze_run`` can replay the bench afterwards). Ledger and trace land
+    in $BENCH_TELEMETRY_DIR (default: a fresh temp dir) — never in the
+    repo, so smoke runs cannot touch committed artifacts. device_sync
+    stays OFF so instrumented barrier requests cannot perturb the measured
+    numbers."""
+    import tempfile
+
     from photon_ml_tpu.telemetry import (
         disable_tracing,
-        enable_tracing,
         get_registry,
         jit_trace_counts,
         span_tree_summary,
+        start_run,
+        validate_chrome_trace,
+        validate_ledger,
     )
 
+    out_dir = os.environ.get("BENCH_TELEMETRY_DIR") or tempfile.mkdtemp(
+        prefix=f"bench-telemetry-{mode}-"
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    ledger_path = os.path.join(out_dir, f"{mode}-ledger.jsonl")
+    trace_path = os.path.join(out_dir, f"{mode}-trace.json")
     get_registry().reset()
-    tracer = enable_tracing(device_sync=False)
+    run = start_run(
+        f"bench-{mode}",
+        ledger_path=ledger_path,
+        trace_path=trace_path,
+        device_sync=False,
+    )
+    tracer = run.tracer
 
     def summarize():
+        run.finish()
         disable_tracing()
+        num_records = len(validate_ledger(ledger_path))
+        validate_chrome_trace(trace_path)
         counters = get_registry().snapshot()["counters"]
         transfers = {
             k[len("transfer."):]: v
@@ -651,6 +706,10 @@ def _bench_telemetry():
             "num_spans": len(tracer),
             "jit_traces": jit_trace_counts(),
             "span_tree": span_tree_summary(tracer.spans(), max_depth=2),
+            "ledger": ledger_path,
+            "trace": trace_path,
+            "ledger_records": num_records,
+            "validated": True,
             **({"transfers": transfers} if transfers else {}),
         }
 
@@ -695,7 +754,7 @@ def _serving_bench():
         from photon_ml_tpu.serving.scorer import ScoreRequest
         from photon_ml_tpu.types import TaskType
 
-        summarize_telemetry = _bench_telemetry()
+        summarize_telemetry = _bench_telemetry("serving")
         rng = np.random.default_rng(SEED)
         fe_w = (rng.standard_normal(D_SRV_FE) * 0.1).astype(np.float32)
         re_table = (
@@ -790,6 +849,7 @@ def _serving_bench():
         if not _SMOKE or _env_flag("BENCH_SERVING_WRITE"):
             with open(_SERVING_PATH, "w") as f:
                 json.dump(payload, f, indent=2)
+        _append_history(payload, "serving")
     except Exception as e:  # noqa: BLE001 - one JSON line per exit path
         print(json.dumps({
             "metric": "serving_p99_latency_s",
@@ -852,7 +912,7 @@ def _incremental_bench():
         )
         from photon_ml_tpu.types import RegularizationType, TaskType
 
-        summarize_telemetry = _bench_telemetry()
+        summarize_telemetry = _bench_telemetry("incremental")
         l2 = lambda lam: GlmOptimizationConfiguration(  # noqa: E731
             regularization=RegularizationContext(RegularizationType.L2),
             regularization_weight=lam,
@@ -956,6 +1016,7 @@ def _incremental_bench():
         if not _SMOKE or _env_flag("BENCH_INCREMENTAL_WRITE"):
             with open(_INCREMENTAL_PATH, "w") as f:
                 json.dump(payload, f, indent=2)
+        _append_history(payload, "incremental")
     except Exception as e:  # noqa: BLE001 - one JSON line per exit path
         print(json.dumps({
             "metric": "incremental_update_latency_s",
@@ -1001,7 +1062,7 @@ def _re_adaptive_bench():
         )
         from photon_ml_tpu.types import RegularizationType, TaskType
 
-        summarize_telemetry = _bench_telemetry()
+        summarize_telemetry = _bench_telemetry("re-adaptive")
         rng = np.random.default_rng(SEED)
         rows, cols, vals, ids = [], [], [], []
         labels_base, labels_fresh = [], []
@@ -1094,6 +1155,7 @@ def _re_adaptive_bench():
         if not _SMOKE or _env_flag("BENCH_RE_ADAPTIVE_WRITE"):
             with open(_RE_ADAPTIVE_PATH, "w") as f:
                 json.dump(payload, f, indent=2)
+        _append_history(payload, "re-adaptive")
     except Exception as e:  # noqa: BLE001 - one JSON line per exit path
         print(json.dumps({
             "metric": "re_adaptive_speedup",
@@ -1143,7 +1205,7 @@ def _cd_scores_bench():
         from photon_ml_tpu.opt.config import OptimizerConfig
         from photon_ml_tpu.types import RegularizationType, TaskType
 
-        summarize_telemetry = _bench_telemetry()
+        summarize_telemetry = _bench_telemetry("cd-scores")
         rng = np.random.default_rng(SEED)
         n = N_CD_USERS * N_CD_ROWS_PER_USER
         Xg = rng.normal(size=(n, D_CD_FE)).astype(np.float32) * 0.3
@@ -1301,9 +1363,185 @@ def _cd_scores_bench():
         if not _SMOKE or _env_flag("BENCH_CD_SCORES_WRITE"):
             with open(_CD_SCORES_PATH, "w") as f:
                 json.dump(payload, f, indent=2)
+        _append_history(payload, "cd-scores")
     except Exception as e:  # noqa: BLE001 - one JSON line per exit path
         print(json.dumps({
             "metric": "cd_score_plane_overhead_reduction",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
+
+
+_TUNING_PATH = os.path.join(_REPO, "BENCH_TUNING.json")
+
+
+def _tuning_bench():
+    """Close the telemetry loop on the serving replay: run the default
+    serving config under a run ledger, replay that ledger through the
+    analyzer, let the tuner propose knob overrides from the evidence, then
+    re-run the replay with the tuned config and report the default-vs-tuned
+    deltas. The headline is the p99 latency delta (positive = tuned is
+    faster); BENCH_TUNING.json records both arms plus the proposal that
+    connected them. Emits ONE JSON line; an exception emits an error line
+    instead (same contract as the other sub-benches)."""
+    import sys
+
+    try:
+        import jax
+
+        if _SMOKE:
+            jax.config.update("jax_platforms", "cpu")
+        from photon_ml_tpu.indexmap import DefaultIndexMap
+        from photon_ml_tpu.serving import (
+            GameScorer,
+            ServingArtifact,
+            ServingTable,
+            replay_requests,
+        )
+        from photon_ml_tpu.serving.scorer import ScoreRequest
+        from photon_ml_tpu.telemetry import analyze_ledger, get_registry
+        from photon_ml_tpu.tuning import ab_candidates, get_knob, propose
+        from photon_ml_tpu.types import TaskType
+
+        summarize_telemetry = _bench_telemetry("tuning")
+        rng = np.random.default_rng(SEED)
+        fe_w = (rng.standard_normal(D_SRV_FE) * 0.1).astype(np.float32)
+        re_table = (
+            rng.standard_normal((N_SRV_ENT, D_SRV_RE)) * 0.3
+        ).astype(np.float32)
+        artifact = ServingArtifact(
+            task=TaskType.LOGISTIC_REGRESSION,
+            tables={
+                "fixed": ServingTable(
+                    feature_shard="global", random_effect_type=None,
+                    weights=fe_w,
+                ),
+                "per_user": ServingTable(
+                    feature_shard="per_user", random_effect_type="userId",
+                    weights=re_table,
+                    entity_index=DefaultIndexMap(
+                        {f"u{i}": i for i in range(N_SRV_ENT)}
+                    ),
+                ),
+            },
+            model_name="tuning-bench",
+        )
+        ent = (rng.zipf(1.3, N_SRV_REQ) - 1) % N_SRV_ENT
+        fe_idx = rng.integers(0, D_SRV_FE, (N_SRV_REQ, K_SRV_FE))
+        fe_val = rng.standard_normal((N_SRV_REQ, K_SRV_FE)).astype(np.float32)
+        re_val = rng.standard_normal((N_SRV_REQ, D_SRV_RE)).astype(np.float32)
+        requests = [
+            ScoreRequest(
+                request_id=f"r{i}",
+                features={
+                    "global": {
+                        int(c): float(v)
+                        for c, v in zip(fe_idx[i], fe_val[i])
+                    },
+                    "per_user": {
+                        j: float(re_val[i, j]) for j in range(D_SRV_RE)
+                    },
+                },
+                entity_ids={"userId": f"u{ent[i]}"},
+            )
+            for i in range(N_SRV_REQ)
+        ]
+
+        def _replay(buckets, cache_capacity):
+            scorer = GameScorer(
+                artifact,
+                max_nnz={"global": K_SRV_FE, "per_user": D_SRV_RE},
+                cache_capacity=cache_capacity,
+            )
+            for b in buckets:
+                scorer.score_batch(requests[:b], bucket_size=b)
+            for cache in scorer.caches.values():
+                cache.hits = cache.misses = cache.evictions = cache.cold = 0
+            _, snap = replay_requests(
+                scorer, requests, bucket_sizes=buckets,
+                model_id="tuning-bench",
+            )
+            snap["xla_compiles"] = scorer.compile_count
+            return snap
+
+        bucket_knob = get_knob("serving.bucket_sizes")
+        cache_knob = get_knob("serving.cache_capacity")
+        default_buckets = tuple(bucket_knob.default)
+        default_cache = int(cache_knob.default) if not _SMOKE else SRV_CACHE
+
+        # --- arm A: knob-registry defaults, recorded into the run ledger so
+        # the analyzer replay has real evidence to tune from
+        default_snap = _replay(default_buckets, default_cache)
+        get_registry().record_serving_snapshot(default_snap)
+        telemetry = summarize_telemetry()
+
+        # --- analyzer replay -> proposal -> tuned candidate (arm B)
+        report = analyze_ledger(telemetry["ledger"])
+        proposal = propose(report)
+        candidates = ab_candidates(proposal, "serve")
+        tuned_cfg = candidates[-1] if len(candidates) > 1 else {}
+        tuned_buckets = default_buckets
+        if "serving.bucket_sizes" in tuned_cfg:
+            tuned_buckets = bucket_knob.parse(tuned_cfg["serving.bucket_sizes"])
+        tuned_cache = default_cache
+        if "serving.cache_capacity" in tuned_cfg:
+            tuned_cache = cache_knob.parse(tuned_cfg["serving.cache_capacity"])
+        tuned_snap = _replay(tuned_buckets, tuned_cache)
+
+        def _arm(snap, buckets, cache_capacity):
+            return {
+                "bucket_sizes": list(buckets),
+                "cache_capacity": cache_capacity,
+                **{
+                    k: snap[k]
+                    for k in (
+                        "latency_p50_s", "latency_p95_s", "latency_p99_s",
+                        "batch_fill_ratio", "cache_hit_rate",
+                        "replay_requests_per_s", "xla_compiles",
+                    )
+                    if k in snap
+                },
+            }
+
+        d_p99 = float(default_snap.get("latency_p99_s", 0.0))
+        t_p99 = float(tuned_snap.get("latency_p99_s", 0.0))
+        payload = {
+            "metric": "tuning_p99_delta_s",
+            "value": round(d_p99 - t_p99, 9),
+            "unit": "seconds_default_minus_tuned",
+            "default": _arm(default_snap, default_buckets, default_cache),
+            "tuned": _arm(tuned_snap, tuned_buckets, tuned_cache),
+            "deltas": {
+                "latency_p99_s": round(t_p99 - d_p99, 9),
+                "requests_per_s": round(
+                    float(tuned_snap.get("replay_requests_per_s", 0.0))
+                    - float(default_snap.get("replay_requests_per_s", 0.0)),
+                    3,
+                ),
+                "xla_compiles": (
+                    int(tuned_snap.get("xla_compiles", 0))
+                    - int(default_snap.get("xla_compiles", 0))
+                ),
+            },
+            "proposal": {
+                "changed": proposal.changed(),
+                "knobs_considered": len(proposal.knobs),
+                "candidates": candidates,
+            },
+            "report_coverage": report.coverage,
+            "num_requests": N_SRV_REQ,
+            "n_entities": N_SRV_ENT,
+            "backend": jax.default_backend(),
+            "telemetry": telemetry,
+        }
+        print(json.dumps(payload))
+        if not _SMOKE or _env_flag("BENCH_TUNING_WRITE"):
+            with open(_TUNING_PATH, "w") as f:
+                json.dump(payload, f, indent=2)
+        _append_history(payload, "tuning")
+    except Exception as e:  # noqa: BLE001 - one JSON line per exit path
+        print(json.dumps({
+            "metric": "tuning_p99_delta_s",
             "error": f"{type(e).__name__}: {e}",
         }))
         sys.exit(1)
@@ -1372,8 +1610,19 @@ def _main():
              "reduction (wall minus solver time), row-transfer counts and "
              "host/device parity, and writes BENCH_CD_SCORES.json",
     )
+    ap.add_argument(
+        "--tuning", action="store_true",
+        help="run the auto-tuning benchmark instead of the training bench: "
+             "replay the serving workload with default knobs under a run "
+             "ledger, feed the ledger through the analyzer + tuner, re-run "
+             "with the proposed config, and write the default-vs-tuned "
+             "deltas to BENCH_TUNING.json",
+    )
     args = ap.parse_args()
 
+    if args.tuning:
+        _tuning_bench()
+        return
     if args.serving:
         _serving_bench()
         return

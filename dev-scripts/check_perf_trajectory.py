@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Perf-trajectory sentinel: fresh smoke bench vs the last-good record.
+
+CI runs a smoke-mode ``bench.py`` (CPU, tiny shapes) and hands its one JSON
+line to this script, which compares the headline value against
+``BENCH_LASTGOOD.json`` with a tolerance band. The point is catching
+order-of-magnitude regressions a unit suite can't see — a retrace storm, an
+accidental sync per batch — NOT chasing benchmark noise, hence:
+
+* the gate only fires when the fresh value is BELOW ``tolerance`` × the
+  reference (default 0.05: a 20x collapse), never on improvements;
+* a host-fingerprint mismatch (CI machine != the machine that measured the
+  reference) downgrades the check to a report and exits 0 — cross-machine
+  absolute numbers are not comparable;
+* a missing reference or unmeasurable fresh run also reports-and-passes:
+  the sentinel must never block a round on infrastructure, only on a
+  measured collapse on comparable hardware.
+
+Usage:
+    python bench.py > /tmp/fresh.json          # BENCH_SMOKE=1 upstream
+    python dev-scripts/check_perf_trajectory.py /tmp/fresh.json \
+        [--reference BENCH_LASTGOOD.json] [--tolerance 0.05] \
+        [--history BENCH_HISTORY.jsonl]
+
+With ``--history`` it also prints the recent trajectory of the fresh
+metric (last 5 matching records) for the CI log, purely informational.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _host_fingerprint() -> str:
+    # must mirror bench.py's fingerprint so equality is meaningful
+    model = "unknown"
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return f"{model} x{os.cpu_count()}"
+
+
+def _last_json_line(path: str):
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path}: empty")
+    return json.loads(lines[-1])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("fresh", help="file holding the fresh bench's JSON line")
+    ap.add_argument(
+        "--reference", default=os.path.join(REPO, "BENCH_LASTGOOD.json"),
+        help="last-good record to compare against (default: repo's)",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.05,
+        help="fail when fresh < tolerance * reference (default 0.05; the "
+             "gate hunts collapses, not noise)",
+    )
+    ap.add_argument(
+        "--history", default=None,
+        help="optional BENCH_HISTORY.jsonl to print the recent trajectory",
+    )
+    ap.add_argument(
+        "--require-same-host", action="store_true",
+        help="fail (rather than skip) on a host-fingerprint mismatch",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        fresh = _last_json_line(args.fresh)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perf-trajectory: cannot read fresh result ({e}); skipping")
+        return 0
+    if fresh.get("error") or not fresh.get("value"):
+        print(
+            "perf-trajectory: fresh run did not measure "
+            f"(error={fresh.get('error')!r}); the bench's own exit code "
+            "already gates this — skipping"
+        )
+        return 0
+
+    if args.history and os.path.exists(args.history):
+        tail = []
+        with open(args.history) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == fresh.get("metric"):
+                    tail.append(rec)
+        for rec in tail[-5:]:
+            print(
+                f"perf-trajectory: history {rec.get('ts')}: "
+                f"{rec.get('metric')}={rec.get('value')} {rec.get('unit')}"
+            )
+
+    if not os.path.exists(args.reference):
+        print(
+            f"perf-trajectory: no reference at {args.reference}; nothing to "
+            "compare — skipping"
+        )
+        return 0
+    with open(args.reference) as f:
+        ref = json.load(f)
+    if ref.get("metric") != fresh.get("metric"):
+        print(
+            f"perf-trajectory: metric mismatch (fresh {fresh.get('metric')!r}"
+            f" vs reference {ref.get('metric')!r}); skipping"
+        )
+        return 0
+    ref_value = ref.get("value")
+    if not ref_value:
+        print("perf-trajectory: reference has no value; skipping")
+        return 0
+
+    host = _host_fingerprint()
+    # only the top-level "host" names the MEASUREMENT machine
+    # (baseline_pin_host is the CPU-baseline pin, typically a different
+    # machine than the accelerator that produced the headline)
+    ref_host = ref.get("host")
+    if ref_host != host:
+        msg = (
+            f"perf-trajectory: host mismatch — reference measured on "
+            f"{ref_host!r}, this is {host!r}; absolute numbers are not "
+            "comparable"
+        )
+        if args.require_same_host:
+            print(msg + " (--require-same-host set)")
+            return 1
+        print(msg + "; skipping the gate")
+        return 0
+
+    ratio = float(fresh["value"]) / float(ref_value)
+    print(
+        f"perf-trajectory: {fresh['metric']} fresh={fresh['value']} vs "
+        f"reference={ref_value} ({ratio:.3f}x, floor {args.tolerance}x)"
+    )
+    if ratio < args.tolerance:
+        print(
+            "perf-trajectory: FAIL — the fresh measurement collapsed below "
+            f"{args.tolerance}x of the last good record on the same host; "
+            "suspect a retrace storm or an accidental per-batch sync"
+        )
+        return 1
+    print("perf-trajectory: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,26 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--input-columns-names", default=None,
                    help="JSON map overriding input field names")
     p.add_argument("--log-file", default=None)
+    p.add_argument("--auto-tune", action="store_true",
+                   help="A/B candidate serving configs on warmup replay "
+                        "traffic (judged by the metrics registry), serve "
+                        "with the winner, and persist it as the artifact's "
+                        "tuned config")
+    p.add_argument("--auto-tune-warmup", type=int, default=256,
+                   help="requests replayed per auto-tune trial (default 256)")
+    p.add_argument("--auto-tune-judge", default="serving.latency_p99_ms",
+                   help="registry metric that judges auto-tune trials, "
+                        "minimized (default serving.latency_p99_ms)")
+    p.add_argument("--introspect-port", type=int, default=None,
+                   help="serve /metrics, /healthz, /varz on this local port "
+                        "while replaying (0 = ephemeral)")
+    p.add_argument("--introspect-port-file", default=None,
+                   help="write the bound introspection port to this file "
+                        "(useful with --introspect-port 0)")
+    p.add_argument("--introspect-hold", type=float, default=0.0,
+                   help="after the replay, keep the introspection endpoints "
+                        "up for this many seconds (or until "
+                        "/quitquitquit is hit)")
     add_telemetry_args(p)
     return p.parse_args(argv)
 
@@ -124,6 +144,119 @@ def _load_or_pack(args, logger, timer):
     return artifact
 
 
+def _effective_config(args, artifact, logger) -> dict:
+    """Resolve the serving config the replay will actually use.
+
+    Explicit CLI flags always win; flags left at their defaults fall back
+    to the artifact's ``tuned_config`` (a previous --auto-tune winner) and
+    finally to the built-in defaults — the "boots tuned" path. Returns the
+    /varz-ready dict of active values."""
+    tuned = dict(artifact.tuned_config or {})
+    bucket_sizes = tuple(
+        int(b) for b in str(args.bucket_sizes).split(",") if b.strip()
+    )
+    cache_capacity = args.cache_capacity
+    max_nnz = args.max_nnz
+    applied = {}
+    if tuned:
+        if args.bucket_sizes == DEFAULT_BUCKETS and "serving.bucket_sizes" in tuned:
+            bucket_sizes = tuple(int(b) for b in tuned["serving.bucket_sizes"])
+            applied["serving.bucket_sizes"] = list(bucket_sizes)
+        if cache_capacity is None and tuned.get("serving.cache_capacity"):
+            cache_capacity = int(tuned["serving.cache_capacity"])
+            applied["serving.cache_capacity"] = cache_capacity
+        if max_nnz is None and tuned.get("serving.max_nnz"):
+            max_nnz = int(tuned["serving.max_nnz"])
+            applied["serving.max_nnz"] = max_nnz
+        if applied:
+            logger.info("booting with tuned config: %s", applied)
+    return {
+        "bucket_sizes": list(bucket_sizes),
+        "cache_capacity": cache_capacity,
+        "max_nnz": max_nnz,
+        "tuned": bool(applied),
+        "tuned_config": tuned or None,
+        "tuned_applied": applied or None,
+    }
+
+
+def _auto_tune_serving(args, artifact, requests, active, logger):
+    """Warmup-replay A/B over the serve-side knob space.
+
+    A baseline warmup replay produces the evidence (its metrics snapshot,
+    replayed through ``analyze_records`` into a RunReport); the tuner
+    proposes candidates; each candidate replays the same warmup slice
+    against a fresh scorer and a FRESH MetricsRegistry, judged by
+    ``--auto-tune-judge``. Returns (winner_knob_values, ab_result_dict)."""
+    import time as _time
+
+    from photon_ml_tpu.serving import GameScorer, ServingMetrics, replay_requests
+    from photon_ml_tpu.serving.replay import max_nnz_of
+    from photon_ml_tpu.telemetry.analyze import analyze_records
+    from photon_ml_tpu.tuning import ab_candidates, get_knob, propose, run_ab_trials
+
+    warmup = requests[: max(1, min(args.auto_tune_warmup, len(requests)))]
+    default_nnz = max_nnz_of(requests)
+
+    def _replay_with(config, registry):
+        buckets = get_knob("serving.bucket_sizes").parse(
+            config.get("serving.bucket_sizes") or active["bucket_sizes"]
+        )
+        nnz = int(config.get("serving.max_nnz") or 0) or (
+            active["max_nnz"] or default_nnz
+        )
+        cache = config.get("serving.cache_capacity") or active["cache_capacity"]
+        scorer = GameScorer(
+            artifact,
+            max_nnz=nnz,
+            cache_capacity=int(cache) if cache else None,
+        )
+        metrics = ServingMetrics()
+        _, snap = replay_requests(
+            scorer, warmup, bucket_sizes=buckets, metrics=metrics
+        )
+        registry.record_serving_snapshot(snap)
+
+    # evidence pass: the control config IS the baseline trial; wrap its
+    # snapshot in a minimal ledger so the tuner sees a real RunReport
+    from photon_ml_tpu.telemetry.metrics import MetricsRegistry
+
+    baseline_registry = MetricsRegistry()
+    t0 = _time.time()
+    _replay_with({}, baseline_registry)
+    t1 = _time.time()
+    report = analyze_records(
+        [
+            {"type": "meta", "ts": t0, "phase": "start", "label": "serve-warmup"},
+            {"type": "metrics", "ts": t1, "snapshot": baseline_registry.snapshot()},
+            {"type": "meta", "ts": t1, "phase": "finish"},
+        ],
+        source_path=None,
+    )
+    proposal = propose(report)
+    candidates = ab_candidates(proposal, "serve")
+    logger.info(
+        "auto-tune: %d warmup requests, %d candidate config(s)",
+        len(warmup), len(candidates),
+    )
+    result = run_ab_trials(
+        candidates,
+        _replay_with,
+        judge_metric=args.auto_tune_judge,
+        minimize=True,
+        logger=logger,
+    )
+    winner = result.winner
+    logger.info(
+        "auto-tune winner: trial %d %s=%s config=%s",
+        winner.index,
+        args.auto_tune_judge,
+        f"{winner.score:.6g}" if winner.score is not None else "n/a",
+        winner.config,
+    )
+    return dict(winner.config), result.to_dict()
+
+
 def run(args: argparse.Namespace) -> Optional[dict]:
     from photon_ml_tpu.event import EventEmitter
 
@@ -143,12 +276,11 @@ def run(args: argparse.Namespace) -> Optional[dict]:
 
 
 def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
-    bucket_sizes = tuple(
-        int(b) for b in str(args.bucket_sizes).split(",") if b.strip()
-    )
-
     artifact = _load_or_pack(args, logger, timer)
     model_id = args.model_id or artifact.model_name
+    active = _effective_config(args, artifact, logger)
+    active["model_id"] = model_id
+    bucket_sizes = tuple(active["bucket_sizes"])
 
     if args.export_artifact_dir:
         from photon_ml_tpu.serving import save_artifact
@@ -157,6 +289,54 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
             save_artifact(artifact, args.export_artifact_dir)
         logger.info("exported serving artifact to %s", args.export_artifact_dir)
 
+    state = {"manager": None, "phase": "starting"}
+    introspect = None
+    if args.introspect_port is not None:
+        from photon_ml_tpu.serving import IntrospectionServer
+
+        def _health():
+            manager = state["manager"]
+            doc = {
+                "healthy": True,
+                "phase": state["phase"],
+                "model_id": model_id,
+                "watching_deltas": bool(args.watch_deltas),
+            }
+            if manager is not None:
+                doc["swap_generation"] = manager.generation
+            return doc
+
+        introspect = IntrospectionServer(
+            varz=lambda: dict(active),
+            health=_health,
+            port=args.introspect_port,
+        ).start()
+        logger.info("introspection endpoints on 127.0.0.1:%d", introspect.port)
+        if args.introspect_port_file:
+            with open(args.introspect_port_file, "w") as f:
+                f.write(str(introspect.port))
+    try:
+        snapshot = _serve_stream(
+            args, logger, timer, emitter, artifact, model_id, active,
+            bucket_sizes, state,
+        )
+        state["phase"] = "drained"
+        if introspect is not None and args.introspect_hold > 0:
+            logger.info(
+                "holding introspection endpoints for %.1fs (POST "
+                "/quitquitquit to release)", args.introspect_hold,
+            )
+            introspect.wait_quit(args.introspect_hold)
+        return snapshot
+    finally:
+        if introspect is not None:
+            introspect.stop()
+
+
+def _serve_stream(
+    args, logger, timer, emitter, artifact, model_id, active, bucket_sizes,
+    state,
+) -> Optional[dict]:
     snapshot: Optional[dict] = None
     if args.data_dirs:
         from photon_ml_tpu.io.data_reader import (
@@ -206,10 +386,45 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
             )
         logger.info("replaying %d requests", len(requests))
 
+        ab_result = None
+        if args.auto_tune:
+            state["phase"] = "auto-tune"
+            with timer.time("auto-tune"):
+                winner, ab_result = _auto_tune_serving(
+                    args, artifact, requests, active, logger
+                )
+            tuned_now = {k: v for k, v in winner.items() if v}
+            if "serving.bucket_sizes" in winner:
+                bucket_sizes = tuple(int(b) for b in winner["serving.bucket_sizes"])
+                active["bucket_sizes"] = list(bucket_sizes)
+            if winner.get("serving.cache_capacity"):
+                active["cache_capacity"] = int(winner["serving.cache_capacity"])
+            if winner.get("serving.max_nnz"):
+                active["max_nnz"] = int(winner["serving.max_nnz"])
+            active["tuned"] = True
+            active["tuned_config"] = {
+                k: (list(v) if isinstance(v, tuple) else v)
+                for k, v in tuned_now.items()
+            }
+            from photon_ml_tpu.serving import save_tuned_config
+
+            provenance = {
+                "source": "serve_game --auto-tune",
+                "judge_metric": args.auto_tune_judge,
+                "warmup_requests": int(args.auto_tune_warmup),
+            }
+            for target in (args.artifact_dir, args.export_artifact_dir):
+                if target:
+                    path = save_tuned_config(
+                        target, active["tuned_config"], provenance=provenance
+                    )
+                    logger.info("persisted tuned config to %s", path)
+
+        state["phase"] = "replaying"
         scorer = GameScorer(
             artifact,
-            max_nnz=args.max_nnz if args.max_nnz else max_nnz_of(requests),
-            cache_capacity=args.cache_capacity,
+            max_nnz=active["max_nnz"] if active["max_nnz"] else max_nnz_of(requests),
+            cache_capacity=active["cache_capacity"],
             growth_headroom=bool(args.watch_deltas),
         )
         from photon_ml_tpu.serving import ServingMetrics
@@ -230,6 +445,7 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
                 emitter=emitter,
                 model_id=model_id,
             )
+            state["manager"] = manager
             logger.info(
                 "watching %s for delta artifacts (poll every %d requests)",
                 args.watch_deltas, args.watch_chunk,
@@ -254,6 +470,13 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
 
         snapshot["model_id"] = model_id
         snapshot["bucket_sizes"] = list(bucket_sizes)
+        if ab_result is not None:
+            snapshot["auto_tune"] = ab_result
+        # fold the final serving snapshot into the process registry so the
+        # /metrics endpoint reflects the replay even without --telemetry-out
+        from photon_ml_tpu.telemetry.metrics import get_registry
+
+        get_registry().record_serving_snapshot(snapshot)
         if args.metrics_output:
             with open(args.metrics_output, "w") as f:
                 json.dump(snapshot, f, indent=2)

@@ -162,6 +162,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--profile-dir", default=None,
                    help="write a jax profiler trace of the fit phase here "
                         "(view with TensorBoard / xprof)")
+    p.add_argument("--auto-tune", action="store_true",
+                   help="A/B adaptive-RE solver configs on a 1-outer-"
+                        "iteration trial fit before the real fit (judged by "
+                        "the metrics registry); the winner trains the model "
+                        "and is saved as the metadata's tuned_config")
+    p.add_argument("--auto-tune-trials", type=int, default=2,
+                   help="candidate configs trialed besides the incumbent "
+                        "(default 2)")
+    p.add_argument("--auto-tune-judge", default="autotune.wall_s",
+                   help="registry metric that judges auto-tune trials, "
+                        "minimized (default autotune.wall_s = trial "
+                        "wall-clock)")
+    p.add_argument("--auto-tune-report", default=None,
+                   help="RunReport JSON from analyze_run; when given, trial "
+                        "candidates come from the offline tuner's proposal "
+                        "instead of ladder neighbors")
     p.add_argument("--log-file", default=None)
     add_telemetry_args(p)
     args = p.parse_args(argv)
@@ -193,6 +209,120 @@ def _sweep_model_configs(sweeps, coordinates):
         }
         for combo in itertools.product(*(sweeps[cid] for cid in ids))
     ]
+
+
+def _apply_adaptive_knobs(coordinates: dict, knobs: dict) -> dict:
+    """Return ``coordinates`` with the adaptive-RE knob values folded into
+    every optimizer that carries an AdaptiveSolveConfig (frozen dataclasses
+    throughout, so this is replace(), never mutation — the originals stay
+    usable as the A/B control)."""
+    out = {}
+    for cid, cfg in coordinates.items():
+        opt = getattr(cfg, "optimizer", None)
+        adaptive = getattr(opt, "adaptive", None) if opt is not None else None
+        if adaptive is None:
+            out[cid] = cfg
+            continue
+        new_adaptive = dataclasses.replace(
+            adaptive,
+            chunk_iters=int(
+                knobs.get("adaptive.chunk_iters", adaptive.chunk_iters)
+            ),
+            min_lanes=int(knobs.get("adaptive.min_lanes", adaptive.min_lanes)),
+        )
+        out[cid] = dataclasses.replace(
+            cfg, optimizer=dataclasses.replace(opt, adaptive=new_adaptive)
+        )
+    return out
+
+
+def _auto_tune_training(args, logger, estimator_kwargs, coordinates, data):
+    """Iteration-0 A/B over the adaptive-RE knob space.
+
+    Each candidate runs a 1-outer-iteration fit with its knob values and a
+    FRESH MetricsRegistry fed by a trial-local emitter (trial A's solver
+    counters cannot leak into trial B's judgment, and none of it pollutes
+    the surrounding run's telemetry). Judged by ``--auto-tune-judge``
+    (default: trial wall-clock). Returns (winner_knobs, ab_result_dict) —
+    winner_knobs is {} when the incumbent wins."""
+    from photon_ml_tpu.event import EventEmitter
+    from photon_ml_tpu.telemetry.sinks import TelemetryEventListener
+    from photon_ml_tpu.tuning import get_knob, run_ab_trials
+
+    spec = get_knob("adaptive.chunk_iters")
+    incumbent = None
+    for cfg in coordinates.values():
+        adaptive = getattr(getattr(cfg, "optimizer", None), "adaptive", None)
+        if adaptive is not None:
+            incumbent = {
+                "adaptive.chunk_iters": adaptive.chunk_iters,
+                "adaptive.min_lanes": adaptive.min_lanes,
+            }
+            break
+    if incumbent is None:
+        logger.info("auto-tune: no adaptive-RE coordinate; nothing to tune")
+        return {}, None
+
+    candidates = [dict(incumbent)]
+    if args.auto_tune_report:
+        from photon_ml_tpu.telemetry.analyze import RunReport
+        from photon_ml_tpu.tuning import ab_candidates, propose
+
+        with open(args.auto_tune_report, "r", encoding="utf-8") as f:
+            report = RunReport.from_dict(json.load(f))
+        for cand in ab_candidates(propose(report), "train")[1:]:
+            knobs = {
+                k: v for k, v in cand.items() if k.startswith("adaptive.")
+            }
+            if knobs and knobs != incumbent:
+                candidates.append({**incumbent, **knobs})
+    else:
+        ladder = list(spec.candidates)
+        cur = incumbent["adaptive.chunk_iters"]
+        for alt in sorted(ladder, key=lambda v: abs(v - cur)):
+            if alt != cur:
+                candidates.append(
+                    {**incumbent, "adaptive.chunk_iters": alt}
+                )
+    candidates = candidates[: 1 + max(0, args.auto_tune_trials)]
+
+    def _trial(knobs, registry):
+        trial_emitter = EventEmitter()
+        trial_emitter.register_listener(
+            TelemetryEventListener(ledger=None, registry=registry)
+        )
+        try:
+            trial = GameEstimator(
+                coordinates=_apply_adaptive_knobs(coordinates, knobs),
+                emitter=trial_emitter,
+                **{**estimator_kwargs, "num_outer_iterations": 1},
+            )
+            trial.fit(data, validation_data=None)
+        finally:
+            trial_emitter.clear_listeners()
+
+    logger.info(
+        "auto-tune: %d candidate config(s) over 1-outer-iteration trials",
+        len(candidates),
+    )
+    result = run_ab_trials(
+        candidates,
+        _trial,
+        judge_metric=args.auto_tune_judge,
+        minimize=True,
+        logger=logger,
+    )
+    winner = result.winner
+    logger.info(
+        "auto-tune winner: trial %d %s=%s config=%s",
+        winner.index,
+        args.auto_tune_judge,
+        f"{winner.score:.6g}" if winner.score is not None else "n/a",
+        winner.config,
+    )
+    if winner.index == 0:
+        return {}, result.to_dict()
+    return dict(winner.config), result.to_dict()
 
 
 def _make_evaluator(spec: Optional[str], task: TaskType, data):
@@ -441,22 +571,41 @@ def run(args: argparse.Namespace) -> GameFit:
                 n_feat=args.parallel_feat,
                 engine=args.parallel_engine,
             )
-        estimator = GameEstimator(
+        estimator_kwargs = dict(
             task=task,
-            coordinates=coordinates,
             update_order=update_order,
             num_outer_iterations=(
                 args.num_outer_iterations
                 if args.num_outer_iterations is not None
                 else int(raw_config.get("num_outer_iterations", 1))
             ),
-            evaluator=evaluator,
-            extra_evaluators=extra_evaluators,
             normalization=normalization,
             intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
             parallel=parallel,
-            compute_variance=args.compute_variance,
+            compute_variance=False,  # trials skip variances; the real fit below opts in
+        )
+
+        tuned_config: Dict[str, object] = {}
+        if args.auto_tune:
+            with timer.time("auto-tune"):
+                tuned_config, ab_result = _auto_tune_training(
+                    args, logger, estimator_kwargs, coordinates, data
+                )
+            if tuned_config:
+                coordinates = _apply_adaptive_knobs(coordinates, tuned_config)
+            if ab_result is not None:
+                os.makedirs(args.output_dir, exist_ok=True)
+                with open(
+                    os.path.join(args.output_dir, "auto-tune.json"), "w"
+                ) as f:
+                    json.dump(ab_result, f, indent=2, sort_keys=True)
+
+        estimator = GameEstimator(
+            coordinates=coordinates,
+            evaluator=evaluator,
+            extra_evaluators=extra_evaluators,
             emitter=emitter,
+            **{**estimator_kwargs, "compute_variance": args.compute_variance},
         )
 
         emitter.send_event(TrainingStartEvent(task=task.name))
@@ -496,6 +645,16 @@ def run(args: argparse.Namespace) -> GameFit:
                     )
                     m_cfg.pop("regularization_weights", None)
                     m_cfg["regularization_weight"] = matrix.regularization_weight
+            return cfg
+
+        def _final_config(overrides) -> dict:
+            """_config_with_overrides plus the --auto-tune winner, so the
+            saved metadata records exactly what trained the model and the
+            pack flow carries the tuned config into the serving artifact."""
+            cfg = _config_with_overrides(overrides)
+            if tuned_config:
+                cfg = dict(cfg)
+                cfg["tuned_config"] = dict(tuned_config)
             return cfg
 
         fit_overrides: Dict[str, object] = {}  # the winning config's map
@@ -617,7 +776,7 @@ def run(args: argparse.Namespace) -> GameFit:
                     os.path.join(args.output_dir, "best"),
                     index_maps=index_maps,
                     model_name=args.model_name,
-                    configurations=_config_with_overrides(best_overrides),
+                    configurations=_final_config(best_overrides),
                     num_output_files_per_random_effect=(
                         args.num_output_files_for_random_effect_model
                     ),
@@ -634,7 +793,7 @@ def run(args: argparse.Namespace) -> GameFit:
                             os.path.join(args.output_dir, "all", str(i)),
                             index_maps=index_maps,
                             model_name=args.model_name,
-                            configurations=_config_with_overrides(ovr),
+                            configurations=_final_config(ovr),
                             num_output_files_per_random_effect=(
                                 args.num_output_files_for_random_effect_model
                             ),

@@ -40,16 +40,27 @@ DELTA_DIR_PREFIX = "delta-"
 _ROWS_FILE = "rows.npy"
 
 
-def fingerprint_dir(path: str) -> str:
+# Top-level files that never count toward an artifact's content identity.
+# tuned-config.json is a serve-side sidecar (--auto-tune winner): writing it
+# next to a live artifact must not orphan the delta chain anchored on the
+# artifact's fingerprint.
+FINGERPRINT_EXCLUDE = ("tuned-config.json",)
+
+
+def fingerprint_dir(path: str, exclude: Tuple[str, ...] = FINGERPRINT_EXCLUDE) -> str:
     """Content fingerprint of a directory tree: sha256 over every file's
     relative path and bytes, in sorted path order. Any byte change — or a
-    file added/removed — changes the fingerprint."""
+    file added/removed — changes the fingerprint (except top-level names in
+    ``exclude``, which are advisory sidecars, not model content)."""
     h = hashlib.sha256()
     files = []
     for root, _, names in os.walk(path):
         for name in names:
             full = os.path.join(root, name)
-            files.append((os.path.relpath(full, path), full))
+            rel = os.path.relpath(full, path)
+            if rel in exclude:
+                continue
+            files.append((rel, full))
     for rel, full in sorted(files):
         h.update(rel.encode("utf-8"))
         h.update(b"\0")

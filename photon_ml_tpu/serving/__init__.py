@@ -33,9 +33,12 @@ from photon_ml_tpu.serving.artifact import (
     ServingArtifact,
     ServingTable,
     load_artifact,
+    load_tuned_config,
     pack_game_model,
     save_artifact,
+    save_tuned_config,
 )
+from photon_ml_tpu.serving.introspect import IntrospectionServer, prometheus_text
 from photon_ml_tpu.serving.batcher import MicroBatcher
 from photon_ml_tpu.serving.cache import HotEntityCache
 from photon_ml_tpu.serving.hotswap import (
@@ -59,9 +62,13 @@ __all__ = [
     "ServingTable",
     "SwapReport",
     "ValidationGate",
+    "IntrospectionServer",
     "load_artifact",
+    "load_tuned_config",
     "pack_game_model",
+    "prometheus_text",
     "replay_requests",
     "requests_from_game_data",
     "save_artifact",
+    "save_tuned_config",
 ]

@@ -58,6 +58,10 @@ ENTITY_INDEX_DIR = "entity-index"
 FEATURE_INDEX_DIR = "feature-index"
 TABLE_FILE = "table.npy"
 SERVING_FORMAT_VERSION = 1
+# Serve-side tuning sidecar. Kept OUTSIDE model-metadata.json so a running
+# --auto-tune can persist a winner without rewriting the model manifest,
+# and excluded from fingerprint_dir so delta chains stay valid across it.
+TUNED_CONFIG_FILE = "tuned-config.json"
 
 
 @dataclasses.dataclass
@@ -92,6 +96,11 @@ class ServingArtifact:
     # score CLI does
     configurations: Dict[str, object] = dataclasses.field(default_factory=dict)
     feature_index: Dict[str, IndexMap] = dataclasses.field(default_factory=dict)
+    # winning knob values from --auto-tune (knob name -> value); None when
+    # the artifact has never been tuned. Persisted in the metadata's
+    # "tuned_config" section at pack time and overridable post-hoc by the
+    # tuned-config.json sidecar (see save_tuned_config).
+    tuned_config: Optional[Dict[str, object]] = None
 
     def entity_row(self, cid: str, entity_id: str) -> int:
         """Table row of an entity in one RE coordinate; -1 when cold/unknown
@@ -173,12 +182,18 @@ def pack_game_model(
             raise ValueError(
                 f"cannot pack sub-model type {type(sub).__name__} for {cid}"
             )
+    configurations = dict(configurations or {})
+    # a train-side --auto-tune winner rides along in the model metadata;
+    # lift it into the artifact field so direct --model-dir serving boots
+    # tuned exactly like artifact-dir serving
+    tuned = configurations.pop("tuned_config", None)
     return ServingArtifact(
         task=model.task,
         tables=tables,
         model_name=model_name,
-        configurations=dict(configurations or {}),
+        configurations=configurations,
         feature_index=dict(index_maps or {}),
+        tuned_config=tuned,
     )
 
 
@@ -315,6 +330,8 @@ def _write_artifact_contents(artifact: ServingArtifact, output_dir: str) -> None
         )
     configurations = dict(artifact.configurations)
     configurations["serving"] = serving
+    if artifact.tuned_config:
+        configurations["tuned_config"] = dict(artifact.tuned_config)
     save_game_model_metadata(
         output_dir, artifact.task,
         model_name=artifact.model_name,
@@ -369,10 +386,67 @@ def load_artifact(artifact_dir: str, mmap: bool = True) -> ServingArtifact:
     if os.path.isdir(fdir):
         for shard in sorted(os.listdir(fdir)):
             feature_index[shard] = OffHeapIndexMap(os.path.join(fdir, shard))
+    # tuned config: sidecar (serve-side --auto-tune) overrides the metadata
+    # section (train-side --auto-tune carried through the pack flow)
+    tuned = configurations.pop("tuned_config", None)
+    sidecar = load_tuned_config(artifact_dir)
+    if sidecar is not None:
+        tuned = sidecar
     return ServingArtifact(
         task=task,
         tables=tables,
         model_name=metadata.get("modelName", "game-model"),
         configurations=configurations,
         feature_index=feature_index,
+        tuned_config=tuned,
     )
+
+
+def save_tuned_config(
+    artifact_dir: str,
+    tuned_config: Dict[str, object],
+    provenance: Optional[Dict[str, object]] = None,
+) -> str:
+    """Atomically persist an --auto-tune winner next to an artifact.
+
+    Written as the ``tuned-config.json`` sidecar (tmp file + fsync +
+    rename) so a live artifact directory is never rewritten and a hot-swap
+    watcher can't observe a half-written manifest. The sidecar is excluded
+    from :func:`photon_ml_tpu.incremental.delta.fingerprint_dir`, so
+    writing it does not invalidate an existing delta chain."""
+    import tempfile
+
+    doc: Dict[str, object] = {"tuned_config": dict(tuned_config)}
+    if provenance:
+        doc["provenance"] = dict(provenance)
+    target = os.path.join(artifact_dir, TUNED_CONFIG_FILE)
+    fd, tmp = tempfile.mkstemp(
+        prefix=".tuned-config-", suffix=".json", dir=artifact_dir
+    )
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return target
+
+
+def load_tuned_config(artifact_dir: str) -> Optional[Dict[str, object]]:
+    """Read the tuned-config sidecar; None when the artifact is untuned."""
+    path = os.path.join(artifact_dir, TUNED_CONFIG_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    tuned = doc.get("tuned_config")
+    if not isinstance(tuned, dict):
+        raise ValueError(f"{path}: missing 'tuned_config' object")
+    return tuned

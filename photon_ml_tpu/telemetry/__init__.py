@@ -41,7 +41,18 @@ from photon_ml_tpu.telemetry.sinks import (
     write_chrome_trace,
 )
 from photon_ml_tpu.telemetry.session import TelemetryRun, start_run
-from photon_ml_tpu.telemetry.validate import validate_chrome_trace, validate_ledger
+from photon_ml_tpu.telemetry.validate import (
+    TruncatedLedgerWarning,
+    validate_chrome_trace,
+    validate_ledger,
+)
+from photon_ml_tpu.telemetry.analyze import (
+    RunReport,
+    analyze_ledger,
+    analyze_records,
+    classify_span,
+    format_report,
+)
 
 __all__ = [
     "NOOP_SPAN",
@@ -64,6 +75,12 @@ __all__ = [
     "write_chrome_trace",
     "TelemetryRun",
     "start_run",
+    "TruncatedLedgerWarning",
     "validate_chrome_trace",
     "validate_ledger",
+    "RunReport",
+    "analyze_ledger",
+    "analyze_records",
+    "classify_span",
+    "format_report",
 ]

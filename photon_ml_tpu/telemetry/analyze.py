@@ -1,0 +1,428 @@
+"""Ledger-replay performance analyzer: RunLedger → occupancy report.
+
+PR 5 made every hot path write telemetry; this module reads it back. From
+one JSONL :class:`~photon_ml_tpu.telemetry.sinks.RunLedger` it
+
+* reconstructs the span tree (``span_id``/``parent_id`` chains),
+* computes per-phase occupancy — wall-clock attributed to FE solves, RE
+  chunked rounds, CD driver algebra, serving, incremental updates, I/O —
+  from per-span **exclusive** time (duration minus direct children, so
+  nothing is double-counted),
+* accounts the **bubbles**: driver-thread gaps where no span was open are
+  attributed explicitly as host driver time, so the report sums to the
+  measured wall-clock instead of silently dropping it,
+* joins in the SolverStats / TransferStats events, jit retrace counters
+  and the metrics-registry snapshot, and
+* emits a structured :class:`RunReport` (JSON-ready) plus a human-readable
+  table via :func:`format_report`.
+
+The occupancy accounting is the Snap-ML-style per-level breakdown (arxiv
+1803.06333) that the offline tuner (:mod:`photon_ml_tpu.tuning`) consumes
+to propose configs over the declared knob space. CLI:
+``python -m photon_ml_tpu.cli.analyze_run LEDGER.jsonl``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from photon_ml_tpu.telemetry.validate import validate_ledger
+
+__all__ = [
+    "RunReport",
+    "analyze_ledger",
+    "analyze_records",
+    "classify_span",
+    "format_report",
+    "PHASES",
+]
+
+# Canonical phase buckets, in report order. Span NAMES (not paths — paths
+# concatenate parent names) map onto these; see classify_span.
+PHASES = (
+    "fe_solve",      # fixed-effect GLM solves (fe/*)
+    "re_solve",      # random-effect chunked rounds / bucket solves (re/*)
+    "cd_driver",     # coordinate-descent driver algebra (cd/*)
+    "serving",       # online scoring path (serve/*)
+    "incremental",   # nearline update path (incremental/*)
+    "transfers",     # explicit host<->device transfer spans
+    "io",            # data read / model save / artifact pack phases
+    "host_driver",   # everything else: Python glue, setup, graph build
+)
+
+_IO_WORDS = (
+    "read", "load", "save", "write", "export", "pack",
+    "prepare feature maps", "build requests", "feature stats", "check data",
+)
+
+
+def classify_span(name: str) -> str:
+    """Span name → phase bucket. Uses the name (the span's own identity),
+    not the path, so nesting never reclassifies a child."""
+    head = name.split("/", 1)[0]
+    if head == "fe":
+        return "fe_solve"
+    if head == "re":
+        return "re_solve"
+    if head == "cd":
+        return "cd_driver"
+    if head == "serve":
+        return "serving"
+    if head == "incremental":
+        return "incremental"
+    low = name.lower()
+    if "transfer" in low or "h2d" in low or "d2h" in low:
+        return "transfers"
+    if any(low.startswith(w) or f" {w}" in low for w in _IO_WORDS):
+        return "io"
+    return "host_driver"
+
+
+@dataclasses.dataclass
+class RunReport:
+    """Structured result of replaying one run ledger.
+
+    ``phases`` maps each phase bucket to ``{"seconds", "spans",
+    "fraction"}`` where seconds are exclusive span time. ``bubble_s`` is
+    wall-clock inside the run window covered by NO span (host driver gaps
+    between instrumented regions) — it is attributed, not dropped, so
+    ``attributed_s = Σ phases + bubble_s`` and ``coverage =
+    attributed_s / wall_clock_s`` should sit near 1.0; a value much below
+    1 means uninstrumented time, much above 1 means concurrent span trees
+    double-counting against a single wall-clock.
+    """
+
+    label: str
+    source_path: Optional[str]
+    wall_clock_s: float
+    span_extent_s: float
+    phases: Dict[str, Dict[str, float]]
+    bubble_s: float
+    attributed_s: float
+    coverage: float
+    num_spans: int
+    failed_spans: int
+    top_spans: Dict[str, Dict[str, Any]]
+    solver: Dict[str, Any]
+    transfers: Dict[str, float]
+    jit_traces: Dict[str, int]
+    events: Dict[str, int]
+    metrics: Dict[str, Any]
+    warnings: List[str] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RunReport":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    # convenience readers used by the tuner ------------------------------
+    def phase_seconds(self, phase: str) -> float:
+        return float(self.phases.get(phase, {}).get("seconds", 0.0))
+
+    def phase_fraction(self, phase: str) -> float:
+        return float(self.phases.get(phase, {}).get("fraction", 0.0))
+
+    def metric(self, name: str) -> Optional[float]:
+        """Look a flat metric name up across the snapshot's counters,
+        gauges (last value) and histograms (mean), in that order."""
+        snap = self.metrics or {}
+        counters = snap.get("counters") or {}
+        if name in counters:
+            return float(counters[name])
+        gauges = snap.get("gauges") or {}
+        if name in gauges:
+            return float(gauges[name]["last"])
+        hists = snap.get("histograms") or {}
+        if name in hists:
+            return float(hists[name].get("mean", 0.0))
+        return None
+
+
+def _merged_coverage(intervals: Sequence[Tuple[float, float]]) -> float:
+    """Total length of the union of [start, end) intervals."""
+    total = 0.0
+    last_end = None
+    for start, end in sorted(intervals):
+        if last_end is None or start > last_end:
+            total += max(0.0, end - start)
+            last_end = end
+        elif end > last_end:
+            total += end - last_end
+            last_end = end
+    return total
+
+
+def _span_tree_summary(spans: List[dict], max_depth: int = 2) -> Dict[str, dict]:
+    """span_tree_summary over ledger span dicts (depth reconstructed from
+    the path, which encodes the ancestor chain)."""
+    out: Dict[str, dict] = {}
+    for rec in spans:
+        path = rec.get("path", rec["name"])
+        # depth = nesting level in the span tree: count ancestors via
+        # parent links is not possible per-path, so approximate from how
+        # many recorded spans prefix this one; cheap proxy: parent chain
+        if rec.get("_depth", 1) > max_depth:
+            continue
+        entry = out.setdefault(
+            path,
+            {"count": 0, "total_s": 0.0, "mean_s": 0.0, "max_s": 0.0, "failed": 0},
+        )
+        entry["count"] += 1
+        entry["total_s"] += float(rec.get("duration_s", 0.0))
+        entry["max_s"] = max(entry["max_s"], float(rec.get("duration_s", 0.0)))
+        entry["failed"] += int(bool(rec.get("failed")))
+    for entry in out.values():
+        entry["mean_s"] = entry["total_s"] / entry["count"]
+    return dict(sorted(out.items()))
+
+
+def analyze_records(
+    records: Sequence[Dict[str, Any]],
+    source_path: Optional[str] = None,
+) -> RunReport:
+    """Build a :class:`RunReport` from parsed ledger records (the output of
+    :func:`photon_ml_tpu.telemetry.validate.validate_ledger`)."""
+    warnings: List[str] = []
+    spans = [r for r in records if r.get("type") == "span"]
+    metas = [r for r in records if r.get("type") == "meta"]
+    events = [r for r in records if r.get("type") == "event"]
+    metric_recs = [r for r in records if r.get("type") == "metrics"]
+
+    label = next(
+        (m.get("label", "run") for m in metas if m.get("phase") == "start"),
+        "run",
+    )
+    start_ts = next(
+        (float(m["ts"]) for m in metas if m.get("phase") == "start"), None
+    )
+    finish_ts = next(
+        (float(m["ts"]) for m in metas if m.get("phase") == "finish"), None
+    )
+
+    # ---- span tree reconstruction --------------------------------------
+    by_id: Dict[int, dict] = {}
+    children_dur: Dict[int, float] = {}
+    for rec in spans:
+        sid = rec.get("span_id")
+        if sid is not None:
+            by_id[int(sid)] = rec
+    for rec in spans:
+        pid = rec.get("parent_id")
+        if pid is not None:
+            children_dur[int(pid)] = children_dur.get(int(pid), 0.0) + float(
+                rec.get("duration_s", 0.0)
+            )
+    # depth for the top-span table: walk parent links
+    for rec in spans:
+        depth, pid = 1, rec.get("parent_id")
+        while pid is not None and int(pid) in by_id and depth < 64:
+            depth += 1
+            pid = by_id[int(pid)].get("parent_id")
+        rec["_depth"] = depth
+
+    # ---- window and wall-clock -----------------------------------------
+    starts = [float(r["start_unix"]) for r in spans if "start_unix" in r]
+    ends = [
+        float(r["start_unix"]) + float(r.get("duration_s", 0.0))
+        for r in spans
+        if "start_unix" in r
+    ]
+    span_extent = (max(ends) - min(starts)) if starts else 0.0
+    if start_ts is not None and finish_ts is not None:
+        wall = max(0.0, finish_ts - start_ts)
+    elif start_ts is not None and ends:
+        wall = max(0.0, max(ends) - start_ts)
+        warnings.append(
+            "no finish record (crash-truncated run?); wall-clock measured "
+            "to the last span end"
+        )
+    else:
+        wall = span_extent
+        if start_ts is None:
+            warnings.append("no start record; wall-clock is the span extent")
+
+    # ---- per-phase exclusive occupancy ---------------------------------
+    phases: Dict[str, Dict[str, float]] = {
+        p: {"seconds": 0.0, "spans": 0, "fraction": 0.0} for p in PHASES
+    }
+    failed = 0
+    for rec in spans:
+        dur = float(rec.get("duration_s", 0.0))
+        sid = rec.get("span_id")
+        child = children_dur.get(int(sid), 0.0) if sid is not None else 0.0
+        exclusive = max(0.0, dur - child)
+        bucket = phases[classify_span(str(rec.get("name", "")))]
+        bucket["seconds"] += exclusive
+        bucket["spans"] += 1
+        failed += int(bool(rec.get("failed")))
+
+    # ---- bubble accounting ---------------------------------------------
+    # gaps inside the run window covered by NO root span = host driver
+    # time between instrumented regions (plus pre-first-span setup)
+    root_intervals = []
+    window_start = start_ts if start_ts is not None else (min(starts) if starts else 0.0)
+    window_end = window_start + wall
+    for rec in spans:
+        if rec.get("parent_id") is None and "start_unix" in rec:
+            s = max(window_start, float(rec["start_unix"]))
+            e = min(window_end, float(rec["start_unix"]) + float(rec.get("duration_s", 0.0)))
+            if e > s:
+                root_intervals.append((s, e))
+    covered = _merged_coverage(root_intervals)
+    bubble = max(0.0, wall - covered)
+
+    span_total = sum(p["seconds"] for p in phases.values())
+    attributed = span_total + bubble
+    coverage = attributed / wall if wall > 0 else 0.0
+    for p in phases.values():
+        p["fraction"] = (p["seconds"] / wall) if wall > 0 else 0.0
+        p["seconds"] = round(p["seconds"], 6)
+        p["fraction"] = round(p["fraction"], 6)
+
+    # ---- joins ----------------------------------------------------------
+    event_counts: Dict[str, int] = {}
+    solver_events = []
+    transfer_events = []
+    for rec in events:
+        name = str(rec.get("event", "?"))
+        event_counts[name] = event_counts.get(name, 0) + 1
+        if name == "SolverStatsEvent":
+            solver_events.append(rec.get("fields") or {})
+        elif name == "TransferStatsEvent":
+            transfer_events.append(rec.get("fields") or {})
+
+    solver: Dict[str, Any] = {}
+    if solver_events:
+        def _sum(key):
+            return sum(float(f.get(key, 0) or 0) for f in solver_events)
+
+        executed = _sum("executed_lane_iterations")
+        lockstep = _sum("lockstep_lane_iterations")
+        solver = {
+            "buckets": len(solver_events),
+            "entities": int(_sum("num_entities")),
+            "rounds": int(_sum("rounds")),
+            "executed_lane_iterations": int(executed),
+            "lockstep_lane_iterations": int(lockstep),
+            "lane_iteration_savings": (
+                round(lockstep / executed, 4) if executed else None
+            ),
+            "chunk_retraces": int(_sum("chunk_retraces")),
+            "unconverged_buckets": sum(
+                1 for f in solver_events if not f.get("converged", True)
+            ),
+        }
+
+    snapshot = dict(metric_recs[-1].get("snapshot") or {}) if metric_recs else {}
+    counters = snapshot.get("counters") or {}
+    transfers = {
+        k[len("transfer."):]: v
+        for k, v in counters.items()
+        if k.startswith("transfer.")
+    }
+    if not transfers and transfer_events:
+        for f in transfer_events:
+            for k, v in f.items():
+                if isinstance(v, (int, float)):
+                    transfers[k] = transfers.get(k, 0) + v
+    jit = {
+        k[len("jit.traces."):]: int(v)
+        for k, v in counters.items()
+        if k.startswith("jit.traces.")
+    }
+
+    return RunReport(
+        label=str(label),
+        source_path=source_path,
+        wall_clock_s=round(wall, 6),
+        span_extent_s=round(span_extent, 6),
+        phases=phases,
+        bubble_s=round(bubble, 6),
+        attributed_s=round(attributed, 6),
+        coverage=round(coverage, 6),
+        num_spans=len(spans),
+        failed_spans=failed,
+        top_spans=_span_tree_summary(spans, max_depth=2),
+        solver=solver,
+        transfers=transfers,
+        jit_traces=jit,
+        events=event_counts,
+        metrics=snapshot,
+        warnings=warnings,
+    )
+
+
+def analyze_ledger(path: str) -> RunReport:
+    """Validate + replay one run-ledger file into a :class:`RunReport`.
+    Crash-truncated ledgers analyze their valid prefix (with a report
+    warning) rather than failing."""
+    import warnings as _w
+
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        records = validate_ledger(path)
+    report = analyze_records(records, source_path=path)
+    for w in caught:
+        report.warnings.append(str(w.message))
+    return report
+
+
+def format_report(report: RunReport) -> str:
+    """Human-readable occupancy report (the analyze_run CLI output)."""
+    lines = [
+        f"run report [{report.label}]"
+        + (f" — {report.source_path}" if report.source_path else ""),
+        f"  wall clock      {report.wall_clock_s:10.4f}s"
+        f"   spans {report.num_spans}"
+        + (f"   FAILED {report.failed_spans}" if report.failed_spans else ""),
+        f"  attributed      {report.attributed_s:10.4f}s"
+        f"   coverage {report.coverage * 100:6.2f}%",
+        "",
+        f"  {'phase':<12} {'seconds':>10} {'share':>8} {'spans':>7}",
+    ]
+    rows = sorted(
+        ((p, v) for p, v in report.phases.items() if v["spans"] or v["seconds"]),
+        key=lambda kv: -kv[1]["seconds"],
+    )
+    for phase, v in rows:
+        lines.append(
+            f"  {phase:<12} {v['seconds']:>10.4f} {v['fraction'] * 100:>7.2f}% "
+            f"{int(v['spans']):>7d}"
+        )
+    lines.append(
+        f"  {'(bubbles)':<12} {report.bubble_s:>10.4f} "
+        f"{(report.bubble_s / report.wall_clock_s * 100 if report.wall_clock_s else 0):>7.2f}%"
+        f" {'—':>7}"
+    )
+    if report.solver:
+        s = report.solver
+        lines += [
+            "",
+            "  solver join: "
+            f"{s['buckets']} bucket(s), {s['entities']} entities, "
+            f"{s['rounds']} adaptive round(s)",
+            f"    lane iterations executed/lockstep: "
+            f"{s['executed_lane_iterations']}/{s['lockstep_lane_iterations']}"
+            + (
+                f" (savings {s['lane_iteration_savings']}x)"
+                if s.get("lane_iteration_savings")
+                else ""
+            ),
+        ]
+    if report.transfers:
+        lines.append("  transfer join: " + ", ".join(
+            f"{k}={int(v)}" for k, v in sorted(report.transfers.items())
+        ))
+    if report.jit_traces:
+        lines.append("  jit traces: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(report.jit_traces.items())
+        ))
+    if report.warnings:
+        lines.append("")
+        for w in report.warnings:
+            lines.append(f"  warning: {w}")
+    return "\n".join(lines)

@@ -44,6 +44,7 @@ class TelemetryRun:
         )
         self._emitters: List[Any] = []
         self._finished = False
+        self._spans_flushed = 0
         if self.ledger is not None:
             self.ledger.write("meta", phase="start", label=label)
 
@@ -62,6 +63,23 @@ class TelemetryRun:
             int(getattr(emitter, "listener_errors", 0))
             for emitter in self._emitters
         )
+
+    def checkpoint(self, label: str = "") -> None:
+        """Span-tree checkpoint: drain spans finished so far into the
+        ledger and fsync it, so a later crash still leaves an analyzable
+        prefix. Spans are written once — a checkpoint remembers how many it
+        has flushed and ``finish`` continues from there."""
+        if self.ledger is None or self._finished:
+            return
+        spans = self.tracer.spans()
+        for rec in spans[self._spans_flushed:]:
+            self.ledger.write_span(rec, self.tracer.origin_unix)
+        self._spans_flushed = len(spans)
+        self.ledger.write(
+            "meta", phase="checkpoint", label=label or self.label,
+            num_spans=self._spans_flushed,
+        )
+        self.ledger.flush()
 
     def finish(self, extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Drain spans into the sinks; returns the summary dict. Safe to
@@ -92,8 +110,9 @@ class TelemetryRun:
             )
             _log.info("wrote chrome trace (%d events) to %s", n, self.trace_path)
         if self.ledger is not None:
-            for rec in spans:
+            for rec in spans[self._spans_flushed:]:
                 self.ledger.write_span(rec, self.tracer.origin_unix)
+            self._spans_flushed = len(spans)
             self.ledger.write("metrics", snapshot=metrics_snapshot)
             self.ledger.write(
                 "meta",

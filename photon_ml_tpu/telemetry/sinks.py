@@ -103,6 +103,20 @@ class RunLedger:
             attrs=rec.attrs,
         )
 
+    def flush(self) -> None:
+        """Push buffered records to stable storage (flush + best-effort
+        fsync). ``write`` already flushes to the OS after every record; this
+        additionally asks the kernel to persist, so span-tree checkpoints
+        survive a machine-level crash, not just a process kill."""
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
+
     def close(self) -> None:
         with self._lock:
             if not self._f.closed:

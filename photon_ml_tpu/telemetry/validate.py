@@ -7,9 +7,15 @@ on the first violation and return the parsed payload on success.
 from __future__ import annotations
 
 import json
+import warnings as _warnings
 from typing import Any, Dict, List
 
-__all__ = ["validate_chrome_trace", "validate_ledger"]
+__all__ = ["validate_chrome_trace", "validate_ledger", "TruncatedLedgerWarning"]
+
+
+class TruncatedLedgerWarning(UserWarning):
+    """The ledger's final line was cut mid-write (crash-truncated); the
+    valid prefix was still validated and returned."""
 
 _REQUIRED_TRACE_KEYS = ("name", "cat", "ph", "ts", "dur", "pid", "tid")
 
@@ -52,35 +58,61 @@ def validate_chrome_trace(path: str) -> Dict[str, Any]:
     return doc
 
 
-def validate_ledger(path: str) -> List[Dict[str, Any]]:
+def validate_ledger(
+    path: str, allow_truncated_tail: bool = True
+) -> List[Dict[str, Any]]:
     """Check every line of ``path`` is a typed JSONL record matching the
-    ledger schema. Returns the parsed records."""
+    ledger schema. Returns the parsed records.
+
+    A crash-truncated ledger — the process was killed mid-``write``, so the
+    FINAL line is a partial record with no trailing newline — is tolerated
+    by default: the partial tail raises a :class:`TruncatedLedgerWarning`
+    and the valid prefix is still validated and returned. A malformed line
+    anywhere else (or any bad line with ``allow_truncated_tail=False``)
+    remains a hard ``ValueError``: that is corruption, not a crash
+    artifact.
+    """
+    with open(path, "r", encoding="utf-8", newline="") as f:
+        raw = f.read()
+    lines = raw.split("\n")
+    # A well-formed ledger ends with "\n", leaving a trailing "" element; a
+    # non-empty final element means the last write was cut short.
+    truncated_tail = bool(lines) and lines[-1] != ""
     records: List[Dict[str, Any]] = []
-    with open(path, "r", encoding="utf-8") as f:
-        for lineno, line in enumerate(f, start=1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError as e:
-                raise ValueError(f"{path}:{lineno}: invalid JSON ({e})") from e
-            if not isinstance(rec, dict):
-                raise ValueError(f"{path}:{lineno}: record is not an object")
-            rec_type = rec.get("type")
-            if rec_type not in _LEDGER_SCHEMAS:
-                raise ValueError(
-                    f"{path}:{lineno}: unknown record type {rec_type!r} "
-                    f"(expected one of {sorted(_LEDGER_SCHEMAS)})"
+    for lineno, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        is_tail = lineno == len(lines) and truncated_tail
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            if is_tail and allow_truncated_tail:
+                _warnings.warn(
+                    f"{path}:{lineno}: final line is a partial record "
+                    f"(crash-truncated ledger); validated the "
+                    f"{len(records)}-record prefix",
+                    TruncatedLedgerWarning,
+                    stacklevel=2,
                 )
-            if not isinstance(rec.get("ts"), (int, float)):
-                raise ValueError(f"{path}:{lineno}: missing numeric 'ts'")
-            for field in _LEDGER_SCHEMAS[rec_type]:
-                if field not in rec:
-                    raise ValueError(
-                        f"{path}:{lineno}: {rec_type} record missing {field!r}"
-                    )
-            records.append(rec)
+                break
+            raise ValueError(f"{path}:{lineno}: invalid JSON ({e})") from e
+        if not isinstance(rec, dict):
+            raise ValueError(f"{path}:{lineno}: record is not an object")
+        rec_type = rec.get("type")
+        if rec_type not in _LEDGER_SCHEMAS:
+            raise ValueError(
+                f"{path}:{lineno}: unknown record type {rec_type!r} "
+                f"(expected one of {sorted(_LEDGER_SCHEMAS)})"
+            )
+        if not isinstance(rec.get("ts"), (int, float)):
+            raise ValueError(f"{path}:{lineno}: missing numeric 'ts'")
+        for field in _LEDGER_SCHEMAS[rec_type]:
+            if field not in rec:
+                raise ValueError(
+                    f"{path}:{lineno}: {rec_type} record missing {field!r}"
+                )
+        records.append(rec)
     if not records:
         raise ValueError(f"{path}: ledger is empty")
     return records

@@ -1,0 +1,197 @@
+"""Declared knob space: every tunable config surface, registered once.
+
+The registration mechanism is the contract that keeps future knobs
+observable: a knob is not tunable until it declares *which report metrics
+its decision depends on* (``metric_deps``) and *which phase it moves*
+(``phase``). The offline tuner refuses to reason about config surfaces
+that are not in this table, so adding a knob forces you to say what
+evidence would justify changing it.
+
+Knobs are identified by dotted names mirroring where they act:
+``adaptive.*`` feed :class:`photon_ml_tpu.opt.config.AdaptiveSolveConfig`,
+``serving.*`` are ``serve_game`` CLI surfaces, ``train.*`` are
+``train_game``/engine surfaces.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["KnobSpec", "register_knob", "get_knob", "all_knobs", "KNOBS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobSpec:
+    """One tunable knob.
+
+    ``metric_deps`` names the :class:`RunReport` evidence the tuner reads
+    when proposing a value — phase fractions (``phase:<name>``), solver
+    join fields (``solver:<field>``), registry metrics (``metric:<name>``)
+    or jit counters (``jit:<key>``). ``candidates`` is the discrete ladder
+    the A/B layer may trial; continuous knobs enumerate a sensible grid.
+    """
+
+    name: str
+    kind: str  # "int" | "float" | "str" | "csv_ints"
+    default: Any
+    applies_to: str  # "train" | "serve" | "both"
+    phase: str  # RunReport phase bucket this knob chiefly moves
+    metric_deps: Tuple[str, ...]
+    candidates: Tuple[Any, ...]
+    description: str
+
+    def parse(self, value: Any) -> Any:
+        if self.kind == "int":
+            return int(value)
+        if self.kind == "float":
+            return float(value)
+        if self.kind == "csv_ints":
+            if isinstance(value, str):
+                return tuple(int(v) for v in value.split(",") if v.strip())
+            return tuple(int(v) for v in value)
+        return str(value)
+
+
+KNOBS: Dict[str, KnobSpec] = {}
+
+
+def register_knob(spec: KnobSpec) -> KnobSpec:
+    if spec.name in KNOBS:
+        raise ValueError(f"knob {spec.name!r} registered twice")
+    KNOBS[spec.name] = spec
+    return spec
+
+
+def get_knob(name: str) -> KnobSpec:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown knob {name!r}; registered: {sorted(KNOBS)}"
+        ) from None
+
+
+def all_knobs() -> Tuple[KnobSpec, ...]:
+    return tuple(KNOBS[name] for name in sorted(KNOBS))
+
+
+# ------------------------------------------------------------------ table
+
+register_knob(KnobSpec(
+    name="adaptive.chunk_iters",
+    kind="int",
+    default=8,
+    applies_to="train",
+    phase="re_solve",
+    metric_deps=(
+        "phase:re_solve",
+        "solver:lane_iteration_savings",
+        "solver:chunk_retraces",
+        "jit:re_bucket_chunk",
+    ),
+    candidates=(4, 8, 16, 32),
+    description=(
+        "Iterations per adaptive-RE device chunk. Larger chunks amortize "
+        "dispatch overhead but waste lane iterations past convergence; "
+        "smaller chunks re-check convergence more often at more dispatches."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="adaptive.min_lanes",
+    kind="int",
+    default=8,
+    applies_to="train",
+    phase="re_solve",
+    metric_deps=(
+        "phase:re_solve",
+        "solver:lane_iteration_savings",
+        "solver:rounds",
+    ),
+    candidates=(4, 8, 16, 32),
+    description=(
+        "Smallest compacted lane count an adaptive round may shrink to. "
+        "Lower values squeeze out more wasted lanes per round but add "
+        "compaction rounds (and retraces for new lane shapes)."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="serving.bucket_sizes",
+    kind="csv_ints",
+    default=(1, 2, 4, 8, 16, 32),
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "phase:serving",
+        "metric:serving.latency_p99_ms",
+        "metric:serving.batch_fill",
+        "metric:serving.compile_count",
+    ),
+    candidates=(
+        (1, 2, 4, 8, 16, 32),
+        (1, 4, 16, 64),
+        (1, 2, 4, 8, 16, 32, 64),
+        (1, 8, 64),
+    ),
+    description=(
+        "Microbatch padding ladder. A denser ladder improves batch fill "
+        "(less padding waste) at the cost of more compiled programs; a "
+        "sparser one compiles less but pads more."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="serving.cache_capacity",
+    kind="int",
+    default=4096,
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "phase:serving",
+        "metric:serving.cache_hit_rate",
+        "metric:serving.latency_p50_ms",
+    ),
+    candidates=(1024, 4096, 16384, 65536),
+    description=(
+        "Per-coordinate device row-cache capacity. Bigger caches lift the "
+        "hit rate on skewed entity traffic at the cost of device memory."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="serving.max_nnz",
+    kind="int",
+    default=0,  # 0 = derive from the replayed requests (max_nnz_of)
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "phase:serving",
+        "metric:serving.latency_p99_ms",
+        "metric:serving.compile_count",
+    ),
+    candidates=(0,),
+    description=(
+        "Padded nonzeros per request row (0 = derive pow2 from traffic). "
+        "Overriding trades truncation risk for smaller padded programs."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="train.engine",
+    kind="str",
+    default="auto",
+    applies_to="train",
+    phase="fe_solve",
+    metric_deps=(
+        "phase:fe_solve",
+        "phase:transfers",
+        "jit:fe_solve",
+    ),
+    candidates=("auto", "ell", "benes", "fused"),
+    description=(
+        "Fixed-effect matvec engine. BENCH_LASTGOOD.json records a 19x "
+        "spread across engines on the same shard shape, so this is the "
+        "single highest-leverage train-side knob."
+    ),
+))

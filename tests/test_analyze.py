@@ -1,0 +1,320 @@
+"""Ledger-replay analyzer tests: phase classification, exclusive-time and
+bubble accounting on synthetic ledgers (fast lane), the analyze_run CLI
+contract, and the driver-level gate — a tiny traced train whose ledger
+replays into a report that attributes ≥95% of wall-clock (slow lane; CI's
+analyze smoke gate runs the same CLI invocation)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.telemetry import (
+    RunReport,
+    TruncatedLedgerWarning,
+    analyze_ledger,
+    analyze_records,
+    classify_span,
+    format_report,
+    get_registry,
+)
+from photon_ml_tpu.telemetry.analyze import PHASES
+from photon_ml_tpu.telemetry.span import disable_tracing, span
+
+
+def _write_ledger(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def _span(name, sid, start, dur, parent=None, failed=False):
+    return {
+        "type": "span", "ts": start + dur, "name": name,
+        "path": name if parent is None else f"parent/{name}",
+        "span_id": sid, "parent_id": parent, "start_unix": start,
+        "duration_s": dur, "thread": "MainThread", "failed": failed,
+        "error": None, "attrs": {},
+    }
+
+
+def _synthetic_records():
+    """10s run: one cd root span (8s) holding a 2s fe solve and a 3s re
+    solve, so cd exclusive time is 3s and 2s of wall is bubble."""
+    return [
+        {"type": "meta", "ts": 1000.0, "phase": "start", "label": "synth"},
+        _span("fe/solve", 2, 1000.5, 2.0, parent=1),
+        _span("re/train", 3, 1003.0, 3.0, parent=1),
+        _span("cd/run", 1, 1000.0, 8.0),
+        {
+            "type": "metrics", "ts": 1009.9,
+            "snapshot": {
+                "counters": {
+                    "transfer.row_transfers_h2d": 4,
+                    "jit.traces.fe_solve": 2,
+                },
+                "gauges": {"serving.batch_fill": {"last": 0.5, "peak": 0.9}},
+                "histograms": {"lat": {"count": 3, "mean": 1.5, "max": 2.0}},
+            },
+        },
+        {"type": "meta", "ts": 1010.0, "phase": "finish"},
+    ]
+
+
+class TestClassifier:
+    @pytest.mark.parametrize("name,phase", [
+        ("fe/solve", "fe_solve"),
+        ("re/adaptive_round", "re_solve"),
+        ("cd/outer_iter", "cd_driver"),
+        ("serve/score_batch", "serving"),
+        ("incremental/update", "incremental"),
+        ("h2d row push", "transfers"),
+        ("read training data", "io"),
+        ("save model", "io"),
+        ("pack artifact", "io"),
+        ("hyperparameter tuning", "host_driver"),
+        ("fit", "host_driver"),
+    ])
+    def test_name_to_phase(self, name, phase):
+        assert classify_span(name) == phase
+
+    def test_every_phase_is_canonical(self):
+        for name in ("fe/x", "re/x", "cd/x", "serve/x", "incremental/x",
+                     "transfer", "load artifact", "anything else"):
+            assert classify_span(name) in PHASES
+
+
+class TestAccounting:
+    def test_exclusive_time_bubble_and_coverage(self):
+        report = analyze_records(_synthetic_records())
+        assert report.label == "synth"
+        assert report.wall_clock_s == pytest.approx(10.0)
+        # parent's exclusive time excludes both direct children
+        assert report.phase_seconds("cd_driver") == pytest.approx(3.0)
+        assert report.phase_seconds("fe_solve") == pytest.approx(2.0)
+        assert report.phase_seconds("re_solve") == pytest.approx(3.0)
+        # 10s wall minus the 8s root interval = 2s of host-driver bubble
+        assert report.bubble_s == pytest.approx(2.0)
+        assert report.attributed_s == pytest.approx(10.0)
+        assert report.coverage == pytest.approx(1.0)
+        assert report.num_spans == 3 and report.failed_spans == 0
+        # joins from the metrics record
+        assert report.transfers == {"row_transfers_h2d": 4}
+        assert report.jit_traces == {"fe_solve": 2}
+
+    def test_missing_finish_warns_and_measures_to_last_span(self):
+        records = [r for r in _synthetic_records()
+                   if not (r["type"] == "meta" and r["phase"] == "finish")]
+        report = analyze_records(records)
+        assert any("no finish record" in w for w in report.warnings)
+        assert report.wall_clock_s == pytest.approx(8.0)  # last span end
+
+    def test_solver_event_join(self):
+        records = _synthetic_records()
+        records.insert(2, {
+            "type": "event", "ts": 1002.0, "event": "SolverStatsEvent",
+            "fields": {
+                "num_entities": 8, "rounds": 3,
+                "executed_lane_iterations": 100,
+                "lockstep_lane_iterations": 250,
+                "chunk_retraces": 1, "converged": False,
+            },
+        })
+        report = analyze_records(records)
+        assert report.solver["entities"] == 8
+        assert report.solver["lane_iteration_savings"] == pytest.approx(2.5)
+        assert report.solver["unconverged_buckets"] == 1
+        assert report.events["SolverStatsEvent"] == 1
+
+    def test_failed_span_counted(self):
+        records = _synthetic_records()
+        records.append(_span("cd/objective", 9, 1008.5, 0.5, failed=True))
+        report = analyze_records(records)
+        assert report.failed_spans == 1
+
+    def test_round_trip_and_metric_lookup(self):
+        report = analyze_records(_synthetic_records())
+        d = report.to_dict()
+        d["unknown_future_key"] = 1  # forward-compat: ignored on load
+        back = RunReport.from_dict(d)
+        assert back.phases == report.phases
+        assert back.coverage == report.coverage
+        # counters, then gauge last-values, then histogram means
+        assert back.metric("transfer.row_transfers_h2d") == 4.0
+        assert back.metric("serving.batch_fill") == 0.5
+        assert back.metric("lat") == 1.5
+        assert back.metric("nope") is None
+
+    def test_format_report_renders(self):
+        text = format_report(analyze_records(_synthetic_records()))
+        assert "cd_driver" in text and "coverage" in text
+        assert "(bubbles)" in text
+
+
+class TestLedgerReplay:
+    def test_analyze_ledger_truncated_tail(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        _write_ledger(path, _synthetic_records())
+        with open(path, "a") as f:
+            f.write('{"type": "span", "name": "cut-mid-wr')  # no newline
+        report = analyze_ledger(str(path))
+        assert report.num_spans == 3  # the valid prefix
+        assert any("partial record" in w for w in report.warnings)
+
+    def test_live_session_coverage(self, tmp_path):
+        """A real start_run session (spans + registry + checkpoint) replays
+        with ≥95% attribution — the same bar as the CI analyze gate."""
+        from photon_ml_tpu.telemetry import start_run
+
+        get_registry().reset()
+        ledger = tmp_path / "live.jsonl"
+        run = start_run("live", ledger_path=str(ledger), device_sync=False)
+        try:
+            with span("cd/run"):
+                with span("fe/solve"):
+                    time.sleep(0.02)
+                with span("re/train"):
+                    time.sleep(0.02)
+            run.checkpoint("mid")
+            with span("read data"):
+                time.sleep(0.01)
+            run.finish()
+        finally:
+            disable_tracing()
+        report = analyze_ledger(str(ledger))
+        assert report.num_spans == 4  # checkpoint must not double-write
+        assert 0.95 <= report.coverage <= 1.05
+        assert report.phase_seconds("fe_solve") > 0
+        assert report.phase_seconds("io") > 0
+
+
+class TestAnalyzeRunCli:
+    def _ledger(self, tmp_path):
+        return _write_ledger(tmp_path / "l.jsonl", _synthetic_records())
+
+    def test_report_json_and_coverage_gate(self, tmp_path, capsys):
+        from photon_ml_tpu.cli.analyze_run import main
+
+        out = tmp_path / "report.json"
+        rc = main([
+            self._ledger(tmp_path),
+            "--json", str(out), "--check-coverage", "0.95",
+        ])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["coverage"] == pytest.approx(1.0)
+        assert capsys.readouterr().out  # human table still printed
+
+    def test_coverage_gate_fails_on_gaps(self, tmp_path, capsys):
+        from photon_ml_tpu.cli.analyze_run import main
+
+        # drop the root span: 5s of child spans against a 10s wall
+        records = [r for r in _synthetic_records()
+                   if r.get("name") != "cd/run"]
+        path = _write_ledger(tmp_path / "gappy.jsonl", records)
+        assert main([path, "--check-coverage", "0.95"]) == 1
+        assert "coverage" in capsys.readouterr().out.lower()
+
+    def test_propose_covers_knob_space(self, tmp_path, capsys):
+        from photon_ml_tpu.cli.analyze_run import main
+
+        out = tmp_path / "proposal.json"
+        rc = main([
+            self._ledger(tmp_path), "--quiet", "--propose-json", str(out),
+        ])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert len(doc["knobs"]) >= 4  # the declared knob space, audited
+        for name, knob in doc["knobs"].items():
+            assert knob["rationale"], name
+
+
+@pytest.mark.slow
+class TestAnalyzeTrainGate:
+    @pytest.fixture(scope="class")
+    def traced_train(self, tmp_path_factory):
+        """Tiny traced CPU train (same fixture recipe as the telemetry
+        smoke gate) -> ledger path."""
+        from photon_ml_tpu.cli.train_game import parse_args, run
+        from photon_ml_tpu.io.data_reader import write_training_examples
+
+        root = tmp_path_factory.mktemp("analyze_train")
+        rng = np.random.default_rng(7)
+        n_users, dg, du = 6, 4, 3
+        records = []
+        for i in range(n_users * 8):
+            user = f"user{i % n_users}"
+            xg = rng.normal(size=dg)
+            xu = rng.normal(size=du)
+            y = 1.0 if (xg.sum() + xu.sum()) > 0 else 0.0
+            records.append({
+                "uid": f"r{i}", "label": y,
+                "features": [("g", str(j), xg[j]) for j in range(dg)],
+                "userFeatures": [("u", str(j), xu[j]) for j in range(du)],
+                "metadataMap": {"userId": user},
+            })
+        train_dir = root / "train"
+        train_dir.mkdir()
+        write_training_examples(str(train_dir / "part-00000.avro"), records)
+        config = {
+            "feature_shards": {
+                "global": {"feature_bags": ["features"],
+                           "add_intercept": True},
+                "per_user": {"feature_bags": ["userFeatures"],
+                             "add_intercept": False},
+            },
+            "coordinates": {
+                "fixed": {
+                    "type": "fixed", "feature_shard": "global",
+                    "optimizer": {"optimizer": "LBFGS",
+                                  "regularization": "L2",
+                                  "regularization_weight": 0.1},
+                },
+                "per_user": {
+                    "type": "random", "feature_shard": "per_user",
+                    "random_effect_type": "userId",
+                    "optimizer": {
+                        "optimizer": "LBFGS", "regularization": "L2",
+                        "regularization_weight": 1.0,
+                        "adaptive": {"enabled": True, "chunk_iters": 4,
+                                     "min_lanes": 2},
+                    },
+                },
+            },
+            "update_order": ["fixed", "per_user"],
+        }
+        cfg = root / "game.json"
+        cfg.write_text(json.dumps(config))
+        ledger = root / "train-ledger.jsonl"
+        run(parse_args([
+            "--train-data-dirs", str(train_dir),
+            "--coordinate-config", str(cfg),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(root / "model"),
+            "--telemetry-out", str(ledger),
+        ]))
+        return str(ledger)
+
+    def test_train_ledger_attributes_wall_clock(self, traced_train):
+        report = analyze_ledger(traced_train)
+        # phase durations sum within 5% of measured wall-clock (two-sided:
+        # >1 would mean concurrent trees double-counting)
+        assert 0.95 <= report.coverage <= 1.05, report.to_dict()
+        assert report.phase_seconds("re_solve") > 0
+        assert report.phase_seconds("cd_driver") > 0
+        assert report.phase_seconds("io") > 0
+        assert report.events.get("SolverStatsEvent", 0) > 0
+
+    def test_analyze_run_cli_gate(self, traced_train, tmp_path):
+        from photon_ml_tpu.cli.analyze_run import main
+
+        out = tmp_path / "proposal.json"
+        rc = main([
+            traced_train, "--check-coverage", "0.95",
+            "--propose-json", str(out),
+        ])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert len(doc["knobs"]) >= 4

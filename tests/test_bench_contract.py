@@ -232,3 +232,103 @@ def test_bench_cd_scores_contract():
     assert dev["host_score_sums"] == 0
     # smoke mode must not touch the committed full-scale artifact
     assert _artifact_fingerprint(artifact) == before
+
+
+def test_bench_tuning_contract(tmp_path):
+    """``--tuning`` closes the telemetry loop: default replay under a run
+    ledger -> analyzer replay -> tuner proposal -> tuned replay, with the
+    default-vs-tuned deltas in the payload. Smoke must leave both the
+    committed artifact AND the perf-trajectory history untouched."""
+    artifact = os.path.join(REPO, "BENCH_TUNING.json")
+    history = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+    before = _artifact_fingerprint(artifact)
+    history_before = _artifact_fingerprint(history)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--tuning"],
+        capture_output=True, text=True, timeout=900,
+        env=_smoke_env(BENCH_TELEMETRY_DIR=str(tmp_path)),
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+
+    assert payload["metric"] == "tuning_p99_delta_s"
+    assert "error" not in payload
+    assert payload["unit"] == "seconds_default_minus_tuned"
+    # both arms fully recorded, with the connecting proposal
+    for arm in ("default", "tuned"):
+        assert payload[arm]["latency_p99_s"] > 0
+        assert payload[arm]["bucket_sizes"]
+        assert payload[arm]["cache_capacity"] > 0
+    assert payload["value"] == pytest.approx(
+        payload["default"]["latency_p99_s"]
+        - payload["tuned"]["latency_p99_s"],
+        abs=1e-6,
+    )
+    assert set(payload["deltas"]) == {
+        "latency_p99_s", "requests_per_s", "xla_compiles"
+    }
+    # the proposal audited the full knob space and the A/B always has a
+    # control + at least one trial arm
+    assert payload["proposal"]["knobs_considered"] >= 4
+    assert len(payload["proposal"]["candidates"]) >= 2
+    # the analyzer replay attributed the ledger's wall-clock
+    assert payload["report_coverage"] >= 0.95
+    telemetry = payload["telemetry"]
+    assert telemetry["validated"] is True
+    assert telemetry["ledger_records"] > 0
+    # telemetry files land in BENCH_TELEMETRY_DIR, not the repo
+    assert telemetry["ledger"].startswith(str(tmp_path))
+    # smoke mode leaves committed records untouched
+    assert _artifact_fingerprint(artifact) == before
+    assert _artifact_fingerprint(history) == history_before
+
+
+def test_bench_serving_validates_own_telemetry(tmp_path):
+    """Every telemetry-mode sub-bench validates its own ledger + Chrome
+    trace before writing the BENCH artifact; the files are real and land
+    outside the repo."""
+    from photon_ml_tpu.telemetry import validate_chrome_trace, validate_ledger
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serving"],
+        capture_output=True, text=True, timeout=900,
+        env=_smoke_env(BENCH_TELEMETRY_DIR=str(tmp_path)),
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    telemetry = payload["telemetry"]
+    assert telemetry["validated"] is True
+    # the paths the bench reported really validate from the outside too
+    records = validate_ledger(telemetry["ledger"])
+    assert len(records) == telemetry["ledger_records"]
+    validate_chrome_trace(telemetry["trace"])
+    span_names = {r["name"] for r in records if r["type"] == "span"}
+    assert any(n.startswith("serve/") for n in span_names)
+
+
+def test_bench_history_append_when_opted_in(tmp_path):
+    """BENCH_HISTORY_WRITE opts a smoke run into the perf-trajectory
+    append; the record carries the fields check_perf_trajectory.py reads."""
+    import shutil
+
+    shutil.copy(os.path.join(REPO, "bench.py"), tmp_path / "bench.py")
+    env = _smoke_env(
+        BENCH_HISTORY_WRITE="1",
+        BENCH_TELEMETRY_DIR=str(tmp_path / "telemetry"),
+        PYTHONPATH=REPO,
+    )
+    proc = subprocess.run(
+        [sys.executable, str(tmp_path / "bench.py"), "--tuning"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    history = tmp_path / "BENCH_HISTORY.jsonl"
+    assert history.exists()
+    (rec,) = [json.loads(l) for l in history.read_text().splitlines()]
+    assert rec["mode"] == "tuning"
+    assert rec["metric"] == "tuning_p99_delta_s"
+    assert isinstance(rec["value"], (int, float))
+    assert rec["ts"] > 0 and rec["host"]

@@ -396,6 +396,130 @@ class TestEventBridge:
 # ---------------------------------------------------------------------------
 
 
+class TestRegistryLifecycle:
+    """Two telemetry sessions in one process must not bleed into each
+    other, and --auto-tune's fresh-registry trials must not pollute the
+    process-global registry (the isolation contract autotune.py documents)."""
+
+    def test_two_start_run_sessions_isolated(self, tmp_path):
+        from photon_ml_tpu.telemetry import note_jit_trace, start_run
+        from photon_ml_tpu.telemetry.span import disable_tracing, span
+
+        get_registry().reset()
+        first = tmp_path / "first.jsonl"
+        run1 = start_run("one", ledger_path=str(first), device_sync=False)
+        try:
+            with span("cd/first"):
+                note_jit_trace("prog_a")
+            run1.finish()
+        finally:
+            disable_tracing()
+        assert jit_trace_counts() == {"prog_a": 1}
+
+        # session 2 starts from a reset registry; start_run(clear=True)
+        # already drops session 1's spans
+        get_registry().reset()
+        assert jit_trace_counts() == {}
+        second = tmp_path / "second.jsonl"
+        run2 = start_run("two", ledger_path=str(second), device_sync=False)
+        try:
+            with span("re/second"):
+                note_jit_trace("prog_b", kind="fwd")
+            run2.finish()
+        finally:
+            disable_tracing()
+        assert jit_trace_counts() == {"prog_b/fwd": 1}
+
+        records2 = validate_ledger(str(second))
+        names2 = {r["name"] for r in records2 if r["type"] == "span"}
+        assert names2 == {"re/second"}  # session 1's span did not carry over
+        (metrics2,) = [r for r in records2 if r["type"] == "metrics"]
+        counters2 = metrics2["snapshot"]["counters"]
+        assert "jit.traces.prog_b/fwd" in counters2
+        assert "jit.traces.prog_a" not in counters2  # no cross-session leak
+        # session 1's ledger is intact and still its own
+        records1 = validate_ledger(str(first))
+        assert {r["name"] for r in records1 if r["type"] == "span"} == {
+            "cd/first"
+        }
+
+    def test_fresh_trial_registry_cannot_leak(self):
+        get_registry().reset()
+        trial_a = MetricsRegistry()
+        trial_a.count("serving.compile_count", 5)
+        trial_b = MetricsRegistry()
+        # trial A's counters are invisible to trial B AND to the global
+        assert trial_b.counter_value("serving.compile_count") == 0.0
+        assert get_registry().counter_value("serving.compile_count") == 0.0
+        trial_b.gauge("judge", 1.0)
+        assert "judge" not in trial_a.snapshot()["gauges"]
+
+    def test_checkpoint_leaves_analyzable_prefix(self, tmp_path):
+        """RunLedger.flush() via TelemetryRun.checkpoint(): the ledger is a
+        valid prefix BEFORE finish, and finish does not re-write the
+        checkpointed spans."""
+        from photon_ml_tpu.telemetry import start_run
+        from photon_ml_tpu.telemetry.span import disable_tracing, span
+
+        get_registry().reset()
+        path = tmp_path / "ledger.jsonl"
+        run = start_run("ckpt", ledger_path=str(path), device_sync=False)
+        try:
+            with span("cd/outer_iter"):
+                pass
+            run.checkpoint("iter-0")
+            mid = validate_ledger(str(path))  # readable pre-finish
+            assert [r["name"] for r in mid if r["type"] == "span"] == [
+                "cd/outer_iter"
+            ]
+            assert any(
+                r["type"] == "meta" and r.get("phase") == "checkpoint"
+                for r in mid
+            )
+            with span("cd/coordinate"):
+                pass
+            run.finish()
+        finally:
+            disable_tracing()
+        final = validate_ledger(str(path))
+        spans = [r["name"] for r in final if r["type"] == "span"]
+        assert spans == ["cd/outer_iter", "cd/coordinate"]  # no double write
+
+    def test_truncated_tail_tolerated_with_warning(self, tmp_path):
+        from photon_ml_tpu.telemetry import TruncatedLedgerWarning, start_run
+        from photon_ml_tpu.telemetry.span import disable_tracing, span
+
+        get_registry().reset()
+        path = tmp_path / "crash.jsonl"
+        run = start_run("crash", ledger_path=str(path), device_sync=False)
+        try:
+            with span("cd/run"):
+                pass
+            run.finish()
+        finally:
+            disable_tracing()
+        with open(path, "a") as f:
+            f.write('{"type": "span", "name": "killed mid-wr')  # no newline
+        with pytest.warns(TruncatedLedgerWarning, match="partial record"):
+            records = validate_ledger(str(path))
+        assert [r["name"] for r in records if r["type"] == "span"] == [
+            "cd/run"
+        ]
+        # strict mode still treats the same tail as corruption
+        with pytest.raises(ValueError, match="invalid JSON"):
+            validate_ledger(str(path), allow_truncated_tail=False)
+
+    def test_mid_file_garbage_still_hard_error(self, tmp_path):
+        path = tmp_path / "corrupt.jsonl"
+        path.write_text(
+            '{"type": "meta", "ts": 1.0, "phase": "start"}\n'
+            "not json at all\n"
+            '{"type": "meta", "ts": 2.0, "phase": "finish"}\n'
+        )
+        with pytest.raises(ValueError, match="invalid JSON"):
+            validate_ledger(str(path))
+
+
 @pytest.fixture(scope="module")
 def tiny_avro(tmp_path_factory):
     """Tiny GLMix logistic fixture (8 users) + a config whose RE coordinate

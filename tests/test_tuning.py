@@ -1,0 +1,479 @@
+"""Offline tuner + --auto-tune tests: the declared knob registry, evidence
+-driven proposals, the A/B harness's registry-isolation and tie-breaking
+contracts, tuned-config persistence on serving artifacts (fast lane), and
+the end-to-end auto-tune drivers — train_game iteration-0 A/B, serve_game
+warmup A/B, and the boots-tuned /varz assertion (slow lane)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.telemetry import MetricsRegistry, get_registry
+from photon_ml_tpu.telemetry.analyze import analyze_records
+from photon_ml_tpu.tuning import (
+    KnobSpec,
+    ab_candidates,
+    all_knobs,
+    get_knob,
+    propose,
+    register_knob,
+    resolve_dep,
+    run_ab_trials,
+)
+
+
+def _report(gauges=None, counters=None, solver_fields=None, phases=None):
+    """RunReport from a minimal synthetic ledger: a 10s run with optional
+    phase spans, solver events and registry snapshot."""
+    records = [{"type": "meta", "ts": 0.0, "phase": "start", "label": "t"}]
+    sid = 1
+    for name, dur in (phases or {}).items():
+        records.append({
+            "type": "span", "ts": dur, "name": name, "path": name,
+            "span_id": sid, "parent_id": None, "start_unix": 0.0,
+            "duration_s": dur, "failed": False,
+        })
+        sid += 1
+    if solver_fields:
+        records.append({
+            "type": "event", "ts": 5.0, "event": "SolverStatsEvent",
+            "fields": solver_fields,
+        })
+    records.append({
+        "type": "metrics", "ts": 9.9,
+        "snapshot": {
+            "counters": dict(counters or {}),
+            "gauges": {
+                k: {"last": v, "peak": v} for k, v in (gauges or {}).items()
+            },
+            "histograms": {},
+        },
+    })
+    records.append({"type": "meta", "ts": 10.0, "phase": "finish"})
+    return analyze_records(records)
+
+
+class TestKnobRegistry:
+    def test_knob_space_is_declared(self):
+        knobs = all_knobs()
+        assert len(knobs) >= 4
+        names = {k.name for k in knobs}
+        assert {"adaptive.chunk_iters", "serving.bucket_sizes",
+                "serving.cache_capacity", "train.engine"} <= names
+        for spec in knobs:
+            assert spec.metric_deps, spec.name  # tunable ⇒ observable
+            assert spec.applies_to in ("train", "serve", "both")
+            assert spec.default in spec.candidates or spec.kind == "csv_ints"
+
+    def test_parse_kinds(self):
+        assert get_knob("adaptive.chunk_iters").parse("16") == 16
+        assert get_knob("train.engine").parse("ell") == "ell"
+        buckets = get_knob("serving.bucket_sizes")
+        assert buckets.parse("1,4,16") == (1, 4, 16)
+        assert buckets.parse([1, 4]) == (1, 4)
+
+    def test_duplicate_registration_rejected(self):
+        with pytest.raises(ValueError, match="registered twice"):
+            register_knob(KnobSpec(
+                name="adaptive.chunk_iters", kind="int", default=8,
+                applies_to="train", phase="re_solve",
+                metric_deps=("phase:re_solve",), candidates=(8,),
+                description="dup",
+            ))
+
+    def test_unknown_knob_lists_registered(self):
+        with pytest.raises(KeyError, match="registered"):
+            get_knob("no.such.knob")
+
+
+class TestProposal:
+    def test_resolve_dep_kinds(self):
+        r = _report(
+            gauges={"serving.batch_fill": 0.5},
+            counters={"jit.traces.fe_solve": 3},
+            solver_fields={"executed_lane_iterations": 10,
+                           "lockstep_lane_iterations": 25},
+            phases={"re/train": 4.0},
+        )
+        assert resolve_dep(r, "phase:re_solve") == pytest.approx(0.4)
+        assert resolve_dep(r, "metric:serving.batch_fill") == 0.5
+        assert resolve_dep(r, "solver:lane_iteration_savings") == 2.5
+        assert resolve_dep(r, "jit:fe_solve") == 3.0
+        assert resolve_dep(r, "solver:nope") is None
+
+    def test_low_savings_steps_chunk_iters_down(self):
+        r = _report(
+            solver_fields={"executed_lane_iterations": 100,
+                           "lockstep_lane_iterations": 105, "rounds": 2,
+                           "chunk_retraces": 0},
+            phases={"re/train": 5.0},
+        )
+        p = propose(r)
+        assert p.knobs["adaptive.chunk_iters"].value == 4
+        assert p.knobs["adaptive.chunk_iters"].changed
+        assert p.knobs["adaptive.min_lanes"].value == 4
+        assert "savings" in p.knobs["adaptive.chunk_iters"].rationale
+
+    def test_serving_evidence_moves_serving_knobs(self):
+        r = _report(gauges={
+            "serving.batch_fill": 0.4,
+            "serving.cache_hit_rate": 0.5,
+        })
+        p = propose(r)
+        assert p.knobs["serving.bucket_sizes"].value == (1, 2, 4, 8, 16, 32, 64)
+        assert p.knobs["serving.cache_capacity"].value == 16384
+        changed = p.changed()
+        assert set(changed) == {"serving.bucket_sizes",
+                                "serving.cache_capacity"}
+
+    def test_every_knob_proposed_even_without_evidence(self):
+        p = propose(_report())
+        assert set(p.knobs) == {k.name for k in all_knobs()}
+        assert len(p.knobs) >= 4
+        assert p.changed() == {}  # no evidence ⇒ defaults hold
+        for knob in p.knobs.values():
+            assert knob.rationale
+
+    def test_to_dict_is_auditable(self):
+        doc = propose(_report(gauges={"serving.cache_hit_rate": 0.5})).to_dict()
+        knob = doc["knobs"]["serving.cache_capacity"]
+        assert knob["changed"] is True
+        assert knob["evidence"]["metric:serving.cache_hit_rate"] == 0.5
+
+
+class TestAbCandidates:
+    def test_control_is_always_first_and_default(self):
+        p = propose(_report(gauges={"serving.cache_hit_rate": 0.5}))
+        cands = ab_candidates(p, "serve")
+        assert len(cands) == 2
+        assert cands[0]["serving.cache_capacity"] == 4096  # the control
+        assert cands[1]["serving.cache_capacity"] == 16384
+        # train-scoped knobs never leak into serve candidates
+        assert all("adaptive.chunk_iters" not in c for c in cands)
+
+    def test_no_change_still_yields_b_arm(self):
+        # healthy metrics: nothing changes, but --auto-tune still needs a
+        # B arm to judge
+        p = propose(_report(gauges={"serving.batch_fill": 0.8,
+                                    "serving.cache_hit_rate": 0.9}))
+        assert p.changed() == {}
+        cands = ab_candidates(p, "serve")
+        assert len(cands) == 2
+        assert cands[0] != cands[1]
+
+
+class TestAbTrials:
+    def test_fresh_registry_per_trial_no_leaks(self):
+        get_registry().reset()
+        seen = []
+
+        def trial(config, registry):
+            # a leak would make trial 1 see trial 0's counter
+            seen.append(registry.counter_value("trial.touch"))
+            registry.count("trial.touch")
+            registry.gauge("judge", config["x"])
+
+        result = run_ab_trials([{"x": 2.0}, {"x": 1.0}], trial,
+                               judge_metric="judge")
+        assert seen == [0.0, 0.0]  # trial A cannot leak into trial B
+        assert get_registry().counter_value("trial.touch") == 0.0  # no global pollution
+        assert result.winner_index == 1
+        assert result.winner.config == {"x": 1.0}
+
+    def test_control_wins_ties(self):
+        def trial(config, registry):
+            registry.gauge("judge", 5.0)
+
+        result = run_ab_trials([{"v": "a"}, {"v": "b"}], trial,
+                               judge_metric="judge")
+        assert result.winner_index == 0
+
+    def test_failed_trial_never_wins(self):
+        def trial(config, registry):
+            if config["boom"]:
+                raise RuntimeError("trial exploded")
+            registry.gauge("judge", 100.0)
+
+        result = run_ab_trials(
+            [{"boom": False}, {"boom": True}], trial, judge_metric="judge"
+        )
+        assert result.winner_index == 0
+        failed = result.trials[1]
+        assert failed.score is None and "trial exploded" in failed.error
+
+    def test_wall_clock_fallback_judge(self):
+        result = run_ab_trials([{}, {}], lambda c, r: None)
+        assert result.judge_metric == "autotune.wall_s"
+        for t in result.trials:
+            assert t.score is not None and t.score >= 0
+        d = result.to_dict()
+        assert "snapshot" not in d["trials"][0]  # kept portable
+
+
+def _toy_artifact():
+    from photon_ml_tpu.indexmap import DefaultIndexMap
+    from photon_ml_tpu.serving import ServingArtifact, ServingTable
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    return ServingArtifact(
+        task=TaskType.LOGISTIC_REGRESSION,
+        tables={
+            "fixed": ServingTable(
+                feature_shard="global", random_effect_type=None,
+                weights=rng.standard_normal(8).astype(np.float32),
+            ),
+            "per_user": ServingTable(
+                feature_shard="per_user", random_effect_type="userId",
+                weights=rng.standard_normal((4, 3)).astype(np.float32),
+                entity_index=DefaultIndexMap(
+                    {f"u{i}": i for i in range(4)}
+                ),
+            ),
+        },
+        model_name="toy",
+    )
+
+
+class TestTunedConfigPersistence:
+    def test_metadata_round_trip(self, tmp_path):
+        from photon_ml_tpu.serving import load_artifact, save_artifact
+
+        art = _toy_artifact()
+        art.tuned_config = {"serving.cache_capacity": 1024}
+        out = tmp_path / "artifact"
+        save_artifact(art, str(out))
+        loaded = load_artifact(str(out))
+        assert loaded.tuned_config == {"serving.cache_capacity": 1024}
+
+    def test_sidecar_overrides_metadata(self, tmp_path):
+        from photon_ml_tpu.serving import (
+            load_artifact,
+            load_tuned_config,
+            save_artifact,
+            save_tuned_config,
+        )
+
+        art = _toy_artifact()
+        art.tuned_config = {"serving.cache_capacity": 1024}
+        out = tmp_path / "artifact"
+        save_artifact(art, str(out))
+        save_tuned_config(
+            str(out), {"serving.cache_capacity": 16384},
+            provenance={"source": "test"},
+        )
+        assert load_tuned_config(str(out)) == {
+            "serving.cache_capacity": 16384
+        }
+        loaded = load_artifact(str(out))
+        assert loaded.tuned_config == {"serving.cache_capacity": 16384}
+
+    def test_untuned_artifact_loads_none(self, tmp_path):
+        from photon_ml_tpu.serving import (
+            load_artifact,
+            load_tuned_config,
+            save_artifact,
+        )
+
+        out = tmp_path / "artifact"
+        save_artifact(_toy_artifact(), str(out))
+        assert load_tuned_config(str(out)) is None
+        assert load_artifact(str(out)).tuned_config is None
+
+    def test_malformed_sidecar_rejected(self, tmp_path):
+        from photon_ml_tpu.serving import load_tuned_config, save_artifact
+        from photon_ml_tpu.serving.artifact import TUNED_CONFIG_FILE
+
+        out = tmp_path / "artifact"
+        save_artifact(_toy_artifact(), str(out))
+        (out / TUNED_CONFIG_FILE).write_text('{"not": "tuned"}')
+        with pytest.raises(ValueError, match="tuned_config"):
+            load_tuned_config(str(out))
+
+    def test_sidecar_excluded_from_fingerprint(self, tmp_path):
+        """Writing the tuned-config sidecar must not invalidate the delta
+        chain: hot-swap fingerprints skip it."""
+        from photon_ml_tpu.incremental import fingerprint_dir
+        from photon_ml_tpu.serving import save_artifact, save_tuned_config
+
+        out = tmp_path / "artifact"
+        save_artifact(_toy_artifact(), str(out))
+        before = fingerprint_dir(str(out))
+        save_tuned_config(str(out), {"serving.cache_capacity": 1024})
+        assert fingerprint_dir(str(out)) == before
+
+
+@pytest.fixture(scope="module")
+def tiny_glmix(tmp_path_factory):
+    """Tiny GLMix logistic workload + adaptive-RE config for the driver
+    auto-tune gates."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    root = tmp_path_factory.mktemp("tuning_glmix")
+    rng = np.random.default_rng(11)
+    n_users, dg, du = 6, 4, 3
+    records = []
+    for i in range(n_users * 10):
+        user = f"user{i % n_users}"
+        xg = rng.normal(size=dg)
+        xu = rng.normal(size=du)
+        y = 1.0 if (xg.sum() + xu.sum()) > 0 else 0.0
+        records.append({
+            "uid": f"r{i}", "label": y,
+            "features": [("g", str(j), xg[j]) for j in range(dg)],
+            "userFeatures": [("u", str(j), xu[j]) for j in range(du)],
+            "metadataMap": {"userId": user},
+        })
+    train_dir = root / "train"
+    train_dir.mkdir()
+    write_training_examples(str(train_dir / "part-00000.avro"), records)
+    config = {
+        "feature_shards": {
+            "global": {"feature_bags": ["features"], "add_intercept": True},
+            "per_user": {"feature_bags": ["userFeatures"],
+                         "add_intercept": False},
+        },
+        "coordinates": {
+            "fixed": {
+                "type": "fixed", "feature_shard": "global",
+                "optimizer": {"optimizer": "LBFGS",
+                              "regularization": "L2",
+                              "regularization_weight": 0.1},
+            },
+            "per_user": {
+                "type": "random", "feature_shard": "per_user",
+                "random_effect_type": "userId",
+                "optimizer": {
+                    "optimizer": "LBFGS", "regularization": "L2",
+                    "regularization_weight": 1.0,
+                    "adaptive": {"enabled": True, "chunk_iters": 4,
+                                 "min_lanes": 2},
+                },
+            },
+        },
+        "update_order": ["fixed", "per_user"],
+    }
+    cfg = root / "game.json"
+    cfg.write_text(json.dumps(config))
+    return {"root": root, "train": train_dir, "config": cfg}
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode(), dict(resp.headers)
+
+
+@pytest.mark.slow
+class TestAutoTuneDrivers:
+    def test_train_auto_tune(self, tiny_glmix, tmp_path):
+        from photon_ml_tpu.cli.train_game import parse_args, run
+        from photon_ml_tpu.io.model_io import (
+            load_game_model,
+            load_game_model_metadata,
+        )
+
+        out = tmp_path / "model"
+        run(parse_args([
+            "--train-data-dirs", str(tiny_glmix["train"]),
+            "--coordinate-config", str(tiny_glmix["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(out),
+            "--auto-tune", "--auto-tune-trials", "1",
+        ]))
+        ab = json.loads((out / "auto-tune.json").read_text())
+        assert len(ab["trials"]) >= 2  # incumbent + at least one candidate
+        assert 0 <= ab["winner_index"] < len(ab["trials"])
+        assert ab["judge_metric"] == "autotune.wall_s"
+        for t in ab["trials"]:
+            assert t["error"] is None, t["error"]
+        # the tuned run still produces a loadable model
+        model, _ = load_game_model(str(out / "best"))
+        assert "fixed" in model.models
+        if ab["winner_index"] != 0:
+            meta = load_game_model_metadata(str(out / "best"))
+            tuned = (meta.get("configurations") or {}).get("tuned_config")
+            assert tuned == ab["winner_config"]
+
+    def test_serve_auto_tune_persists_and_boots_tuned(self, tiny_glmix,
+                                                      tmp_path):
+        """serve_game --auto-tune judges candidates via the registry,
+        persists the winner into the artifact, and a RESTARTED serve_game
+        boots with it — asserted over live /varz."""
+        from photon_ml_tpu.cli.serve_game import parse_args, run
+        from photon_ml_tpu.cli.train_game import (
+            parse_args as train_args,
+            run as train_run,
+        )
+        from photon_ml_tpu.serving import load_tuned_config
+
+        model_out = tmp_path / "model"
+        train_run(train_args([
+            "--train-data-dirs", str(tiny_glmix["train"]),
+            "--coordinate-config", str(tiny_glmix["config"]),
+            "--task", "LOGISTIC_REGRESSION",
+            "--output-dir", str(model_out),
+        ]))
+        artifact_dir = tmp_path / "artifact"
+        metrics_out = tmp_path / "metrics.json"
+        run(parse_args([
+            "--model-dir", str(model_out / "best"),
+            "--data-dirs", str(tiny_glmix["train"]),
+            "--export-artifact-dir", str(artifact_dir),
+            "--max-requests", "24",
+            "--auto-tune", "--auto-tune-warmup", "16",
+            "--metrics-output", str(metrics_out),
+        ]))
+        snapshot = json.loads(metrics_out.read_text())
+        ab = snapshot["auto_tune"]
+        assert len(ab["trials"]) >= 2
+        assert ab["judge_metric"] == "serving.latency_p99_ms"
+        for t in ab["trials"]:
+            assert t["error"] is None, t["error"]
+        persisted = load_tuned_config(str(artifact_dir))
+        assert persisted  # the winner landed in the sidecar
+
+        # restart from the tuned artifact and read /varz live
+        port_file = tmp_path / "port"
+        probes = {}
+
+        def probe():
+            deadline = time.time() + 60
+            while time.time() < deadline and not port_file.exists():
+                time.sleep(0.05)
+            port = int(port_file.read_text())
+            base = f"http://127.0.0.1:{port}"
+            probes["varz"] = _get(f"{base}/varz")
+            probes["healthz"] = _get(f"{base}/healthz")
+            probes["metrics"] = _get(f"{base}/metrics")
+            _get(f"{base}/quitquitquit")
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        run(parse_args([
+            "--artifact-dir", str(artifact_dir),
+            "--data-dirs", str(tiny_glmix["train"]),
+            "--max-requests", "8",
+            "--introspect-port", "0",
+            "--introspect-port-file", str(port_file),
+            "--introspect-hold", "60",
+        ]))
+        t.join(timeout=60)
+        assert not t.is_alive()
+
+        status, body, _ = probes["varz"]
+        varz = json.loads(body)
+        assert status == 200
+        assert varz["tuned"] is True  # boots with the persisted winner
+        assert varz["tuned_config"] == persisted
+        for knob, value in (varz["tuned_applied"] or {}).items():
+            assert varz[knob.split(".", 1)[1]] == value
+        status, body, _ = probes["healthz"]
+        assert status == 200 and json.loads(body)["healthy"] is True
+        status, body, headers = probes["metrics"]
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "photon_serving_num_requests" in body
