@@ -7,6 +7,11 @@ from photon_ml_tpu.algorithm.coordinate_descent import (
     CoordinateDescent,
     CoordinateDescentResult,
 )
+from photon_ml_tpu.algorithm.schedule import (
+    SCHEDULES,
+    InFlight,
+    ScheduleExecutor,
+)
 
 __all__ = [
     "Coordinate",
@@ -14,4 +19,7 @@ __all__ = [
     "RandomEffectCoordinate",
     "CoordinateDescent",
     "CoordinateDescentResult",
+    "SCHEDULES",
+    "InFlight",
+    "ScheduleExecutor",
 ]

@@ -278,6 +278,9 @@ class RandomEffectCoordinate(Coordinate):
     # per-entity coefficient variances from the local Hessian diagonals
     # (reference COMPUTE_VARIANCE; SingleNodeOptimizationProblem variances)
     compute_variances: bool = False
+    # >= 2 overlaps that many bucket solves on worker threads (the async CD
+    # schedule sets this; 0 = sequential, the bitwise-identical default)
+    overlap_buckets: int = 0
     # base_offsets uploaded once; every device-plane update reuses it in the
     # jitted regroup instead of re-pushing a row-length host array
     _base_offsets_dev: Optional[jax.Array] = dataclasses.field(
@@ -326,6 +329,7 @@ class RandomEffectCoordinate(Coordinate):
             new_model, results = train_random_effects(
                 ds, self.task, self.configuration, initial_model=model,
                 compute_variances=self.compute_variances, stats_out=stats,
+                overlap_buckets=self.overlap_buckets,
             )
         self.last_solver_stats = stats
         # entity lanes beyond the real ids (mesh padding) carry zero weights

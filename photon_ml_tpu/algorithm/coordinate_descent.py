@@ -30,6 +30,19 @@ NOT small, so where they live matters. Two planes are supported:
 array crossing the host/device boundary plus host plane re-sums; a
 ``TransferStatsEvent`` with per-iteration deltas is emitted after each outer
 iteration.
+
+Schedule: ``schedule="sync"`` (default) runs the strictly sequential loop
+above. ``schedule="async"`` pipelines coordinate solves on the device
+plane: each solve is dispatched onto a worker pool against the residual
+computed from the *current* running total — which may still be missing up
+to ``staleness`` in-flight updates — and completed solves are folded back
+into the device total (``total += new - old``) in dispatch order. Residuals
+are computed on the driver thread at dispatch time and reconciliation is
+FIFO, so the trajectory is deterministic for a given ``staleness``;
+``staleness=0`` reconciles everything before each dispatch and is
+bitwise-identical to sync (the solve merely runs on a worker thread). A
+full reconciliation barrier ends every outer iteration, so the plane never
+lags across iterations.
 """
 
 from __future__ import annotations
@@ -37,6 +50,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -44,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.algorithm.coordinate import Coordinate
+from photon_ml_tpu.algorithm.schedule import SCHEDULES, ScheduleExecutor
 from photon_ml_tpu.evaluation.evaluators import nan_aware_better_than
 from photon_ml_tpu.opt.tracking import TransferStats
 from photon_ml_tpu.telemetry import note_jit_trace, span
@@ -100,6 +115,8 @@ class CoordinateDescent:
         validation_better_than: Optional[Callable[[float, float], bool]] = None,
         emitter: Optional[object] = None,
         score_plane: str = "device",
+        schedule: str = "sync",
+        staleness: int = 1,
     ) -> None:
         if not coordinates:
             raise ValueError("need at least one coordinate")
@@ -107,6 +124,12 @@ class CoordinateDescent:
             raise ValueError(
                 f"score_plane must be one of {SCORE_PLANES}, got {score_plane!r}"
             )
+        if schedule not in SCHEDULES:
+            raise ValueError(
+                f"schedule must be one of {SCHEDULES}, got {schedule!r}"
+            )
+        if int(staleness) < 0:
+            raise ValueError(f"staleness must be >= 0, got {staleness}")
         self.coordinates = coordinates
         self.num_rows = num_rows
         self.update_order = list(update_order) if update_order else list(coordinates)
@@ -127,10 +150,27 @@ class CoordinateDescent:
         # a TransferStatsEvent per outer iteration
         self.emitter = emitter
         self.score_plane = score_plane
+        # pipelined coordinate solves with bounded staleness; requires the
+        # device plane (the host plane's numpy algebra is driver-owned), so
+        # async over a host plane falls back to the sync loop at run time
+        self.schedule = schedule
+        self.staleness = int(staleness)
         # transfer accounting of the most recent (or in-flight) run
         self.transfer_stats = TransferStats(
             score_plane=score_plane, num_rows=num_rows
         )
+
+    def _effective_schedule(self) -> str:
+        """Async needs device-resident score algebra; a host-plane run
+        (chosen directly or forced by multi-controller) drops to sync."""
+        if self.schedule == "async" and self.score_plane != "device":
+            logger.warning(
+                "schedule='async' requires the device score plane; "
+                "falling back to the sync schedule on the %r plane",
+                self.score_plane,
+            )
+            return "sync"
+        return self.schedule
 
     def _emit_solver_stats(self, cid: str, coord: Coordinate) -> None:
         stats = getattr(coord, "last_solver_stats", None)
@@ -188,13 +228,16 @@ class CoordinateDescent:
         checkpoint-resume: the callback fires after each outer iteration with
         the running result; resume passes the restored models and best-so-far
         back in and skips completed iterations."""
+        schedule = self._effective_schedule()
         with span(
             "cd/run",
             score_plane=self.score_plane,
             num_rows=self.num_rows,
             iterations=num_iterations,
+            schedule=schedule,
         ):
-            return self._run(
+            run = self._run_async if schedule == "async" else self._run
+            return run(
                 num_iterations,
                 initial_models,
                 start_iteration,
@@ -384,6 +427,198 @@ class CoordinateDescent:
                             validation_history=list(validation_history),
                         ),
                     )
+
+        logger.info("CD %s", stats.to_summary_string())
+        if self.validate is None or not best_models:
+            best_models = dict(models)
+        return CoordinateDescentResult(
+            models=models,
+            best_models=best_models,
+            best_metric=best_metric,
+            objective_history=objective_history,
+            validation_history=validation_history,
+        )
+
+    # ------------------------------------------------------------- async
+    def _solve_in_flight(self, coord, model0, residual, stats, lock):
+        """Worker-thread body of one dispatched coordinate solve: train
+        against the (possibly stale) residual and rescore. Runs inside the
+        executor's ``cd/overlap`` span; touches no driver-owned state —
+        transfer counters are the only shared mutation, taken under the
+        driver's lock with the same accounting as the sync device path."""
+        if coord.supports_device_plane:
+            model = coord.update_model_device(model0, residual)
+            new_own = coord.score_device(model)
+        else:
+            with lock:
+                stats.record_d2h()
+            model = coord.update_model(model0, np.asarray(residual))
+            with lock:
+                stats.record_d2h()
+                stats.record_h2d()
+            new_own = coord.score_device(model)
+        return model, new_own
+
+    def _run_async(
+        self,
+        num_iterations: int,
+        initial_models: Optional[Dict[str, object]],
+        start_iteration: int,
+        initial_best: Optional[Tuple[Dict[str, object], float]],
+        on_iteration_end: Optional[Callable[[int, "CoordinateDescentResult"], None]],
+    ) -> CoordinateDescentResult:
+        """Bounded-staleness pipelined schedule over the device plane.
+
+        Per outer iteration, each coordinate's residual is computed on the
+        driver from the CURRENT running total — which may still be missing
+        the deltas of up to ``staleness`` unreconciled solves — and the
+        solve is dispatched to the worker pool. Before every dispatch the
+        driver reconciles down to the staleness bound (FIFO), folding each
+        finished solve into the total (``total += new - old``) and
+        recording its objective/validation entry at that point, so the
+        histories keep the sync loop's one-entry-per-update structure. A
+        full drain ends each iteration: the next iteration never sees a
+        stale plane.
+        """
+        stats = self.transfer_stats = TransferStats(
+            score_plane=self.score_plane, num_rows=self.num_rows
+        )
+        stats_lock = threading.Lock()
+        models: Dict[str, object] = dict(initial_models or {})
+        scores: Dict[str, object] = {}
+
+        apply_, residual_ = _plane_programs()
+        zeros = jnp.zeros(self.num_rows, dtype=jnp.float32)
+        total = jnp.zeros_like(zeros)
+
+        # initial scoring for warm-started models (same path as sync)
+        for cid, model in models.items():
+            coord = self.coordinates[cid]
+            if not coord.supports_device_plane:
+                stats.record_d2h()
+                stats.record_h2d()
+            scores[cid] = coord.score_device(model)
+            total = total + scores[cid]
+
+        objective_history: List[Tuple[str, float]] = []
+        validation_history: List[Tuple[str, float]] = []
+        best_metric: Optional[float] = None
+        best_models: Dict[str, object] = {}
+        if initial_best is not None:
+            best_models, best_metric = dict(initial_best[0]), initial_best[1]
+
+        # pending: (cid, old_own, in-flight work) in dispatch order
+        pending: List[Tuple[str, object, object]] = []
+        executor = ScheduleExecutor(
+            max_in_flight=min(len(self.update_order), self.staleness + 1),
+            name="cd-async",
+        )
+
+        def _reconcile_one(outer: int) -> None:
+            nonlocal total, best_metric, best_models
+            cid, old_own, work = pending.pop(0)
+            coord = self.coordinates[cid]
+            with span(
+                "cd/reconcile", device_sync=True, coordinate=cid, outer=outer
+            ):
+                model, new_own = work.result()
+                models[cid] = model
+                total = apply_(
+                    total, new_own, old_own if old_own is not None else zeros
+                )
+                stats.device_plane_updates += 1
+                scores[cid] = new_own
+            self._emit_solver_stats(cid, coord)
+
+            if self.training_objective is not None:
+                with span("cd/objective", coordinate=cid, outer=outer):
+                    loss_val = float(self.training_objective(total))
+                    if self.regularization_term is not None:
+                        reg = float(self.regularization_term(models))
+                        obj = loss_val + reg
+                        objective_history.append((cid, obj))
+                        logger.info(
+                            "CD iter %d coordinate %s: loss %.6f + "
+                            "regularization %.6f = objective %.6f",
+                            outer, cid, loss_val, reg, obj,
+                        )
+                    else:
+                        objective_history.append((cid, loss_val))
+                        logger.info(
+                            "CD iter %d coordinate %s: training "
+                            "objective %.6f",
+                            outer, cid, loss_val,
+                        )
+            if self.validate is not None:
+                with span("cd/validate", coordinate=cid, outer=outer):
+                    metric = float(self.validate(models))
+                    validation_history.append((cid, metric))
+                    logger.info(
+                        "CD iter %d coordinate %s: validation %.6f",
+                        outer, cid, metric,
+                    )
+                    if all(c in models for c in self.update_order) and (
+                        best_metric is None
+                        or self.validation_better_than(metric, best_metric)
+                    ):
+                        best_metric = metric
+                        best_models = dict(models)
+
+        try:
+            for outer in range(start_iteration, num_iterations):
+                with span("cd/outer_iter", outer=outer, schedule="async"):
+                    prev_transfers = stats.snapshot()
+                    for cid in self.update_order:
+                        # bound the lag BEFORE dispatch: at most `staleness`
+                        # unreconciled updates may be missing from the
+                        # residual this coordinate trains against
+                        while len(pending) > self.staleness:
+                            _reconcile_one(outer)
+                        coord = self.coordinates[cid]
+                        stats.coordinate_updates += 1
+                        old_own = scores.get(cid)
+                        residual = residual_(
+                            total, old_own if old_own is not None else zeros
+                        )
+                        work = executor.submit(
+                            cid,
+                            functools.partial(
+                                self._solve_in_flight,
+                                coord,
+                                models.get(cid),
+                                residual,
+                                stats,
+                                stats_lock,
+                            ),
+                            span_name="cd/overlap",
+                            coordinate=cid,
+                            outer=outer,
+                        )
+                        pending.append((cid, old_own, work))
+                    # iteration barrier: fold everything before the next
+                    # outer iteration (the plane lags within an iteration
+                    # only)
+                    while pending:
+                        _reconcile_one(outer)
+
+                    self._emit_transfer_stats(outer, prev_transfers)
+                    if on_iteration_end is not None:
+                        on_iteration_end(
+                            outer,
+                            CoordinateDescentResult(
+                                models=dict(models),
+                                best_models=(
+                                    dict(best_models)
+                                    if best_models
+                                    else dict(models)
+                                ),
+                                best_metric=best_metric,
+                                objective_history=list(objective_history),
+                                validation_history=list(validation_history),
+                            ),
+                        )
+        finally:
+            executor.shutdown(wait=True)
 
         logger.info("CD %s", stats.to_summary_string())
         if self.validate is None or not best_models:

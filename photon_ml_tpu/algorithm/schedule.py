@@ -1,0 +1,146 @@
+"""Overlapped coordinate-descent schedule: the in-flight work executor.
+
+The sync CD driver serializes every coordinate update — the FE solve, each
+RE bucket round, and the residual-plane algebra each wait for the previous
+step, so the device idles through every host-driven phase boundary the
+telemetry can now measure. The async schedule pipelines that work instead:
+solves are dispatched onto a small worker pool and reconciled into the
+device score plane in dispatch order, with a ``staleness`` bound on how
+many unreconciled updates a dispatch may ignore.
+
+:class:`ScheduleExecutor` is the piece both overlap sites share (the CD
+driver's coordinate pipeline and ``train_random_effects``'s bucket
+overlap). It is a thin wrapper over :class:`~concurrent.futures.
+ThreadPoolExecutor` that adds the two things a telemetry-instrumented
+training loop needs:
+
+* **contextvar propagation** — the dispatching thread's context is copied
+  at submit time (:func:`contextvars.copy_context`), so spans opened inside
+  the worker parent under the span that was live at the dispatch site
+  (``cd/outer_iter``, ``re/train``, …) instead of floating as roots;
+* **overlap spans** — every unit of work runs inside its own span (default
+  name ``cd/overlap``) carrying the submit attrs, so ``analyze_run`` can
+  attribute concurrent wall-clock per coordinate/bucket.
+
+Determinism note: the executor itself imposes no ordering — callers get it
+by construction. The CD driver computes every residual on the driver
+thread *at dispatch time* and folds results back in dispatch order, so the
+trained trajectory depends only on the ``staleness`` bound, never on
+thread timing; RE bucket solves are mutually independent, so any
+completion order yields bitwise-identical per-bucket results.
+"""
+from __future__ import annotations
+
+import collections
+import contextvars
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Deque, Optional
+
+from photon_ml_tpu.telemetry import span
+
+__all__ = ["SCHEDULES", "InFlight", "ScheduleExecutor"]
+
+# The CD schedule axis. "sync" is the default and follows today's strictly
+# sequential trajectory bitwise; "async" pipelines solves with bounded
+# staleness (device score plane only — multi-controller runs force sync
+# exactly like they force the host score plane).
+SCHEDULES = ("sync", "async")
+
+
+class InFlight:
+    """One dispatched unit of work: the submit key plus its future."""
+
+    __slots__ = ("key", "future", "attrs")
+
+    def __init__(self, key: Any, future: Future, attrs: dict):
+        self.key = key
+        self.future = future
+        self.attrs = attrs
+
+    def done(self) -> bool:
+        return self.future.done()
+
+    def result(self) -> Any:
+        """Block until the work completes. Worker exceptions re-raise here,
+        on the thread that reconciles the result."""
+        return self.future.result()
+
+
+class ScheduleExecutor:
+    """Bounded worker pool owning the in-flight queue of an overlapped run.
+
+    ``max_in_flight`` caps both the pool width and therefore how many
+    solves can make progress concurrently; callers additionally bound the
+    *unreconciled* count (the staleness window) on their side.
+    """
+
+    def __init__(self, max_in_flight: int = 2, name: str = "cd-sched"):
+        if max_in_flight < 1:
+            raise ValueError(
+                f"max_in_flight must be >= 1, got {max_in_flight}"
+            )
+        self.max_in_flight = max_in_flight
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_in_flight, thread_name_prefix=name
+        )
+        self._queue: Deque[InFlight] = collections.deque()
+
+    # ----------------------------------------------------------- dispatch
+    def submit(
+        self,
+        key: Any,
+        fn: Callable[[], Any],
+        span_name: str = "cd/overlap",
+        **attrs: Any,
+    ) -> InFlight:
+        """Dispatch ``fn`` onto the pool inside a ``span_name`` span.
+
+        The *current* contextvars context — including the live telemetry
+        span — is captured here, on the dispatching thread, and entered in
+        the worker; the overlap span (and everything ``fn`` opens inside
+        it) therefore chains under the span that was open at the call
+        site.
+        """
+        ctx = contextvars.copy_context()
+
+        def _run() -> Any:
+            def _in_span() -> Any:
+                with span(span_name, **attrs):
+                    return fn()
+
+            return ctx.run(_in_span)
+
+        work = InFlight(key, self._pool.submit(_run), dict(attrs))
+        self._queue.append(work)
+        return work
+
+    # -------------------------------------------------------------- queue
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    def oldest(self) -> Optional[InFlight]:
+        return self._queue[0] if self._queue else None
+
+    def pop_oldest(self) -> InFlight:
+        """Remove and return the oldest in-flight work (FIFO — the
+        reconciliation order of the bounded-staleness schedule)."""
+        return self._queue.popleft()
+
+    def drain(self) -> list:
+        """Block until every queued work item completes; returns their
+        results in dispatch order and empties the queue."""
+        out = []
+        while self._queue:
+            out.append(self._queue.popleft().result())
+        return out
+
+    # ---------------------------------------------------------- lifecycle
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait)
+
+    def __enter__(self) -> "ScheduleExecutor":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown(wait=True)
+        return False

@@ -178,9 +178,21 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="RunReport JSON from analyze_run; when given, trial "
                         "candidates come from the offline tuner's proposal "
                         "instead of ladder neighbors")
+    p.add_argument("--schedule", default="sync", choices=("sync", "async"),
+                   help="coordinate-descent schedule: 'sync' (sequential, "
+                        "bitwise-reproducible default) or 'async' "
+                        "(bounded-staleness pipelined FE/RE solves on the "
+                        "device score plane; multi-controller runs fall "
+                        "back to sync)")
+    p.add_argument("--staleness", type=int, default=1,
+                   help="async schedule only: max unreconciled coordinate "
+                        "updates a dispatch may ignore (0 = serialize, "
+                        "bitwise equal to sync)")
     p.add_argument("--log-file", default=None)
     add_telemetry_args(p)
     args = p.parse_args(argv)
+    if args.staleness < 0:
+        p.error("--staleness must be >= 0")
     if args.parallel_data < 0 or args.parallel_feat < 1:
         p.error("--parallel-data must be >= 0 and --parallel-feat >= 1")
     if args.parallel_data == 0 and args.parallel_feat != 1:
@@ -583,6 +595,8 @@ def run(args: argparse.Namespace) -> GameFit:
             intercept_indices={k: v for k, v in intercept_indices.items() if v is not None},
             parallel=parallel,
             compute_variance=False,  # trials skip variances; the real fit below opts in
+            schedule=args.schedule,
+            staleness=args.staleness,
         )
 
         tuned_config: Dict[str, object] = {}

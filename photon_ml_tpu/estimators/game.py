@@ -30,6 +30,7 @@ from photon_ml_tpu.algorithm.coordinate_descent import (
     SCORE_PLANES,
     CoordinateDescent,
 )
+from photon_ml_tpu.algorithm.schedule import SCHEDULES
 from photon_ml_tpu.algorithm.factored_random_effect import (
     FactoredRandomEffectCoordinate,
     MFOptimizationConfiguration,
@@ -194,6 +195,8 @@ class GameEstimator:
         compute_variance: bool = False,
         emitter: Optional[object] = None,
         score_plane: str = "device",
+        schedule: str = "sync",
+        staleness: int = 1,
     ) -> None:
         """``normalization``/``intercept_indices`` are per-feature-shard;
         they apply to fixed-effect coordinates (training runs in normalized
@@ -234,6 +237,18 @@ class GameEstimator:
                 f"score_plane must be one of {SCORE_PLANES}, got {score_plane!r}"
             )
         self.score_plane = score_plane
+        # CD schedule: "sync" (default, bitwise-identical trajectories) or
+        # "async" (bounded-staleness pipelined solves + RE bucket overlap on
+        # the device plane). Multi-controller runs force sync, exactly like
+        # they force the host score plane.
+        if schedule not in SCHEDULES:
+            raise ValueError(
+                f"schedule must be one of {SCHEDULES}, got {schedule!r}"
+            )
+        if int(staleness) < 0:
+            raise ValueError(f"staleness must be >= 0, got {staleness}")
+        self.schedule = schedule
+        self.staleness = int(staleness)
         # per-bucket SolverStats from the most recent resolve_coordinate call
         self.last_resolve_stats: list = []
         # TransferStats from the most recent _run_fit / resolve_coordinate
@@ -248,6 +263,15 @@ class GameEstimator:
         if jax.process_count() > 1:
             return "host"
         return self.score_plane
+
+    def _effective_schedule(self) -> str:
+        """The async schedule pipelines eager per-row updates on the device
+        score plane; under multi-controller (or whenever the effective
+        plane is the host one) the sync loop's single global dispatch order
+        is required, so async falls back to sync."""
+        if self.schedule == "async" and self._effective_score_plane() != "device":
+            return "sync"
+        return self.schedule
 
     def _build_coordinate(
         self, cid: str, cfg: CoordinateConfiguration, data: GameData
@@ -732,6 +756,15 @@ class GameEstimator:
                     )
                 return primary
 
+        schedule = self._effective_schedule()
+        # the async schedule's RE leg: overlap bucket solves inside each
+        # random-effect coordinate (0 restores the sequential, bitwise-
+        # identical path — set every run so shared built coordinates are
+        # correct for whichever schedule this fit uses)
+        for coord in coordinates.values():
+            if hasattr(coord, "overlap_buckets"):
+                coord.overlap_buckets = 2 if schedule == "async" else 0
+
         cd = CoordinateDescent(
             coordinates,
             num_rows=data.num_rows,
@@ -742,6 +775,8 @@ class GameEstimator:
             validation_better_than=self.evaluator.better_than,
             emitter=self.emitter,
             score_plane=self._effective_score_plane(),
+            schedule=schedule,
+            staleness=self.staleness,
         )
 
         start_iteration = 0

@@ -43,6 +43,21 @@ class GlmFit:
     tracked_models: Optional[List[GeneralizedLinearModel]] = None
 
 
+def block_on_fit(fit: GlmFit) -> GlmFit:
+    """Block until the fit's arrays are computed. ``train_glm`` returns
+    unblocked pytrees (the async CD schedule relies on that to overlap the
+    FE solve with RE work); timing and reconciliation code that needs the
+    solve to have actually finished waits here."""
+    import jax
+
+    jax.block_until_ready(
+        [leaf for leaf in jax.tree_util.tree_leaves(
+            (fit.model, fit.result)
+        ) if isinstance(leaf, jax.Array)]
+    )
+    return fit
+
+
 def train_glm(
     data: LabeledData,
     task: TaskType,

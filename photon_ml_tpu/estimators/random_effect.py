@@ -349,6 +349,7 @@ def train_random_effects(
     initial_model: Optional[RandomEffectModel] = None,
     compute_variances: bool = False,
     stats_out: Optional[List[SolverStats]] = None,
+    overlap_buckets: int = 0,
 ) -> tuple[RandomEffectModel, List[SolveResult]]:
     """Solve one GLM per entity (all buckets). Returns the model and the
     per-bucket vmap'd SolveResults (per-entity convergence telemetry — the
@@ -359,6 +360,16 @@ def train_random_effects(
     sharded buckets and buckets at/below ``adaptive.min_lanes`` fall back to
     the one-shot lockstep dispatch, whose results are identical. If
     ``stats_out`` is given, one :class:`SolverStats` per bucket is appended.
+
+    ``overlap_buckets >= 2`` overlaps that many bucket solves on worker
+    threads (the async CD schedule's RE leg): while one bucket's adaptive
+    driver blocks on its converged-mask pull or runs host-side lane
+    compaction bookkeeping, another bucket's chunk dispatches keep the
+    device busy. Bucket solves are mutually independent and the programs
+    come from the same pow2 registry, so per-bucket results are
+    bitwise-identical to the sequential path and no new retraces are
+    introduced. Sharded (multi-device) buckets force the sequential path —
+    collectives must be issued in one global order.
     """
     progs = _re_programs(task, configuration, compute_variances)
     adaptive = configuration.adaptive
@@ -366,40 +377,91 @@ def train_random_effects(
 
     l2 = jnp.float32(configuration.l2_weight)
     l1 = jnp.float32(configuration.l1_weight)
-    coeffs, variances, results = [], [], []
-    for b, bucket in enumerate(dataset.buckets):
+
+    def _warm_start(b, bucket):
         if initial_model is not None:
-            w0 = _fit_entity_axis(
+            return _fit_entity_axis(
                 initial_model.coefficients[b], bucket.num_entities
             )
-        else:
-            w0 = jnp.zeros((bucket.num_entities, bucket.local_dim), dtype=jnp.float32)
-        use_adaptive = (
-            adaptive.enabled
-            and bucket.num_entities > adaptive.min_lanes
-            and not _is_multi_device(bucket.X)
+        return jnp.zeros(
+            (bucket.num_entities, bucket.local_dim), dtype=jnp.float32
         )
-        with span(
-            "re/solve_bucket",
-            device_sync=True,
-            bucket=b,
-            mode="adaptive" if use_adaptive else "oneshot",
-            entities=bucket.num_entities,
-            optimizer=progs.kind,
-        ):
-            if use_adaptive:
-                res, w, var, stats = _solve_bucket_adaptive(
-                    progs, bucket, w0, l2, l1, max_iter, adaptive.min_lanes, b
+
+    def _solve_one(b, bucket, w0, use_adaptive):
+        if use_adaptive:
+            return _solve_bucket_adaptive(
+                progs, bucket, w0, l2, l1, max_iter, adaptive.min_lanes, b
+            )
+        return _solve_bucket_oneshot(progs, bucket, w0, l2, l1, b)
+
+    use_adaptive_by_bucket = [
+        adaptive.enabled
+        and bucket.num_entities > adaptive.min_lanes
+        and not _is_multi_device(bucket.X)
+        for bucket in dataset.buckets
+    ]
+    overlap = (
+        int(overlap_buckets) >= 2
+        and len(dataset.buckets) > 1
+        and not any(_is_multi_device(b.X) for b in dataset.buckets)
+    )
+
+    coeffs, variances, results = [], [], []
+    if overlap:
+        # lazy import: algorithm.coordinate imports this module at its top,
+        # so a module-level import back into algorithm.* could deadlock the
+        # partially-initialized package on first touch
+        from photon_ml_tpu.algorithm.schedule import ScheduleExecutor
+
+        solved = []
+        with ScheduleExecutor(
+            max_in_flight=min(int(overlap_buckets), len(dataset.buckets)),
+            name="re-buckets",
+        ) as executor:
+            for b, bucket in enumerate(dataset.buckets):
+                # warm-start layout on the driver; only the solve overlaps
+                w0 = _warm_start(b, bucket)
+                solved.append(
+                    executor.submit(
+                        b,
+                        functools.partial(
+                            _solve_one, b, bucket, w0, use_adaptive_by_bucket[b]
+                        ),
+                        span_name="re/solve_bucket",
+                        bucket=b,
+                        mode=(
+                            "adaptive" if use_adaptive_by_bucket[b] else "oneshot"
+                        ),
+                        entities=bucket.num_entities,
+                        optimizer=progs.kind,
+                        overlap=True,
+                    )
                 )
-            else:
-                res, w, var, stats = _solve_bucket_oneshot(
-                    progs, bucket, w0, l2, l1, b
-                )
-        coeffs.append(w)
-        variances.append(var)
-        results.append(res)
-        if stats_out is not None:
-            stats_out.append(stats)
+            bucket_outs = [work.result() for work in solved]
+        for res, w, var, stats in bucket_outs:
+            coeffs.append(w)
+            variances.append(var)
+            results.append(res)
+            if stats_out is not None:
+                stats_out.append(stats)
+    else:
+        for b, bucket in enumerate(dataset.buckets):
+            w0 = _warm_start(b, bucket)
+            use_adaptive = use_adaptive_by_bucket[b]
+            with span(
+                "re/solve_bucket",
+                device_sync=True,
+                bucket=b,
+                mode="adaptive" if use_adaptive else "oneshot",
+                entities=bucket.num_entities,
+                optimizer=progs.kind,
+            ):
+                res, w, var, stats = _solve_one(b, bucket, w0, use_adaptive)
+            coeffs.append(w)
+            variances.append(var)
+            results.append(res)
+            if stats_out is not None:
+                stats_out.append(stats)
 
     model = RandomEffectModel(
         random_effect_type=dataset.config.random_effect_type,

@@ -158,3 +158,19 @@ def solve_finalize(state, configuration: GlmOptimizationConfiguration) -> SolveR
     if isinstance(state, _LbfgsState):
         return lbfgs_finalize(state, cfg)
     raise TypeError(f"unknown solver state type {type(state).__name__}")
+
+
+def block_on_result(result: SolveResult) -> SolveResult:
+    """Block until every array in ``result`` is device-resident and
+    computed. ``solve``/``solve_finalize`` return unblocked pytrees (XLA
+    dispatch is async), which is what lets the overlapped CD schedule hide
+    a solve behind other work; callers that need completed-by-now
+    semantics — wall-clock measurement, reconciliation barriers — wait
+    here instead of sprinkling ``block_until_ready`` over fields."""
+    import jax
+
+    jax.block_until_ready(
+        [leaf for leaf in jax.tree_util.tree_leaves(result)
+         if isinstance(leaf, jax.Array)]
+    )
+    return result

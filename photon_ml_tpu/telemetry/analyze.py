@@ -6,8 +6,14 @@ one JSONL :class:`~photon_ml_tpu.telemetry.sinks.RunLedger` it
 * reconstructs the span tree (``span_id``/``parent_id`` chains),
 * computes per-phase occupancy — wall-clock attributed to FE solves, RE
   chunked rounds, CD driver algebra, serving, incremental updates, I/O —
-  from per-span **exclusive** time (duration minus direct children, so
-  nothing is double-counted),
+  from per-span **exclusive self-intervals** (a span's own interval minus
+  the union of its direct children's intervals). Concurrent spans — the
+  async CD schedule runs FE and RE solves on overlapping wall-clock — are
+  shared via a sweep-line: a segment where k spans are simultaneously open
+  contributes 1/k of its length to each span's phase, so phase ``seconds``
+  always sum to wall-clock actually covered (coverage stays <= ~1), while
+  the full per-phase busy time and the concurrency win are reported
+  separately as ``busy_s`` and ``overlap_s = busy_s - seconds``,
 * accounts the **bubbles**: driver-thread gaps where no span was open are
   attributed explicitly as host driver time, so the report sums to the
   measured wall-clock instead of silently dropping it,
@@ -84,13 +90,19 @@ class RunReport:
     """Structured result of replaying one run ledger.
 
     ``phases`` maps each phase bucket to ``{"seconds", "spans",
-    "fraction"}`` where seconds are exclusive span time. ``bubble_s`` is
-    wall-clock inside the run window covered by NO span (host driver gaps
-    between instrumented regions) — it is attributed, not dropped, so
-    ``attributed_s = Σ phases + bubble_s`` and ``coverage =
-    attributed_s / wall_clock_s`` should sit near 1.0; a value much below
-    1 means uninstrumented time, much above 1 means concurrent span trees
-    double-counting against a single wall-clock.
+    "fraction", "busy_s", "overlap_s"}``. ``seconds`` is exclusive span
+    time with concurrent segments SHARED across the open spans (a segment
+    where k spans are open contributes 1/k to each), so phase seconds sum
+    to covered wall-clock even under the async schedule's overlapped span
+    trees. ``busy_s`` is the phase's full (unshared) exclusive time and
+    ``overlap_s = busy_s - seconds`` is the wall-clock the phase spent
+    running concurrently with other spans — the async schedule's win shows
+    up here. ``bubble_s`` is wall-clock inside the run window covered by
+    NO span (host driver gaps between instrumented regions) — it is
+    attributed, not dropped, so ``attributed_s = Σ phases + bubble_s``
+    and ``coverage = attributed_s / wall_clock_s`` should sit near 1.0
+    regardless of concurrency; much below 1 means uninstrumented time.
+    ``overlap_s`` (report level) totals the per-phase overlap.
     """
 
     label: str
@@ -110,6 +122,7 @@ class RunReport:
     events: Dict[str, int]
     metrics: Dict[str, Any]
     warnings: List[str] = dataclasses.field(default_factory=list)
+    overlap_s: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -125,6 +138,11 @@ class RunReport:
 
     def phase_fraction(self, phase: str) -> float:
         return float(self.phases.get(phase, {}).get("fraction", 0.0))
+
+    def phase_overlap(self, phase: str) -> float:
+        """Wall-clock this phase spent overlapped with other open spans
+        (0.0 for sequential runs and for reports from older ledgers)."""
+        return float(self.phases.get(phase, {}).get("overlap_s", 0.0))
 
     def metric(self, name: str) -> Optional[float]:
         """Look a flat metric name up across the snapshot's counters,
@@ -142,18 +160,50 @@ class RunReport:
         return None
 
 
+def _merge_intervals(
+    intervals: Sequence[Tuple[float, float]]
+) -> List[Tuple[float, float]]:
+    """The union of [start, end) intervals as a sorted, disjoint list."""
+    out: List[Tuple[float, float]] = []
+    for start, end in sorted(intervals):
+        if end <= start:
+            continue
+        if out and start <= out[-1][1]:
+            if end > out[-1][1]:
+                out[-1] = (out[-1][0], end)
+        else:
+            out.append((start, end))
+    return out
+
+
 def _merged_coverage(intervals: Sequence[Tuple[float, float]]) -> float:
     """Total length of the union of [start, end) intervals."""
-    total = 0.0
-    last_end = None
-    for start, end in sorted(intervals):
-        if last_end is None or start > last_end:
-            total += max(0.0, end - start)
-            last_end = end
-        elif end > last_end:
-            total += end - last_end
-            last_end = end
-    return total
+    return sum(end - start for start, end in _merge_intervals(intervals))
+
+
+def _subtract_intervals(
+    own: Tuple[float, float], children: Sequence[Tuple[float, float]]
+) -> List[Tuple[float, float]]:
+    """``own`` minus the union of ``children`` (clipped to ``own``): a
+    span's exclusive SELF time as intervals rather than a scalar, so a
+    parent whose concurrent children together outlast it still nets out at
+    zero instead of going negative or double-counting."""
+    s, e = own
+    out: List[Tuple[float, float]] = []
+    cursor = s
+    for cs, ce in _merge_intervals(children):
+        if ce <= cursor:
+            continue
+        if cs >= e:
+            break
+        if cs > cursor:
+            out.append((cursor, min(cs, e)))
+        cursor = max(cursor, ce)
+        if cursor >= e:
+            break
+    if cursor < e:
+        out.append((cursor, e))
+    return out
 
 
 def _span_tree_summary(spans: List[dict], max_depth: int = 2) -> Dict[str, dict]:
@@ -246,19 +296,76 @@ def analyze_records(
             warnings.append("no start record; wall-clock is the span extent")
 
     # ---- per-phase exclusive occupancy ---------------------------------
+    # Self-intervals (own interval minus the union of direct children),
+    # then a sweep-line: a segment where k self-intervals are open gives
+    # each phase its full length as busy_s but only length/k as seconds —
+    # so concurrent span trees (the async CD schedule) never double-count
+    # against wall-clock, and the concurrency win is explicit overlap_s.
     phases: Dict[str, Dict[str, float]] = {
-        p: {"seconds": 0.0, "spans": 0, "fraction": 0.0} for p in PHASES
+        p: {
+            "seconds": 0.0, "spans": 0, "fraction": 0.0,
+            "busy_s": 0.0, "overlap_s": 0.0,
+        }
+        for p in PHASES
     }
     failed = 0
+    have_starts = all("start_unix" in r for r in spans)
     for rec in spans:
-        dur = float(rec.get("duration_s", 0.0))
-        sid = rec.get("span_id")
-        child = children_dur.get(int(sid), 0.0) if sid is not None else 0.0
-        exclusive = max(0.0, dur - child)
-        bucket = phases[classify_span(str(rec.get("name", "")))]
-        bucket["seconds"] += exclusive
-        bucket["spans"] += 1
+        phases[classify_span(str(rec.get("name", "")))]["spans"] += 1
         failed += int(bool(rec.get("failed")))
+
+    if have_starts and spans:
+        children_iv: Dict[int, List[Tuple[float, float]]] = {}
+        for rec in spans:
+            pid = rec.get("parent_id")
+            if pid is not None:
+                s = float(rec["start_unix"])
+                children_iv.setdefault(int(pid), []).append(
+                    (s, s + float(rec.get("duration_s", 0.0)))
+                )
+        # boundary events over every span's self-intervals
+        edges: List[Tuple[float, int, str]] = []
+        for rec in spans:
+            s = float(rec["start_unix"])
+            own = (s, s + float(rec.get("duration_s", 0.0)))
+            sid = rec.get("span_id")
+            kids = children_iv.get(int(sid), []) if sid is not None else []
+            phase = classify_span(str(rec.get("name", "")))
+            for a, b in _subtract_intervals(own, kids):
+                edges.append((a, 1, phase))
+                edges.append((b, -1, phase))
+        edges.sort(key=lambda e: (e[0], e[1]))
+        active: Dict[str, int] = {}
+        k = 0
+        prev_t: Optional[float] = None
+        for t, delta, phase in edges:
+            if prev_t is not None and k > 0 and t > prev_t:
+                seg = t - prev_t
+                for ph, cnt in active.items():
+                    if cnt:
+                        phases[ph]["busy_s"] += seg * cnt
+                        phases[ph]["seconds"] += seg * cnt / k
+            active[phase] = active.get(phase, 0) + delta
+            k += delta
+            prev_t = t
+        for p in phases.values():
+            p["overlap_s"] = max(0.0, p["busy_s"] - p["seconds"])
+    else:
+        # legacy ledgers without start_unix: scalar exclusive time (no
+        # interval data to share concurrency with)
+        if spans and not have_starts:
+            warnings.append(
+                "span records lack start_unix; exclusive time computed "
+                "per-span (concurrent spans may double-count)"
+            )
+        for rec in spans:
+            dur = float(rec.get("duration_s", 0.0))
+            sid = rec.get("span_id")
+            child = children_dur.get(int(sid), 0.0) if sid is not None else 0.0
+            exclusive = max(0.0, dur - child)
+            bucket = phases[classify_span(str(rec.get("name", "")))]
+            bucket["seconds"] += exclusive
+            bucket["busy_s"] += exclusive
 
     # ---- bubble accounting ---------------------------------------------
     # gaps inside the run window covered by NO root span = host driver
@@ -276,12 +383,15 @@ def analyze_records(
     bubble = max(0.0, wall - covered)
 
     span_total = sum(p["seconds"] for p in phases.values())
+    overlap_total = sum(p["overlap_s"] for p in phases.values())
     attributed = span_total + bubble
     coverage = attributed / wall if wall > 0 else 0.0
     for p in phases.values():
         p["fraction"] = (p["seconds"] / wall) if wall > 0 else 0.0
         p["seconds"] = round(p["seconds"], 6)
         p["fraction"] = round(p["fraction"], 6)
+        p["busy_s"] = round(p["busy_s"], 6)
+        p["overlap_s"] = round(p["overlap_s"], 6)
 
     # ---- joins ----------------------------------------------------------
     event_counts: Dict[str, int] = {}
@@ -353,6 +463,7 @@ def analyze_records(
         events=event_counts,
         metrics=snapshot,
         warnings=warnings,
+        overlap_s=round(overlap_total, 6),
     )
 
 
@@ -389,15 +500,22 @@ def format_report(report: RunReport) -> str:
         key=lambda kv: -kv[1]["seconds"],
     )
     for phase, v in rows:
+        overlap = float(v.get("overlap_s", 0.0) or 0.0)
         lines.append(
             f"  {phase:<12} {v['seconds']:>10.4f} {v['fraction'] * 100:>7.2f}% "
             f"{int(v['spans']):>7d}"
+            + (f"   overlap {overlap:.4f}s" if overlap > 0 else "")
         )
     lines.append(
         f"  {'(bubbles)':<12} {report.bubble_s:>10.4f} "
         f"{(report.bubble_s / report.wall_clock_s * 100 if report.wall_clock_s else 0):>7.2f}%"
         f" {'—':>7}"
     )
+    if report.overlap_s > 0:
+        lines.append(
+            f"  overlapped      {report.overlap_s:10.4f}s of concurrent span "
+            "time shared across phases (busy − attributed)"
+        )
     if report.solver:
         s = report.solver
         lines += [

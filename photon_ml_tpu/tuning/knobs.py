@@ -178,6 +178,48 @@ register_knob(KnobSpec(
 ))
 
 register_knob(KnobSpec(
+    name="train.schedule",
+    kind="str",
+    default="sync",
+    applies_to="train",
+    phase="cd_driver",
+    metric_deps=(
+        "phase:fe_solve",
+        "phase:re_solve",
+        "overlap:fe_solve",
+        "overlap:re_solve",
+    ),
+    candidates=("sync", "async"),
+    description=(
+        "Coordinate-descent schedule. 'async' pipelines FE/RE solves with "
+        "bounded staleness on the device score plane (plus RE bucket "
+        "overlap); worth trying when FE and RE both hold material "
+        "wall-clock and the ledger shows no overlap yet. 'sync' is the "
+        "bitwise-reproducible default and required under multi-controller."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="train.staleness",
+    kind="int",
+    default=1,
+    applies_to="train",
+    phase="cd_driver",
+    metric_deps=(
+        "overlap:fe_solve",
+        "overlap:re_solve",
+        "phase:cd_driver",
+    ),
+    candidates=(0, 1, 2),
+    description=(
+        "Max unreconciled coordinate updates an async dispatch may ignore. "
+        "0 serializes (bitwise equal to sync), higher values overlap more "
+        "solves per iteration at the cost of staler residuals (slower "
+        "per-iteration convergence). Ignored under schedule='sync'."
+    ),
+))
+
+register_knob(KnobSpec(
     name="train.engine",
     kind="str",
     default="auto",

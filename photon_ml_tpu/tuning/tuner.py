@@ -24,13 +24,17 @@ __all__ = ["KnobProposal", "TuningProposal", "propose", "resolve_dep", "ab_candi
 def resolve_dep(report: RunReport, dep: str) -> Optional[float]:
     """Resolve one ``metric_deps`` entry against a report.
 
-    ``phase:<name>`` → phase wall-clock fraction; ``solver:<field>`` →
-    solver-join field; ``metric:<name>`` → registry snapshot lookup;
-    ``jit:<key>`` → retrace count. Missing evidence resolves to None —
-    a knob with no evidence keeps its default."""
+    ``phase:<name>`` → phase wall-clock fraction; ``overlap:<name>`` →
+    phase overlap seconds (concurrent span time — the async schedule's
+    observable); ``solver:<field>`` → solver-join field; ``metric:<name>``
+    → registry snapshot lookup; ``jit:<key>`` → retrace count. Missing
+    evidence resolves to None — a knob with no evidence keeps its
+    default."""
     kind, _, key = dep.partition(":")
     if kind == "phase":
         return report.phase_fraction(key)
+    if kind == "overlap":
+        return report.phase_overlap(key)
     if kind == "solver":
         value = (report.solver or {}).get(key)
         return float(value) if value is not None else None
@@ -187,6 +191,42 @@ def _propose_one(spec: KnobSpec, report: RunReport) -> KnobProposal:
             + (f" (p99 {p99:.2f}ms)" if p99 is not None else "")
             + "; overriding only pays off with a fixed upstream schema"
         )
+
+    elif spec.name == "train.schedule":
+        fe = _f("phase:fe_solve")
+        re_ = _f("phase:re_solve")
+        overlap = _f("overlap:fe_solve") + _f("overlap:re_solve")
+        if overlap > 0:
+            why = (
+                f"ledger already shows {overlap:.2f}s of FE/RE overlap — the "
+                "async schedule is active and pulling its weight"
+            )
+        elif fe >= 0.2 and re_ >= 0.2:
+            value = "async"
+            why = (
+                f"FE ({fe:.0%}) and RE ({re_:.0%}) both hold material "
+                "wall-clock with zero measured overlap — pipelining them "
+                "with bounded staleness can hide one behind the other"
+            )
+        elif fe or re_:
+            why = (
+                f"one side dominates (FE {fe:.0%}, RE {re_:.0%}); "
+                "overlapping buys little, keep the reproducible sync loop"
+            )
+
+    elif spec.name == "train.staleness":
+        overlap = _f("overlap:fe_solve") + _f("overlap:re_solve")
+        share = _f("phase:cd_driver")
+        if overlap > 0:
+            why = (
+                f"async overlap measured at {overlap:.2f}s — staleness "
+                f"{spec.default} is doing its job; step it only via A/B"
+            )
+        elif share:
+            why = (
+                "no overlap evidence yet (sync run?); staleness only acts "
+                "under schedule='async'"
+            )
 
     elif spec.name == "train.engine":
         share = _f("phase:fe_solve")

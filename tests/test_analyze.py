@@ -104,6 +104,42 @@ class TestAccounting:
         assert report.transfers == {"row_transfers_h2d": 4}
         assert report.jit_traces == {"fe_solve": 2}
 
+    def test_concurrent_siblings_split_not_double_counted(self):
+        """Async-schedule ledgers hold sibling spans that genuinely run at
+        the same time. The sweep-line splits each concurrent segment evenly
+        (coverage stays ~1.0 instead of blowing past it) and reports the
+        concurrency as per-phase overlap: fe 1000.5-1006.5 and re
+        1001-1007 share 5.5s; each phase keeps busy_s=6.0 but only 3.25s
+        of attributed wall, with 2.75s each surfaced as overlap_s."""
+        records = [
+            {"type": "meta", "ts": 1000.0, "phase": "start",
+             "label": "overlap"},
+            _span("fe/solve", 2, 1000.5, 6.0, parent=1),
+            _span("re/train", 3, 1001.0, 6.0, parent=1),
+            _span("cd/run", 1, 1000.0, 8.0),
+            {"type": "meta", "ts": 1010.0, "phase": "finish"},
+        ]
+        report = analyze_records(records)
+        assert report.wall_clock_s == pytest.approx(10.0)
+        # each 6s sibling keeps its full busy time...
+        assert report.phases["fe_solve"]["busy_s"] == pytest.approx(6.0)
+        assert report.phases["re_solve"]["busy_s"] == pytest.approx(6.0)
+        # ...but attributed wall splits the shared 5.5s segment two ways:
+        # 0.5s solo + 5.5/2 shared = 3.25s apiece
+        assert report.phase_seconds("fe_solve") == pytest.approx(3.25)
+        assert report.phase_seconds("re_solve") == pytest.approx(3.25)
+        assert report.phase_overlap("fe_solve") == pytest.approx(2.75)
+        assert report.phase_overlap("re_solve") == pytest.approx(2.75)
+        # the root's exclusive tails (1000-1000.5, 1007-1008) have no
+        # concurrency at all
+        assert report.phase_seconds("cd_driver") == pytest.approx(1.5)
+        assert report.phase_overlap("cd_driver") == pytest.approx(0.0)
+        assert report.overlap_s == pytest.approx(5.5)
+        # attribution stays exact: 8s of spans + 2s bubble = the 10s wall
+        assert report.attributed_s == pytest.approx(10.0)
+        assert report.bubble_s == pytest.approx(2.0)
+        assert report.coverage == pytest.approx(1.0)
+
     def test_missing_finish_warns_and_measures_to_last_span(self):
         records = [r for r in _synthetic_records()
                    if not (r["type"] == "meta" and r["phase"] == "finish")]

@@ -1,0 +1,293 @@
+"""Bounded-staleness async CD schedule: sync-identity at staleness=0,
+held-out AUC parity at staleness>0, overlap span attribution across worker
+threads, retrace parity with the sync pow2 registry, and the RE bucket
+overlap leg.
+
+The async schedule's determinism contract: residuals are computed on the
+DRIVER thread at dispatch time and deltas fold back in dispatch order, so
+the trajectory depends only on the ``staleness`` bound — never on thread
+timing. staleness=0 reconciles before every dispatch, which reproduces the
+sync trajectory bitwise; these tests are the oracle for that claim.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.algorithm.schedule import ScheduleExecutor
+from photon_ml_tpu.data.game_data import FeatureShard, GameData
+from photon_ml_tpu.data.random_effect import RandomEffectDataConfiguration
+from photon_ml_tpu.estimators.game import (
+    FixedEffectCoordinateConfiguration,
+    GameEstimator,
+    RandomEffectCoordinateConfiguration,
+)
+from photon_ml_tpu.estimators.random_effect import solver_trace_counts
+from photon_ml_tpu.event import EventEmitter, EventListener, TransferStatsEvent
+from photon_ml_tpu.telemetry.span import disable_tracing, enable_tracing
+from photon_ml_tpu.types import TaskType
+
+N_USERS, N_ITEMS, ROWS_PER_USER = 18, 7, 24
+D_FE, D_RE = 10, 5
+N_OUTER = 3
+
+
+def _problem(seed=0, task=TaskType.LINEAR_REGRESSION, n_users=N_USERS,
+             rows_per_user=ROWS_PER_USER):
+    rng = np.random.default_rng(seed)
+    n = n_users * rows_per_user
+    Xg = rng.normal(size=(n, D_FE)).astype(np.float32)
+    Xu = rng.normal(size=(n, D_RE)).astype(np.float32)
+    Xi = rng.normal(size=(n, D_RE)).astype(np.float32)
+    user_ids = np.repeat([f"u{i:03d}" for i in range(n_users)], rows_per_user)
+    item_ids = np.array([f"i{int(v):03d}" for v in rng.integers(0, N_ITEMS, n)])
+    w = rng.normal(size=D_FE).astype(np.float32)
+    z = Xg @ w + 0.1 * rng.normal(size=n)
+    if task is TaskType.LOGISTIC_REGRESSION:
+        y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    else:
+        y = z.astype(np.float32)
+
+    def coo(X):
+        rows, cols = np.nonzero(X)
+        return FeatureShard(rows=rows, cols=cols, vals=X[rows, cols], dim=X.shape[1])
+
+    return GameData(
+        labels=y,
+        feature_shards={"global": coo(Xg), "per_user": coo(Xu), "per_item": coo(Xi)},
+        id_tags={"userId": user_ids, "itemId": item_ids},
+    )
+
+
+def _coords():
+    return {
+        "fixed": FixedEffectCoordinateConfiguration("global"),
+        "per-user": RandomEffectCoordinateConfiguration(
+            feature_shard="per_user",
+            data=RandomEffectDataConfiguration(random_effect_type="userId"),
+        ),
+        "per-item": RandomEffectCoordinateConfiguration(
+            feature_shard="per_item",
+            data=RandomEffectDataConfiguration(random_effect_type="itemId"),
+        ),
+    }
+
+
+def _fit(data, schedule="sync", staleness=1, plane="device", emitter=None,
+         task=TaskType.LINEAR_REGRESSION, n_outer=N_OUTER):
+    est = GameEstimator(
+        task=task,
+        coordinates=_coords(),
+        num_outer_iterations=n_outer,
+        score_plane=plane,
+        schedule=schedule,
+        staleness=staleness,
+        emitter=emitter,
+    )
+    fit = est.fit(data)
+    return est, fit
+
+
+class _Recorder(EventListener):
+    def __init__(self):
+        self.events = []
+
+    def on_event(self, event):
+        self.events.append(event)
+
+
+def _auc(scores, labels):
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels > 0.5
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+class TestAsyncTrajectories:
+    def test_staleness_zero_bitwise_matches_sync(self):
+        """staleness=0 reconciles before every dispatch: every solve sees
+        the fully-reconciled plane, so the trajectory IS the sync one —
+        identical scores and objective history, not merely close."""
+        data = _problem()
+        _, fit_s = _fit(data, schedule="sync")
+        _, fit_a = _fit(data, schedule="async", staleness=0)
+        ss = np.asarray(fit_s.model.score(data))
+        sa = np.asarray(fit_a.model.score(data))
+        assert np.array_equal(ss, sa)
+        assert [c for c, _ in fit_s.objective_history] == [
+            c for c, _ in fit_a.objective_history
+        ]
+        for (_, os_), (_, oa) in zip(
+            fit_s.objective_history, fit_a.objective_history
+        ):
+            assert os_ == oa
+
+    def test_async_auc_parity_on_holdout(self):
+        """staleness=1 trains against a one-update-stale plane; with enough
+        outer iterations the fit converges to the same quality — held-out
+        AUC within a small tolerance of sync (the async gate)."""
+        task = TaskType.LOGISTIC_REGRESSION
+        data = _problem(task=task)
+        holdout = _problem(seed=5, task=task, rows_per_user=8)
+        _, fit_s = _fit(data, schedule="sync", task=task, n_outer=6)
+        _, fit_a = _fit(
+            data, schedule="async", staleness=1, task=task, n_outer=6
+        )
+        y = np.asarray(holdout.labels)
+        auc_s = _auc(np.asarray(fit_s.model.score(holdout), np.float64), y)
+        auc_a = _auc(np.asarray(fit_a.model.score(holdout), np.float64), y)
+        assert abs(auc_a - auc_s) <= 0.02
+
+    def test_async_histories_and_transfer_stats_structure(self):
+        """Async keeps the sync loop's observable structure: one objective
+        entry per coordinate update, one TransferStatsEvent per outer
+        iteration, and zero row transfers on the device plane."""
+        data = _problem()
+        emitter = EventEmitter()
+        rec = _Recorder()
+        emitter.register_listener(rec)
+        est, fit = _fit(data, schedule="async", staleness=1, emitter=emitter)
+        t = est.last_transfer_stats
+        assert t.score_plane == "device"
+        assert t.coordinate_updates == 3 * N_OUTER
+        assert t.device_plane_updates == 3 * N_OUTER
+        assert t.row_transfers_h2d == 0
+        assert t.row_transfers_d2h == 0
+        assert len(fit.objective_history) == 3 * N_OUTER
+        tevents = [e for e in rec.events if isinstance(e, TransferStatsEvent)]
+        assert len(tevents) == N_OUTER
+        for i, e in enumerate(tevents):
+            assert e.outer_iteration == i
+            assert e.device_plane_updates == 3
+
+    def test_async_no_new_retraces_after_sync_warmup(self):
+        """The async schedule reuses the sync path's pow2 program registry:
+        once a sync fit has compiled every shape, an async fit on the same
+        workload adds NO solver traces."""
+        data = _problem(seed=3)
+        _fit(data, schedule="sync")
+        before = solver_trace_counts()
+        _fit(data, schedule="async", staleness=1)
+        assert solver_trace_counts() == before
+
+    def test_host_plane_async_falls_back_to_sync(self):
+        """The async schedule needs the device score plane (the running
+        total must be safely shareable across threads); on the host plane
+        the estimator runs sync — bitwise so."""
+        data = _problem()
+        est_a, fit_a = _fit(data, schedule="async", staleness=1, plane="host")
+        assert est_a._effective_schedule() == "sync"
+        _, fit_s = _fit(data, schedule="sync", plane="host")
+        assert np.array_equal(
+            np.asarray(fit_s.model.score(data)),
+            np.asarray(fit_a.model.score(data)),
+        )
+
+
+class TestOverlapSpans:
+    def test_overlap_spans_parent_under_outer_iter(self):
+        """Worker-thread spans chain under the dispatching iteration's span
+        (contextvars are copied at submit): cd/overlap parents under
+        cd/outer_iter, and the solve spans opened INSIDE the worker parent
+        under cd/overlap — the attribution analyze_run depends on."""
+        tracer = enable_tracing(device_sync=False, clear=True)
+        try:
+            data = _problem()
+            _fit(data, schedule="async", staleness=1)
+        finally:
+            disable_tracing()
+        by_id = {r.span_id: r for r in tracer.spans()}
+        overlaps = [r for r in tracer.spans() if r.name == "cd/overlap"]
+        assert len(overlaps) == 3 * N_OUTER
+        for rec in overlaps:
+            assert by_id[rec.parent_id].name == "cd/outer_iter"
+            assert "coordinate" in rec.attrs
+        solves = [
+            r for r in tracer.spans() if r.name in ("fe/solve", "re/train")
+        ]
+        assert solves
+        for rec in solves:
+            assert by_id[rec.parent_id].name == "cd/overlap"
+        # reconcile spans stay on the driver, also under the iteration
+        recs = [r for r in tracer.spans() if r.name == "cd/reconcile"]
+        assert len(recs) == 3 * N_OUTER
+        for rec in recs:
+            assert by_id[rec.parent_id].name == "cd/outer_iter"
+
+
+class TestBucketOverlap:
+    def test_bucket_overlap_bitwise_parity(self):
+        """Overlapped bucket solves are mutually independent: any
+        completion order yields bitwise-identical per-bucket coefficients
+        vs the sequential path."""
+        from photon_ml_tpu.data import build_random_effect_dataset
+        from photon_ml_tpu.estimators.random_effect import train_random_effects
+        from photon_ml_tpu.opt import (
+            GlmOptimizationConfiguration,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.types import RegularizationType
+
+        rng = np.random.default_rng(0)
+        n_ent, d, rows_per = 12, 4, 9
+        ids, rows, cols, vals, labels = [], [], [], [], []
+        r = 0
+        for e in range(n_ent):
+            for _ in range(rows_per):
+                x = rng.normal(size=d).astype(np.float32)
+                for c in range(d):
+                    rows.append(r)
+                    cols.append(c)
+                    vals.append(float(x[c]))
+                ids.append(f"e{e:03d}")
+                labels.append(float(x.sum() > 0))
+                r += 1
+        dcfg = RandomEffectDataConfiguration(
+            random_effect_type="e", num_buckets=3
+        )
+        ds = build_random_effect_dataset(
+            ids, np.array(rows), np.array(cols),
+            np.array(vals, np.float32), d,
+            np.array(labels, np.float32), dcfg,
+        )
+        cfg = GlmOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=1e-3,
+        )
+        seq, _ = train_random_effects(ds, TaskType.LOGISTIC_REGRESSION, cfg)
+        ovl, _ = train_random_effects(
+            ds, TaskType.LOGISTIC_REGRESSION, cfg, overlap_buckets=2
+        )
+        assert len(seq.coefficients) == len(ovl.coefficients) == len(ds.buckets)
+        for cs, co in zip(seq.coefficients, ovl.coefficients):
+            assert np.array_equal(np.asarray(cs), np.asarray(co))
+
+
+class TestValidation:
+    def test_schedule_validation(self):
+        with pytest.raises(ValueError, match="schedule"):
+            GameEstimator(
+                task=TaskType.LINEAR_REGRESSION, coordinates=_coords(),
+                schedule="eager",
+            )
+        with pytest.raises(ValueError, match="staleness"):
+            GameEstimator(
+                task=TaskType.LINEAR_REGRESSION, coordinates=_coords(),
+                staleness=-1,
+            )
+        with pytest.raises(ValueError, match="schedule"):
+            CoordinateDescent({"x": object()}, num_rows=4, schedule="lazy")
+        with pytest.raises(ValueError, match="staleness"):
+            CoordinateDescent({"x": object()}, num_rows=4, staleness=-2)
+
+    def test_executor_validation_and_drain(self):
+        with pytest.raises(ValueError, match="max_in_flight"):
+            ScheduleExecutor(max_in_flight=0)
+        with ScheduleExecutor(max_in_flight=2) as ex:
+            works = [ex.submit(i, lambda i=i: i * i) for i in range(5)]
+            assert len(ex) == 5
+            assert ex.oldest() is works[0]
+            assert ex.drain() == [0, 1, 4, 9, 16]
+            assert len(ex) == 0

@@ -813,3 +813,96 @@ class TestDriverTelemetrySmoke:
         re_p = dict(plain.models["per_user"].items())
         re_t = dict(traced.models["per_user"].items())
         assert re_p == re_t  # exact per-entity sparse coefficient equality
+
+
+class TestCrossThreadSpanPropagation:
+    """The async CD schedule's telemetry contract: spans opened inside a
+    ScheduleExecutor worker parent under the span that was live at the
+    DISPATCH site (contextvars are copied at submit), not under the
+    worker thread's own (empty) context — and the resulting cross-thread
+    span tree survives ledger validation."""
+
+    def test_worker_span_parents_under_dispatch_site(self, tracer):
+        from photon_ml_tpu.algorithm.schedule import ScheduleExecutor
+
+        def work():
+            with span("fe/solve"):
+                return 7
+
+        with ScheduleExecutor(max_in_flight=2, name="t-sched") as ex:
+            with span("cd/outer_iter", outer=0):
+                w = ex.submit("fe", work, coordinate="fe", outer=0)
+                assert w.result() == 7
+        by_name = {r.name: r for r in tracer.spans()}
+        overlap = by_name["cd/overlap"]
+        assert overlap.parent_id == by_name["cd/outer_iter"].span_id
+        assert overlap.attrs == {"coordinate": "fe", "outer": 0}
+        assert by_name["fe/solve"].parent_id == overlap.span_id
+        # the overlap span really ran on the pool thread, not the driver
+        assert overlap.thread_id != by_name["cd/outer_iter"].thread_id
+        assert overlap.thread_name.startswith("t-sched")
+
+    def test_plain_thread_still_isolated(self, tracer):
+        """Bare threads (no executor) keep today's behavior: their spans
+        root independently — propagation is an explicit submit-time copy,
+        not a global change to span parenting."""
+        def worker():
+            with span("w/root"):
+                pass
+
+        with span("driver"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        by_name = {r.name: r for r in tracer.spans()}
+        assert by_name["w/root"].parent_id is None
+
+    def test_concurrent_worker_spans_survive_ledger_validation(
+        self, tmp_path, tracer
+    ):
+        """Two workers dispatched from one iteration write interleaved,
+        genuinely concurrent spans; the ledger schema and the analyzer both
+        accept the result (validate, then analyze_records must attribute
+        nonzero overlap)."""
+        import time as _time
+
+        from photon_ml_tpu.algorithm.schedule import ScheduleExecutor
+        from photon_ml_tpu.telemetry.analyze import analyze_records
+
+        def work(tag):
+            def _run():
+                with span(f"fe/solve_{tag}" if tag == "a" else f"re/train_{tag}"):
+                    _time.sleep(0.05)
+                return tag
+            return _run
+
+        with ScheduleExecutor(max_in_flight=2) as ex:
+            with span("cd/outer_iter", outer=0):
+                wa = ex.submit("a", work("a"), coordinate="a", outer=0)
+                wb = ex.submit("b", work("b"), coordinate="b", outer=0)
+                assert wa.result() == "a"
+                assert wb.result() == "b"
+
+        path = tmp_path / "ledger.jsonl"
+        ledger = RunLedger(str(path))
+        # the run window must bracket the spans (spans are flushed at run
+        # finish in production; here they are replayed after the fact, so
+        # pin the start record to the tracer origin)
+        ledger.write("meta", phase="start", label="xthread",
+                     ts=tracer.origin_unix)
+        for rec in tracer.spans():
+            ledger.write_span(rec, tracer.origin_unix)
+        ledger.write("meta", phase="finish", label="xthread")
+        ledger.close()
+        records = validate_ledger(str(path))
+        spans = [r for r in records if r["type"] == "span"]
+        assert len(spans) == 5  # outer_iter + 2 overlap + 2 solves
+        by_id = {s["span_id"]: s for s in spans}
+        for s in spans:
+            if s["name"] != "cd/outer_iter":
+                assert s["parent_id"] in by_id
+        report = analyze_records(records)
+        # the two 50ms worker spans ran concurrently: the analyzer shares
+        # the segment instead of double-counting it, and reports overlap
+        assert report.coverage <= 1.05
+        assert report.overlap_s > 0
