@@ -5,10 +5,14 @@ The offline driver (``score_game``) reloads the Avro model and scores a
 static dataset in one pass; this driver exercises the *online* path: the
 model is packed into a serving artifact (dense FE coefficients +
 contiguous per-entity RE tables behind off-heap entity indexes), requests
-are drawn row-by-row from a scoring dataset, coalesced by the microbatcher
-into fixed-bucket jit'd batches, and scored through the hot-entity cache.
-Prints a one-line JSON metrics report (latency percentiles, sustained
-request rate, batch fill, cache hit rate, XLA compile count).
+are drawn row-by-row from a scoring dataset, coalesced by the continuous
+microbatcher into fixed-bucket jit'd batches, and scored against sharded
+device-resident RE tables (entity→(shard, slot) routing, async admission
+of the cold tail, optionally one scorer replica per device). Passing
+``--cache-capacity`` instead selects the legacy sealed path: a single
+``GameScorer`` behind an LRU hot-entity row cache. Prints a one-line JSON
+metrics report (latency percentiles, sustained request rate, batch fill,
+device residency, XLA compile count).
 
 Usage:
     # pack a trained model and serve a replayed stream
@@ -66,8 +70,33 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         f"(default {DEFAULT_BUCKETS}); XLA compiles once "
                         "per bucket")
     p.add_argument("--cache-capacity", type=int, default=None,
-                   help="hot-entity cache rows per RE coordinate (default: "
-                        "full tables device-resident, no cache)")
+                   help="legacy mode: hot-entity LRU cache rows per RE "
+                        "coordinate behind a single sealed scorer (default: "
+                        "sharded device-resident serving)")
+    p.add_argument("--scorers", type=int, default=1,
+                   help="scorer replicas, one per serving device; replicas "
+                        "share one routing index and round-robin drained "
+                        "buckets (default 1)")
+    p.add_argument("--shards", type=int, default=None,
+                   help="device shards per RE table in sharded mode "
+                        "(default 4)")
+    p.add_argument("--device-budget-rows", type=int, default=None,
+                   help="cap device-resident RE rows per coordinate; rows "
+                        "beyond it serve FE-only until admitted (default: "
+                        "full residency plus hot-swap headroom)")
+    p.add_argument("--admit-batch", type=int, default=None,
+                   help="rows per async admission step in sharded mode "
+                        "(default 64); one fixed-shape scatter per step")
+    p.add_argument("--batch-deadline-ms", type=float, default=None,
+                   help="continuous-batching deadline: a forming bucket is "
+                        "scored once its oldest request has waited this "
+                        "long (default 2.0)")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="backpressure cap on pending requests in continuous "
+                        "mode (default: 2x the largest bucket)")
+    p.add_argument("--sealed", action="store_true",
+                   help="drive the sealed single-thread MicroBatcher loop "
+                        "instead of continuous batching (single scorer)")
     p.add_argument("--max-requests", type=int, default=None,
                    help="replay at most this many rows")
     p.add_argument("--watch-deltas", default=None,
@@ -157,23 +186,48 @@ def _effective_config(args, artifact, logger) -> dict:
     )
     cache_capacity = args.cache_capacity
     max_nnz = args.max_nnz
+    shards = args.shards
+    admit_batch = args.admit_batch
+    deadline_ms = args.batch_deadline_ms
     applied = {}
     if tuned:
         if args.bucket_sizes == DEFAULT_BUCKETS and "serving.bucket_sizes" in tuned:
             bucket_sizes = tuple(int(b) for b in tuned["serving.bucket_sizes"])
             applied["serving.bucket_sizes"] = list(bucket_sizes)
         if cache_capacity is None and tuned.get("serving.cache_capacity"):
-            cache_capacity = int(tuned["serving.cache_capacity"])
-            applied["serving.cache_capacity"] = cache_capacity
+            # a tuned cache capacity only matters on the legacy cached
+            # path; it must not silently flip the serving mode, so it is
+            # recorded but applied only when --cache-capacity selected it
+            pass
         if max_nnz is None and tuned.get("serving.max_nnz"):
             max_nnz = int(tuned["serving.max_nnz"])
             applied["serving.max_nnz"] = max_nnz
+        if shards is None and tuned.get("serving.shards"):
+            shards = int(tuned["serving.shards"])
+            applied["serving.shards"] = shards
+        if admit_batch is None and tuned.get("serving.admit_batch"):
+            admit_batch = int(tuned["serving.admit_batch"])
+            applied["serving.admit_batch"] = admit_batch
+        if deadline_ms is None and tuned.get("serving.batch_deadline_ms"):
+            deadline_ms = float(tuned["serving.batch_deadline_ms"])
+            applied["serving.batch_deadline_ms"] = deadline_ms
         if applied:
             logger.info("booting with tuned config: %s", applied)
+    mode = "cached" if cache_capacity is not None else "sharded"
     return {
+        "mode": mode,
         "bucket_sizes": list(bucket_sizes),
         "cache_capacity": cache_capacity,
         "max_nnz": max_nnz,
+        "scorers": max(1, int(args.scorers)),
+        "shards": int(shards) if shards else 4,
+        "device_budget_rows": args.device_budget_rows,
+        "admit_batch": int(admit_batch) if admit_batch else 64,
+        "batch_deadline_ms": (
+            float(deadline_ms) if deadline_ms is not None else 2.0
+        ),
+        "max_queue": args.max_queue,
+        "sealed": bool(args.sealed or mode == "cached"),
         "tuned": bool(applied),
         "tuned_config": tuned or None,
         "tuned_applied": applied or None,
@@ -397,10 +451,18 @@ def _serve_stream(
             if "serving.bucket_sizes" in winner:
                 bucket_sizes = tuple(int(b) for b in winner["serving.bucket_sizes"])
                 active["bucket_sizes"] = list(bucket_sizes)
-            if winner.get("serving.cache_capacity"):
+            if active["mode"] == "cached" and winner.get("serving.cache_capacity"):
                 active["cache_capacity"] = int(winner["serving.cache_capacity"])
             if winner.get("serving.max_nnz"):
                 active["max_nnz"] = int(winner["serving.max_nnz"])
+            if winner.get("serving.shards"):
+                active["shards"] = int(winner["serving.shards"])
+            if winner.get("serving.admit_batch"):
+                active["admit_batch"] = int(winner["serving.admit_batch"])
+            if winner.get("serving.batch_deadline_ms"):
+                active["batch_deadline_ms"] = float(
+                    winner["serving.batch_deadline_ms"]
+                )
             active["tuned"] = True
             active["tuned_config"] = {
                 k: (list(v) if isinstance(v, tuple) else v)
@@ -421,29 +483,77 @@ def _serve_stream(
                     logger.info("persisted tuned config to %s", path)
 
         state["phase"] = "replaying"
-        scorer = GameScorer(
-            artifact,
-            max_nnz=active["max_nnz"] if active["max_nnz"] else max_nnz_of(requests),
-            cache_capacity=active["cache_capacity"],
-            growth_headroom=bool(args.watch_deltas),
-        )
+        nnz = active["max_nnz"] if active["max_nnz"] else max_nnz_of(requests)
+        admission = None
+        if active["mode"] == "cached":
+            scorers = [GameScorer(
+                artifact,
+                max_nnz=nnz,
+                cache_capacity=active["cache_capacity"],
+                growth_headroom=bool(args.watch_deltas),
+            )]
+        else:
+            from photon_ml_tpu.serving import (
+                AdmissionController,
+                ShardedGameScorer,
+            )
+
+            routing = None
+            scorers = []
+            for _ in range(active["scorers"]):
+                s = ShardedGameScorer(
+                    artifact,
+                    max_nnz=nnz,
+                    num_shards=active["shards"],
+                    device_budget_rows=active["device_budget_rows"],
+                    routing=routing,
+                )
+                routing = s.routing
+                scorers.append(s)
+            admission = AdmissionController(
+                scorers, admit_batch=active["admit_batch"]
+            )
+            for s in scorers:
+                s.attach_admission(admission)
+            # compile the fixed-shape admission scatter before traffic
+            admission.warmup()
+        continuous = not active["sealed"]
+        if active["sealed"] and len(scorers) > 1:
+            logger.warning(
+                "--sealed drives a single scorer; ignoring %d extra "
+                "replica(s)", len(scorers) - 1,
+            )
+            scorers = scorers[:1]
         from photon_ml_tpu.serving import ServingMetrics
 
         metrics = ServingMetrics()
         manager = None
         if args.watch_deltas:
             from photon_ml_tpu.incremental import fingerprint_dir
-            from photon_ml_tpu.serving import HotSwapManager
+            from photon_ml_tpu.serving import (
+                CoordinatedHotSwap,
+                HotSwapManager,
+            )
 
-            manager = HotSwapManager(
-                scorer,
-                fingerprint=(
-                    fingerprint_dir(args.artifact_dir)
-                    if args.artifact_dir else None
-                ),
-                metrics=metrics,
-                emitter=emitter,
-                model_id=model_id,
+            fingerprint = (
+                fingerprint_dir(args.artifact_dir)
+                if args.artifact_dir else None
+            )
+            managers = [
+                HotSwapManager(
+                    s,
+                    fingerprint=fingerprint,
+                    # only the lead manager records swap metrics/events;
+                    # replica swaps are the same delta fanned out
+                    metrics=metrics if i == 0 else None,
+                    emitter=emitter if i == 0 else None,
+                    model_id=model_id,
+                )
+                for i, s in enumerate(scorers)
+            ]
+            manager = (
+                managers[0] if len(managers) == 1
+                else CoordinatedHotSwap(managers)
             )
             state["manager"] = manager
             logger.info(
@@ -452,7 +562,7 @@ def _serve_stream(
             )
         with timer.time("replay"):
             results, snapshot = replay_requests(
-                scorer, requests,
+                scorers if continuous else scorers[0], requests,
                 bucket_sizes=bucket_sizes,
                 metrics=metrics,
                 emitter=emitter,
@@ -460,6 +570,10 @@ def _serve_stream(
                 swap_manager=manager,
                 watch_dir=args.watch_deltas,
                 poll_every=args.watch_chunk,
+                continuous=continuous,
+                max_wait_s=active["batch_deadline_ms"] / 1e3,
+                max_queue=active["max_queue"],
+                admission=admission,
             )
         if manager is not None:
             logger.info(
@@ -470,6 +584,8 @@ def _serve_stream(
 
         snapshot["model_id"] = model_id
         snapshot["bucket_sizes"] = list(bucket_sizes)
+        snapshot["serving_mode"] = active["mode"]
+        snapshot["num_scorers"] = len(scorers)
         if ab_result is not None:
             snapshot["auto_tune"] = ab_result
         # fold the final serving snapshot into the process registry so the

@@ -13,6 +13,7 @@ convention (Constants.scala): a feature is identified by a single string key.
 from __future__ import annotations
 
 import abc
+from itertools import repeat
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -74,6 +75,15 @@ class DefaultIndexMap(IndexMap):
 
     def get_index(self, name: str) -> int:
         return self._forward.get(name, -1)
+
+    def get_indices(self, names: Sequence[str]) -> np.ndarray:
+        # hot on the serving route path: map(dict.get, names, repeat(-1))
+        # stays entirely in C, vs one Python frame per name via get_index
+        return np.fromiter(
+            map(self._forward.get, names, repeat(-1)),
+            dtype=np.int64,
+            count=len(names),
+        )
 
     def get_feature_name(self, index: int) -> Optional[str]:
         return self._reverse.get(int(index))

@@ -27,6 +27,16 @@ map onto that design:
   (``photon_ml_tpu.incremental``) to a live scorer between batches: in-place
   table mutation with no retrace, per-row cache invalidation, AUC validation
   gate with rollback to the previous generation.
+- :mod:`photon_ml_tpu.serving.routing` /
+  :mod:`photon_ml_tpu.serving.sharded` — the device-resident hot path: RE
+  tables partitioned across a serving mesh behind an entity→(shard, slot)
+  routing index, one jitted gather per shard per batch.
+- :mod:`photon_ml_tpu.serving.admission` — asynchronous admission of the
+  cold long tail into device headroom slots (double-buffered host→device
+  copies off the request path).
+- :mod:`photon_ml_tpu.serving.continuous` — continuous microbatching:
+  requests join in-flight buckets up to a deadline, scored by per-replica
+  threads with backpressure-bounded queues.
 """
 
 from photon_ml_tpu.serving.artifact import (
@@ -39,30 +49,52 @@ from photon_ml_tpu.serving.artifact import (
     save_tuned_config,
 )
 from photon_ml_tpu.serving.introspect import IntrospectionServer, prometheus_text
+from photon_ml_tpu.serving.admission import AdmissionController
 from photon_ml_tpu.serving.batcher import MicroBatcher
 from photon_ml_tpu.serving.cache import HotEntityCache
+from photon_ml_tpu.serving.continuous import ContinuousBatcher, PendingResult
 from photon_ml_tpu.serving.hotswap import (
+    CoordinatedHotSwap,
     HotSwapManager,
     SwapReport,
     ValidationGate,
 )
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.replay import replay_requests, requests_from_game_data
+from photon_ml_tpu.serving.routing import (
+    CoordinateRouting,
+    RoutingIndex,
+    build_routing,
+)
 from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
+from photon_ml_tpu.serving.sharded import (
+    ShardedGameScorer,
+    ShardedReTable,
+    serving_mesh,
+)
 
 __all__ = [
+    "AdmissionController",
+    "ContinuousBatcher",
+    "CoordinateRouting",
+    "CoordinatedHotSwap",
     "GameScorer",
     "HotEntityCache",
     "HotSwapManager",
     "MicroBatcher",
+    "PendingResult",
+    "RoutingIndex",
     "ScoreRequest",
     "ScoreResult",
+    "ShardedGameScorer",
+    "ShardedReTable",
     "ServingArtifact",
     "ServingMetrics",
     "ServingTable",
     "SwapReport",
     "ValidationGate",
     "IntrospectionServer",
+    "build_routing",
     "load_artifact",
     "load_tuned_config",
     "pack_game_model",
@@ -71,4 +103,5 @@ __all__ = [
     "requests_from_game_data",
     "save_artifact",
     "save_tuned_config",
+    "serving_mesh",
 ]

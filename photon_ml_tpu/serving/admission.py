@@ -1,0 +1,249 @@
+"""Asynchronous admission of cold-tail entities into device headroom.
+
+The sharded scorer serves entities beyond its device budget FE-only (cold
+slot) and reports them here; a background step copies their coefficient
+rows host→device OFF the request path — the serving analogue of the
+pipelined host↔accelerator movement in Snap ML / the GPU-DUHL scheme:
+request latency never waits on a host copy, it only determines whether
+THIS request sees the row or the next one does.
+
+Two properties keep the request path clean:
+
+- **Fixed-shape scatters.** Every admission batch is padded to exactly
+  ``admit_batch`` rows (pad writes aim zero values at shard 0's cold
+  slot, which keeps it zero), so the device scatter compiles ONCE — the
+  per-distinct-miss-count compile storm of the synchronous LRU fill is
+  structurally impossible here.
+- **Double-buffered staging.** Rows are gathered from the (possibly
+  mmap'd) host backing store into one of two pinned staging buffers while
+  the other buffer's transfer is still in flight, so disk faults and the
+  device copy overlap across steps.
+
+Publication ordering (see ``routing.py``): evictions unpublish first,
+device content is written to EVERY scorer replica next, routing publishes
+last — a reader never gathers another entity's bytes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.telemetry import span
+
+
+class AdmissionController:
+    """Admits deferred entity rows into the headroom slots of one or more
+    scorer replicas' :class:`~photon_ml_tpu.serving.sharded.ShardedReTable`
+    s. Construct with every replica's scorer so a row becomes resident on
+    all devices before routing publishes it (the routing index is shared).
+
+    Drive it synchronously with :meth:`step` (replay loop, tests) or as a
+    background thread via :meth:`start`/:meth:`stop`.
+    """
+
+    def __init__(
+        self,
+        scorers,
+        admit_batch: int = 64,
+        max_queue: int = 65536,
+    ):
+        if admit_batch < 1:
+            raise ValueError(f"admit_batch must be >= 1, got {admit_batch}")
+        scorers = list(scorers) if isinstance(scorers, (list, tuple)) else [scorers]
+        if not scorers:
+            raise ValueError("need at least one scorer")
+        self._scorers = scorers
+        self.admit_batch = int(admit_batch)
+        self.max_queue = int(max_queue)
+        self._lock = threading.Lock()
+        # per-coordinate FIFO of deferred rows; OrderedDict dedups repeats
+        # of a hot-but-not-yet-admitted entity while keeping arrival order
+        self._queues: Dict[str, "OrderedDict[int, None]"] = {}
+        # double staging buffers per coordinate, allocated lazily at the
+        # first admit (dim known then); index flips every step
+        self._staging: Dict[str, List[np.ndarray]] = {}
+        self._flip: Dict[str, int] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.admitted_total = 0
+        self.evicted_total = 0
+        self.deferred_total = 0
+        self.dropped_total = 0  # queue overflow (admission can't keep up)
+        self.steps = 0
+
+    # -------------------------------------------------------------- intake
+
+    def note_deferred(self, cid: str, rows: np.ndarray) -> None:
+        """Record rows a request batch served FE-only (called by the scorer
+        on the request path — O(deferred) dict inserts, no device work)."""
+        rows = np.asarray(rows, dtype=np.int64).ravel()
+        if rows.size == 0:
+            return
+        with self._lock:
+            q = self._queues.get(cid)
+            if q is None:
+                q = self._queues[cid] = OrderedDict()
+            self.deferred_total += rows.size
+            for r in rows.tolist():
+                if r in q:
+                    continue
+                if len(q) >= self.max_queue:
+                    self.dropped_total += 1
+                    continue
+                q[r] = None
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    # ------------------------------------------------------------- admit
+
+    def step(self) -> int:
+        """Admit up to ``admit_batch`` rows per coordinate. Returns the
+        number of rows admitted across coordinates."""
+        admitted = 0
+        for cid in list(self._queues):
+            with self._lock:
+                q = self._queues[cid]
+                take = min(len(q), self.admit_batch)
+                rows = [q.popitem(last=False)[0] for _ in range(take)]
+            if not rows:
+                continue
+            admitted += self._admit(cid, np.asarray(rows, dtype=np.int64))
+        if admitted:
+            self.steps += 1
+        return admitted
+
+    def _admit(self, cid: str, rows: np.ndarray) -> int:
+        primary = self._scorers[0]._providers[cid]
+        routing = primary.routing
+        # rows can have been admitted since they were queued (hot-swap
+        # update_rows, or a previous step when the same row was queued twice
+        # under different coordinates); they may also have been evicted
+        # again — that is fine, admission is idempotent on content
+        fresh = rows[routing._slot_of[rows] < 0]
+        if fresh.size == 0:
+            return 0
+        # a single step can only claim slots that are free or already
+        # admitted (rows admitted THIS step are not evictable until
+        # published); overflow goes back to the queue head for next step
+        capacity = routing.free_slots + len(routing._admitted)
+        if capacity == 0:
+            self.dropped_total += int(fresh.size)
+            return 0
+        if fresh.size > capacity:
+            overflow = fresh[capacity:]
+            fresh = fresh[:capacity]
+            with self._lock:
+                q = self._queues[cid]
+                for r in overflow.tolist()[::-1]:
+                    q[r] = None
+                    q.move_to_end(r, last=False)
+        with span("serve/admit", cid=cid, rows=int(fresh.size)):
+            k = self.admit_batch
+            shards = np.zeros(k, dtype=np.int32)
+            # pad writes target shard 0's cold slot with zeros: the cold
+            # slot stays zero and the scatter keeps ONE compiled shape
+            slots = np.full(k, routing.cold_slot, dtype=np.int32)
+            a_shards, a_slots, evicted = routing.allocate(fresh.size)
+            shards[: fresh.size] = a_shards
+            slots[: fresh.size] = a_slots
+            buf = self._stage(cid, primary, fresh, k)
+            for scorer in self._scorers:
+                # the donated scatter invalidates the replica's previous
+                # table array; its write_lock keeps that away from a
+                # gather in flight on the replica's scoring thread
+                with scorer.write_lock:
+                    scorer._providers[cid].write_slots(shards, slots, buf)
+            routing.publish(fresh, a_shards, a_slots)
+            self.admitted_total += int(fresh.size)
+            self.evicted_total += len(evicted)
+        return int(fresh.size)
+
+    def _stage(self, cid: str, provider, rows: np.ndarray, k: int) -> np.ndarray:
+        """Gather host rows into the next staging buffer (double-buffered:
+        the buffer written last step may still back an in-flight device
+        copy, so this step fills the other one)."""
+        bufs = self._staging.get(cid)
+        dim = provider._backing.shape[1]
+        if bufs is None or bufs[0].shape != (k, dim):
+            bufs = self._staging[cid] = [
+                np.zeros((k, dim), dtype=np.float32) for _ in range(2)
+            ]
+            self._flip[cid] = 0
+        self._flip[cid] ^= 1
+        buf = bufs[self._flip[cid]]
+        buf[:] = 0.0
+        buf[: rows.size] = provider.host_rows(rows)
+        return buf
+
+    def warmup(self) -> None:
+        """Compile every replica's fixed-shape admission scatter (and
+        allocate the staging buffers) before serving: an all-pad batch
+        writes zeros at shard 0's cold slot, so content is untouched but
+        the first real admit runs compile-free off the request path."""
+        k = self.admit_batch
+        shards = np.zeros(k, dtype=np.int32)
+        for scorer in self._scorers:
+            for cid, provider in scorer._providers.items():
+                slots = np.full(k, provider.cold_slot, dtype=np.int32)
+                buf = self._stage(
+                    cid, provider, np.empty(0, dtype=np.int64), k
+                )
+                with scorer.write_lock:
+                    provider.write_slots(shards, slots, buf)
+
+    # --------------------------------------------------------- background
+
+    def start(self, interval_s: float = 0.001) -> None:
+        """Run :meth:`step` on a background thread every ``interval_s``
+        (sooner when a step admitted a full batch — drain bursts fast)."""
+        if self._thread is not None:
+            raise RuntimeError("admission thread already running")
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.is_set():
+                n = self.step()
+                if n < self.admit_batch:
+                    self._stop.wait(interval_s)
+
+        self._thread = threading.Thread(
+            target=_run, name="serving-admission", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+
+    def drain(self, max_steps: int = 1 << 20) -> int:
+        """Synchronously admit until the queue is empty (tests, shutdown)."""
+        total = 0
+        for _ in range(max_steps):
+            n = self.step()
+            total += n
+            if n == 0 and self.queue_depth == 0:
+                break
+        return total
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "admit_batch": self.admit_batch,
+            "admitted_total": self.admitted_total,
+            "evicted_total": self.evicted_total,
+            "deferred_total": self.deferred_total,
+            "dropped_total": self.dropped_total,
+            "queue_depth": self.queue_depth,
+            "steps": self.steps,
+            "replicas": len(self._scorers),
+        }

@@ -21,6 +21,8 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
 from photon_ml_tpu.telemetry import span
@@ -113,7 +115,9 @@ class MicroBatcher:
             self._metrics.observe_batch(
                 n_real=n, bucket_size=bucket, queue_depth=len(self._pending)
             )
-            for _, enqueued in batch:
-                self._metrics.observe_queue_wait(dequeued - enqueued)
-                self._metrics.observe_latency(done - enqueued)
+            enqueued = np.fromiter(
+                (t for _, t in batch), dtype=np.float64, count=n
+            )
+            self._metrics.observe_queue_waits(dequeued - enqueued)
+            self._metrics.observe_latencies(done - enqueued, bucket_size=bucket)
         return results

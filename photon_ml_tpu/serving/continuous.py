@@ -1,0 +1,294 @@
+"""Continuous microbatching: requests join in-flight buckets to a deadline.
+
+``MicroBatcher`` seals a batch at submit time: the submitting caller
+scores a full bucket inline, and deadline draining only happens when the
+caller remembers to ``poll()``. Under load that serializes admission and
+scoring in one thread, and a request arriving just after a seal waits a
+full scoring pass before its bucket even forms.
+
+The continuous batcher decouples the two: ``submit`` is an O(1) enqueue
+returning a :class:`PendingResult`; a dedicated scoring thread drains the
+queue whenever a full max-size bucket is pending OR the oldest request
+has waited ``max_wait_s`` — so requests keep joining the forming bucket
+right up to its deadline while the previous bucket is still on device.
+Shapes stay fixed: a drain pads to one of ``bucket_sizes``, and the
+compiled-program count per scorer stays at ``len(bucket_sizes)``.
+
+Backpressure bounds the tail: ``max_queue`` caps pending requests, and a
+full queue blocks ``submit`` — p99 latency is then roughly
+``max_queue / throughput + one bucket's scoring time`` instead of
+unbounded queue growth.
+
+``scorers`` accepts one scorer or several replicas (multi-scorer mode:
+one ``GameScorer`` per device, shared routing index) — drained buckets
+round-robin across replicas, one scoring thread per replica, so replica
+scoring overlaps wherever the backend allows.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from itertools import repeat
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.serving.batcher import DEFAULT_BUCKET_SIZES
+from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.scorer import ScoreRequest, ScoreResult
+from photon_ml_tpu.telemetry import span
+
+
+class PendingResult:
+    """Handle for one submitted request; ``result()`` blocks until its
+    bucket is scored. Deliberately lighter than ``concurrent.futures``:
+    no per-handle lock/condition — completion is signalled through the
+    batcher's single condition, so creating one costs an allocation, not
+    kernel objects."""
+
+    __slots__ = ("_batcher", "value", "error", "done")
+
+    def __init__(self, batcher: "ContinuousBatcher"):
+        self._batcher = batcher
+        self.value: Optional[ScoreResult] = None
+        self.error: Optional[BaseException] = None
+        self.done = False
+
+    def result(self, timeout: Optional[float] = None) -> ScoreResult:
+        if not self.done:
+            self._batcher._wait_for(self, timeout)
+        if self.error is not None:
+            raise self.error
+        return self.value  # type: ignore[return-value]
+
+
+class ContinuousBatcher:
+    def __init__(
+        self,
+        scorers,
+        bucket_sizes: Sequence[int] = DEFAULT_BUCKET_SIZES,
+        metrics: Optional[ServingMetrics] = None,
+        max_wait_s: float = 0.002,
+        max_queue: Optional[int] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        scorers = (
+            list(scorers) if isinstance(scorers, (list, tuple)) else [scorers]
+        )
+        if not scorers:
+            raise ValueError("need at least one scorer")
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        buckets = sorted({int(b) for b in bucket_sizes})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"bucket sizes must be positive, got {bucket_sizes}")
+        for scorer in scorers:
+            for cid, cache in getattr(scorer, "caches", {}).items():
+                if cache.capacity < buckets[-1]:
+                    raise ValueError(
+                        f"hot-entity cache for {cid!r} holds {cache.capacity} "
+                        f"rows < max bucket size {buckets[-1]}"
+                    )
+        self._scorers = scorers
+        self.bucket_sizes: Tuple[int, ...] = tuple(buckets)
+        self.max_bucket = buckets[-1]
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = (
+            int(max_queue) if max_queue is not None else 2 * self.max_bucket
+        )
+        if self.max_queue < self.max_bucket:
+            raise ValueError(
+                f"max_queue {self.max_queue} < max bucket {self.max_bucket}"
+            )
+        self._metrics = metrics
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._pending: "deque[Tuple[ScoreRequest, float, PendingResult]]" = (
+            deque()
+        )
+        self._inflight = 0  # requests popped but not yet resolved
+        self._running = False
+        self._threads: List[threading.Thread] = []
+        self._scorer_errors = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ContinuousBatcher":
+        with self._cond:
+            if self._running:
+                raise RuntimeError("batcher already running")
+            self._running = True
+        self._threads = [
+            threading.Thread(
+                target=self._serve_loop,
+                args=(scorer,),
+                name=f"serving-batcher-{i}",
+                daemon=True,
+            )
+            for i, scorer in enumerate(self._scorers)
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join()
+        self._threads = []
+        # resolve anything stranded (stop before flush): submitters must
+        # not block forever on a dead batcher
+        with self._cond:
+            while self._pending:
+                _, _, handle = self._pending.popleft()
+                handle.error = RuntimeError("batcher stopped before scoring")
+                handle.done = True
+            self._cond.notify_all()
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --------------------------------------------------------------- intake
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    def submit(self, request: ScoreRequest) -> PendingResult:
+        """Enqueue one request (blocks only on backpressure)."""
+        return self.submit_many((request,))[0]
+
+    def submit_many(
+        self, requests: Sequence[ScoreRequest]
+    ) -> List[PendingResult]:
+        """Enqueue a burst under one lock acquisition (amortizes the
+        condition handshake for high-rate closed-loop clients)."""
+        handles = [PendingResult(self) for _ in requests]
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("batcher is not running — call start()")
+            i = 0
+            while i < len(requests):
+                while (
+                    len(self._pending) >= self.max_queue and self._running
+                ):
+                    self._cond.wait()
+                if not self._running:
+                    raise RuntimeError("batcher stopped")
+                room = self.max_queue - len(self._pending)
+                now = self._clock()
+                # C-level bulk extend: the lock is held, so per-item
+                # appends would serialize against the scoring threads
+                self._pending.extend(zip(
+                    requests[i : i + room],
+                    repeat(now),
+                    handles[i : i + room],
+                ))
+                i += room
+                self._cond.notify_all()
+        return handles
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every submitted request has been scored."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while self._pending or self._inflight:
+                remaining = (
+                    None if deadline is None else deadline - self._clock()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("flush timed out")
+                self._cond.wait(remaining)
+
+    def _wait_for(
+        self, handle: PendingResult, timeout: Optional[float]
+    ) -> None:
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while not handle.done:
+                remaining = (
+                    None if deadline is None else deadline - self._clock()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("result not ready")
+                self._cond.wait(remaining)
+
+    # -------------------------------------------------------------- serving
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        return self.max_bucket
+
+    def _serve_loop(self, scorer) -> None:
+        while True:
+            batch = None
+            with self._cond:
+                while self._running:
+                    n = len(self._pending)
+                    if n >= self.max_bucket:
+                        break
+                    if n:
+                        oldest_wait = self._clock() - self._pending[0][1]
+                        if oldest_wait >= self.max_wait_s:
+                            break
+                        self._cond.wait(self.max_wait_s - oldest_wait)
+                    else:
+                        self._cond.wait()
+                if not self._running:
+                    return
+                take = min(len(self._pending), self.max_bucket)
+                if take == len(self._pending):
+                    batch = list(self._pending)
+                    self._pending.clear()
+                else:
+                    batch = [
+                        self._pending.popleft() for _ in range(take)
+                    ]
+                self._inflight += take
+                # queue room just opened: wake blocked submitters (and any
+                # sibling replica thread waiting for work)
+                self._cond.notify_all()
+            self._score(scorer, batch)
+
+    def _score(self, scorer, batch) -> None:
+        n = len(batch)
+        dequeued = self._clock()
+        bucket = self._bucket_for(n)
+        results: Optional[List[ScoreResult]] = None
+        error: Optional[BaseException] = None
+        try:
+            with span("serve/drain", n=n, bucket=bucket):
+                results = scorer.score_batch(
+                    [req for req, _, _ in batch], bucket
+                )
+        except BaseException as e:  # resolve handles, keep the loop alive
+            error = e
+            self._scorer_errors += 1
+        done = self._clock()
+        with self._cond:
+            for i, (_, _, handle) in enumerate(batch):
+                if error is None:
+                    handle.value = results[i]
+                else:
+                    handle.error = error
+                handle.done = True
+            self._inflight -= n
+            self._cond.notify_all()
+        if self._metrics is not None and error is None:
+            self._metrics.observe_batch(
+                n_real=n, bucket_size=bucket, queue_depth=len(self._pending)
+            )
+            enqueued = np.fromiter(
+                (t for _, t, _ in batch), dtype=np.float64, count=n
+            )
+            self._metrics.observe_queue_waits(dequeued - enqueued)
+            self._metrics.observe_latencies(done - enqueued, bucket_size=bucket)

@@ -201,7 +201,12 @@ class HotSwapManager:
                 cache_plan[cid] = (np.asarray(new_table.weights), targets)
                 undo.cache_rebinds[cid] = (old_table.weights, targets)
                 continue
-            if targets.max() < provider.capacity:
+            fits = getattr(provider, "fits", None)
+            if (
+                fits(targets)
+                if fits is not None
+                else targets.max() < provider.capacity
+            ):
                 inplace_plan[cid] = (targets, values)
                 n_old = old_table.n_entities
                 old_rows = np.zeros_like(values)
@@ -329,15 +334,12 @@ class HotSwapManager:
 
     # ------------------------------------------------------------ watching
 
-    def poll_directory(self, watch_dir: str) -> List[SwapReport]:
-        """Apply any newly published deltas under ``watch_dir`` (``delta-*``
-        directories, name order = chain order). Already-processed
-        directories are skipped; a delta whose own fingerprint equals the
-        live one is recognized as already applied. Safe to call from the
-        serving loop between batches."""
+    def poll_directory_deltas(self, watch_dir: str):
+        """Yield (path, delta) for unprocessed deltas without applying —
+        used by :class:`CoordinatedHotSwap` to fan one delta out to every
+        replica before marking it processed."""
         from photon_ml_tpu.incremental.delta import discover_deltas, load_delta
 
-        reports: List[SwapReport] = []
         for path in discover_deltas(watch_dir):
             if path in self._processed_dirs:
                 continue
@@ -348,6 +350,72 @@ class HotSwapManager:
             ):
                 self._processed_dirs.add(path)
                 continue
+            yield path, delta
+
+    def poll_directory(self, watch_dir: str) -> List[SwapReport]:
+        """Apply any newly published deltas under ``watch_dir`` (``delta-*``
+        directories, name order = chain order). Already-processed
+        directories are skipped; a delta whose own fingerprint equals the
+        live one is recognized as already applied. Safe to call from the
+        serving loop between batches."""
+        reports: List[SwapReport] = []
+        for path, delta in self.poll_directory_deltas(watch_dir):
             reports.append(self.apply_delta(delta))
             self._processed_dirs.add(path)
+        return reports
+
+
+class CoordinatedHotSwap:
+    """One hot-swap control plane over N scorer replicas (multi-scorer
+    mode): a delta is applied to EVERY replica's :class:`HotSwapManager`
+    before it counts as processed, so all devices serve the same
+    generation. Replicas sharing a routing index coordinate implicitly —
+    the first replica's swap allocates/publishes any new rows, later
+    replicas find them resident and only rewrite the bytes on their own
+    device tables.
+
+    A replica that rolls back (validation gate) aborts the fan-out and
+    rolls back the replicas already swapped, so the group never splits
+    across generations."""
+
+    def __init__(self, managers: Sequence[HotSwapManager]):
+        managers = list(managers)
+        if not managers:
+            raise ValueError("need at least one HotSwapManager")
+        self._managers = managers
+
+    @property
+    def managers(self) -> List[HotSwapManager]:
+        return list(self._managers)
+
+    @property
+    def generation(self) -> int:
+        return self._managers[0].generation
+
+    def apply_delta(self, delta) -> List[SwapReport]:
+        """Apply one delta to every replica. Returns one report per replica
+        actually swapped (all of them, or the prefix up to and including a
+        rolled-back one — whose predecessors are rolled back again here)."""
+        reports: List[SwapReport] = []
+        for i, mgr in enumerate(self._managers):
+            report = mgr.apply_delta(delta)
+            reports.append(report)
+            if report.rolled_back:
+                for prev in self._managers[:i]:
+                    prev.rollback()
+                break
+        return reports
+
+    def poll_directory(self, watch_dir: str) -> List[SwapReport]:
+        """Fan newly published deltas out to every replica (lead replica
+        discovers; a delta is marked processed on all replicas only after
+        the full fan-out)."""
+        lead = self._managers[0]
+        reports: List[SwapReport] = []
+        for path, delta in list(lead.poll_directory_deltas(watch_dir)):
+            group = self.apply_delta(delta)
+            reports.extend(group)
+            if not any(r.rolled_back for r in group):
+                for mgr in self._managers:
+                    mgr._processed_dirs.add(path)
         return reports

@@ -64,6 +64,34 @@ class _Reservoir:
                 self._samples[j] = value
         self.count += 1
 
+    def add_many(self, values: np.ndarray) -> None:
+        """Vectorized :meth:`add` — one RNG draw per overflow element, same
+        keep-probability as the sequential loop (later duplicates win, as
+        they would one at a time). The batcher feeds per-batch latency
+        arrays through this so steady-state metrics cost is O(batch), not
+        O(requests) Python calls."""
+        values = np.asarray(values, dtype=np.float64).ravel()
+        m = values.size
+        if m == 0:
+            return
+        vmax = float(values.max())
+        if self.count == 0 or vmax > self.maximum:
+            self.maximum = vmax
+        self.total += float(values.sum())
+        fill = min(self.capacity - self.count, m) if self.count < self.capacity else 0
+        if fill > 0:
+            self._samples[self.count:self.count + fill] = values[:fill]
+        if m > fill:
+            tail = values[fill:]
+            prior = np.arange(
+                self.count + fill, self.count + m, dtype=np.int64
+            )
+            j = self._rng.integers(0, prior + 1)
+            keep = j < self.capacity
+            if keep.any():
+                self._samples[j[keep]] = tail[keep]
+        self.count += m
+
     @property
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
@@ -86,6 +114,11 @@ class ServingMetrics:
         self._queue_depth_count = 0
         self._queue_depth_max = 0
         self._queue_waits = _Reservoir(seed=1)
+        # per-bucket-size latency reservoirs: which bucket a request drained
+        # through is the serving-side shape signature, so tail latency is
+        # attributable per compiled program, not just in aggregate
+        self._bucket_latencies: Dict[int, _Reservoir] = {}
+        self.deferred_lookups = 0  # known entities awaiting device admission
         self.num_requests = 0
         self.num_batches = 0
         self._t_first: Optional[float] = None
@@ -114,15 +147,49 @@ class ServingMetrics:
         self._queue_depth_count += 1
         self._queue_depth_max = max(self._queue_depth_max, int(queue_depth))
 
-    def observe_latency(self, seconds: float) -> None:
+    def observe_latency(
+        self, seconds: float, bucket_size: Optional[int] = None
+    ) -> None:
         self._latencies.add(seconds)
         self._hist[np.searchsorted(LATENCY_BUCKET_BOUNDS, seconds)] += 1
+        if bucket_size is not None:
+            self._bucket_reservoir(bucket_size).add(seconds)
+
+    def observe_latencies(
+        self, seconds: np.ndarray, bucket_size: Optional[int] = None
+    ) -> None:
+        """Batched :meth:`observe_latency`: one call per drained batch."""
+        seconds = np.asarray(seconds, dtype=np.float64).ravel()
+        if seconds.size == 0:
+            return
+        self._latencies.add_many(seconds)
+        np.add.at(
+            self._hist, np.searchsorted(LATENCY_BUCKET_BOUNDS, seconds), 1
+        )
+        if bucket_size is not None:
+            self._bucket_reservoir(bucket_size).add_many(seconds)
+
+    def _bucket_reservoir(self, bucket_size: int) -> _Reservoir:
+        res = self._bucket_latencies.get(int(bucket_size))
+        if res is None:
+            # deterministic per-bucket seed so snapshots are reproducible
+            res = _Reservoir(seed=100 + int(bucket_size))
+            self._bucket_latencies[int(bucket_size)] = res
+        return res
 
     def observe_queue_wait(self, seconds: float) -> None:
         """Time a request sat in the batcher queue before its batch was
         drained — tracked separately from total latency so queueing policy
         (deadline vs. fill) is visible independently of scoring cost."""
         self._queue_waits.add(seconds)
+
+    def observe_queue_waits(self, seconds: np.ndarray) -> None:
+        self._queue_waits.add_many(np.asarray(seconds, dtype=np.float64))
+
+    def observe_deferred(self, count: int) -> None:
+        """RE lookups that found a known entity not yet device-resident —
+        served FE-only this request, queued for asynchronous admission."""
+        self.deferred_lookups += int(count)
 
     def observe_swap(
         self,
@@ -153,6 +220,8 @@ class ServingMetrics:
         self,
         cache_stats: Optional[Dict[str, Dict[str, float]]] = None,
         compile_count: Optional[int] = None,
+        residency: Optional[Dict[str, Dict[str, float]]] = None,
+        admission: Optional[Dict[str, float]] = None,
     ) -> dict:
         out: dict = {
             "num_requests": self.num_requests,
@@ -189,6 +258,24 @@ class ServingMetrics:
                 ): int(self._hist[i])
                 for i in nz
             }
+        if self._bucket_latencies:
+            # one entry per compiled program signature (bucket size): the
+            # serving analogue of per-kernel attribution
+            per_bucket: dict = {}
+            for size in sorted(self._bucket_latencies):
+                res = self._bucket_latencies[size]
+                if not res.count:
+                    continue
+                b50, b95, b99 = res.percentile([50, 95, 99])
+                per_bucket[str(size)] = {
+                    "count": res.count,
+                    "latency_p50_s": round(float(b50), 6),
+                    "latency_p95_s": round(float(b95), 6),
+                    "latency_p99_s": round(float(b99), 6),
+                    "latency_max_s": round(res.maximum, 6),
+                }
+            if per_bucket:
+                out["per_bucket_latency"] = per_bucket
         if self._queue_waits.count:
             q50, q99 = self._queue_waits.percentile([50, 99])
             out.update(
@@ -196,6 +283,12 @@ class ServingMetrics:
                 queue_wait_p99_s=round(float(q99), 6),
                 queue_wait_max_s=round(self._queue_waits.maximum, 6),
             )
+        if self.deferred_lookups:
+            out["deferred_lookups"] = self.deferred_lookups
+            if self.num_requests:
+                out["deferred_rate"] = round(
+                    self.deferred_lookups / self.num_requests, 6
+                )
         if self.num_swaps:
             out["swaps"] = {
                 "num_swaps": self.num_swaps,
@@ -227,4 +320,14 @@ class ServingMetrics:
             out["cache_hit_rate"] = (
                 round(hits / (hits + misses), 6) if hits + misses else 0.0
             )
+        if residency:
+            # device-resident fraction per RE coordinate: what share of
+            # lookups hit rows already on device (replaces cache_hit_rate in
+            # sharded mode, where there is no per-request host cache)
+            out["residency"] = dict(residency)
+            on = sum(r.get("resident_lookups", 0) for r in residency.values())
+            tot = sum(r.get("total_lookups", 0) for r in residency.values())
+            out["device_resident_rate"] = round(on / tot, 6) if tot else 0.0
+        if admission:
+            out["admission"] = dict(admission)
         return out

@@ -18,6 +18,7 @@ import numpy as np
 from photon_ml_tpu.data.game_data import GameData
 from photon_ml_tpu.serving.artifact import ServingArtifact
 from photon_ml_tpu.serving.batcher import DEFAULT_BUCKET_SIZES, MicroBatcher
+from photon_ml_tpu.serving.continuous import ContinuousBatcher
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
 from photon_ml_tpu.telemetry import span
@@ -89,6 +90,10 @@ def replay_requests(
     swap_manager=None,
     watch_dir: Optional[str] = None,
     poll_every: int = 256,
+    continuous: bool = False,
+    max_wait_s: float = 0.002,
+    max_queue: Optional[int] = None,
+    admission=None,
 ) -> Tuple[List[ScoreResult], dict]:
     """Pump a request stream through a fresh microbatcher.
 
@@ -100,11 +105,20 @@ def replay_requests(
     called every ``poll_every`` requests — new deltas land between batches,
     never under an in-flight one; swap reports ride in the snapshot under
     ``"swap_reports"``.
+
+    ``continuous=True`` drives a :class:`ContinuousBatcher` instead of the
+    sealed ``MicroBatcher``: ``scorer`` may then be ONE scorer or a list
+    of replicas (multi-scorer mode), requests are submitted in bursts and
+    scored by the batcher's threads, and ``max_wait_s``/``max_queue``
+    bound deadline and backpressure. An ``AdmissionController`` passed as
+    ``admission`` runs for the duration of the replay (started/stopped
+    here when not already running) and its stats ride in the snapshot.
     """
     from photon_ml_tpu.event import ScoringFinishEvent, ScoringStartEvent
 
+    scorers = list(scorer) if isinstance(scorer, (list, tuple)) else [scorer]
+    lead = scorers[0]
     metrics = metrics if metrics is not None else ServingMetrics()
-    batcher = MicroBatcher(scorer, bucket_sizes=bucket_sizes, metrics=metrics)
     if emitter is not None:
         emitter.send_event(
             ScoringStartEvent(model_id=model_id, num_requests=len(requests))
@@ -112,19 +126,73 @@ def replay_requests(
     watching = swap_manager is not None and watch_dir is not None
     poll_every = max(1, int(poll_every))
     swap_reports: List[object] = []
-    t0 = time.perf_counter()
     results: List[ScoreResult] = []
-    with span("serve/replay", num_requests=len(requests), model_id=model_id):
-        for i, req in enumerate(requests):
-            if watching and i % poll_every == 0:
+
+    started_admission = False
+    if admission is not None and admission._thread is None:
+        admission.start()
+        started_admission = True
+    try:
+        t0 = time.perf_counter()
+        with span(
+            "serve/replay", num_requests=len(requests), model_id=model_id
+        ):
+            if continuous:
+                batcher = ContinuousBatcher(
+                    scorers,
+                    bucket_sizes=bucket_sizes,
+                    metrics=metrics,
+                    max_wait_s=max_wait_s,
+                    max_queue=max_queue,
+                ).start()
+                try:
+                    handles = []
+                    chunk = batcher.max_bucket
+                    for i in range(0, len(requests), chunk):
+                        if watching and (i // chunk) % max(
+                            1, poll_every // chunk
+                        ) == 0:
+                            batcher.flush()
+                            swap_reports.extend(
+                                swap_manager.poll_directory(watch_dir)
+                            )
+                        handles.extend(
+                            batcher.submit_many(requests[i : i + chunk])
+                        )
+                    batcher.flush()
+                finally:
+                    batcher.stop()
+                results = [h.result(timeout=0) for h in handles]
+            else:
+                if len(scorers) != 1:
+                    raise ValueError(
+                        "sealed replay drives one scorer; pass "
+                        "continuous=True for multi-scorer mode"
+                    )
+                batcher = MicroBatcher(
+                    lead, bucket_sizes=bucket_sizes, metrics=metrics
+                )
+                for i, req in enumerate(requests):
+                    if watching and i % poll_every == 0:
+                        results.extend(batcher.flush())
+                        swap_reports.extend(
+                            swap_manager.poll_directory(watch_dir)
+                        )
+                    results.extend(batcher.submit(req))
                 results.extend(batcher.flush())
-                swap_reports.extend(swap_manager.poll_directory(watch_dir))
-            results.extend(batcher.submit(req))
-        results.extend(batcher.flush())
-    wall = time.perf_counter() - t0
+        wall = time.perf_counter() - t0
+    finally:
+        if started_admission:
+            admission.stop()
+
+    residency = None
+    if hasattr(lead, "residency_stats"):
+        residency = lead.residency_stats() or None
     snapshot = metrics.snapshot(
-        cache_stats=scorer.cache_stats() or None,
-        compile_count=scorer.compile_count,
+        cache_stats=lead.cache_stats() or None,
+        compile_count=max(s.compile_count for s in scorers),
+        residency=residency,
+        admission=admission.stats() if admission is not None else None,
     )
     snapshot["replay_wall_seconds"] = round(wall, 6)
     if wall > 0:

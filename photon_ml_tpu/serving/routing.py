@@ -1,0 +1,300 @@
+"""Entity→(shard, slot) routing index for sharded device RE tables.
+
+The single-table scorer resolves an entity to ONE row index in one device
+table. The sharded scorer splits each random-effect table across ``S``
+device shards (one per mesh device in multi-scorer mode), so resolution
+becomes two coordinates: which shard holds the row, and which slot within
+that shard. This module owns that mapping — pure host state, shared by
+every scorer replica so they stay mutually consistent, with no device
+arrays of its own.
+
+Layout: the base resident set (rows ``0..R-1`` of the packed table, the
+hottest rows when the artifact is popularity-sorted, all rows when the
+device budget covers the table) is placed CYCLICALLY: global row ``r``
+lives at ``(r % S, r // S)`` — the grid layout of
+``parallel/grid_features.py`` applied to table rows, balancing both
+capacity and gather traffic across shards for any contiguous hot prefix.
+Rows beyond the budget start non-resident (slot −1) and are admitted
+later into headroom slots by ``serving/admission.py``; when headroom runs
+out the oldest ADMITTED row is evicted (the base set is pinned).
+
+Publication ordering contract (what makes lock-free readers safe): a row
+becomes resident only AFTER its device content is written (``publish`` is
+the last step), and is evicted by first clearing ``slot_of`` (readers
+immediately fall back to the cold slot → FE-only score) and only then
+reusing the slot's device storage. A reader can therefore never gather
+another entity's coefficients; the worst case is one FE-only score during
+the handover, identical to the cold-entity degradation.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class CoordinateRouting:
+    """Routing state for ONE random-effect coordinate.
+
+    ``num_shards`` device shards of ``shard_capacity`` data slots each
+    (slot ``shard_capacity`` is every shard's permanently-zero cold slot).
+    ``resident_rows`` rows of the backing table start device-resident in
+    the cyclic layout; the remaining device slots are admission headroom.
+    """
+
+    def __init__(
+        self,
+        n_rows: int,
+        num_shards: int,
+        shard_capacity: int,
+        resident_rows: Optional[int] = None,
+    ):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if shard_capacity < 1:
+            raise ValueError(
+                f"shard_capacity must be >= 1, got {shard_capacity}"
+            )
+        self.n_rows = int(n_rows)
+        self.num_shards = int(num_shards)
+        self.shard_capacity = int(shard_capacity)
+        self.cold_slot = self.shard_capacity
+        device_rows = self.num_shards * self.shard_capacity
+        base = device_rows if resident_rows is None else int(resident_rows)
+        base = max(0, min(base, self.n_rows, device_rows))
+        self.base_rows = base  # pinned: never evicted
+
+        # global row -> (shard, slot); slot -1 = not device-resident
+        self._shard_of = np.zeros(max(self.n_rows, 1), dtype=np.int32)
+        self._slot_of = np.full(max(self.n_rows, 1), -1, dtype=np.int32)
+        if base:
+            r = np.arange(base)
+            self._shard_of[:base] = r % self.num_shards
+            self._slot_of[:base] = r // self.num_shards
+
+        # free device slots beyond the base set, round-robin across shards
+        # (same cyclic order as the base layout)
+        free = np.arange(base, device_rows)
+        self._free: Deque[Tuple[int, int]] = deque(
+            zip(
+                (free % self.num_shards).tolist(),
+                (free // self.num_shards).tolist(),
+            )
+        )
+        # admitted (evictable) rows, oldest first
+        self._admitted: Deque[int] = deque()
+
+        # lookup accounting (reset via reset_counters)
+        self.resident_lookups = 0
+        self.deferred_lookups = 0  # known entity, not yet device-resident
+        self.cold_lookups = 0  # entity absent from the model
+        self.admitted_total = 0
+        self.evicted_total = 0
+
+    # ---------------------------------------------------------------- route
+
+    def route(
+        self, entity_rows: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Vectorized batch routing: global table rows (−1 = unknown) →
+        int32 ``(shards, slots)`` arrays plus the unique DEFERRED rows
+        (known entities currently not device-resident — they score through
+        the cold slot this batch and should be queued for admission)."""
+        rows = np.asarray(entity_rows, dtype=np.int64)
+        shards = np.zeros(rows.shape, dtype=np.int32)
+        slots = np.full(rows.shape, self.cold_slot, dtype=np.int32)
+        known = rows >= 0
+        n_known = int(np.count_nonzero(known))
+        self.cold_lookups += rows.size - n_known
+        if not n_known:
+            return shards, slots, np.empty(0, dtype=np.int64)
+        krows = rows[known]
+        kslots = self._slot_of[krows]
+        kshards = self._shard_of[krows]
+        resident = kslots >= 0
+        n_res = int(np.count_nonzero(resident))
+        self.resident_lookups += n_res
+        self.deferred_lookups += n_known - n_res
+        out_slots = np.where(resident, kslots, self.cold_slot)
+        out_shards = np.where(resident, kshards, 0)
+        slots[known] = out_slots
+        shards[known] = out_shards
+        deferred = (
+            np.unique(krows[~resident])
+            if n_res < n_known
+            else np.empty(0, dtype=np.int64)
+        )
+        return shards, slots, deferred
+
+    def is_resident(self, row: int) -> bool:
+        return 0 <= row < self.n_rows and self._slot_of[row] >= 0
+
+    def placement(self, row: int) -> Tuple[int, int]:
+        """(shard, slot) of a resident row (slot −1 when not resident)."""
+        return int(self._shard_of[row]), int(self._slot_of[row])
+
+    # ----------------------------------------------------- slot allocation
+
+    def allocate(self, k: int) -> Tuple[np.ndarray, np.ndarray, List[int]]:
+        """Claim ``k`` device slots for admission. Returns int arrays
+        ``(shards, slots)`` plus the list of rows EVICTED to make room
+        (already unpublished here — the caller must zero/overwrite their
+        device slots before publishing new occupants). Raises when the
+        coordinate has fewer than ``k`` evictable slots in total."""
+        shards = np.empty(k, dtype=np.int32)
+        slots = np.empty(k, dtype=np.int32)
+        evicted: List[int] = []
+        for i in range(k):
+            if self._free:
+                shard, slot = self._free.popleft()
+            elif self._admitted:
+                victim = self._admitted.popleft()
+                shard, slot = self.placement(victim)
+                # unpublish BEFORE the slot is reused: readers of the
+                # victim fall back to FE-only from this point on
+                self._slot_of[victim] = -1
+                self.evicted_total += 1
+                evicted.append(victim)
+            else:
+                raise RuntimeError(
+                    f"no admission headroom: {self.base_rows} base rows "
+                    f"fill all {self.num_shards}x{self.shard_capacity} "
+                    "device slots — raise the device budget or lower the "
+                    "resident base"
+                )
+            shards[i] = shard
+            slots[i] = slot
+        return shards, slots, evicted
+
+    def publish(
+        self, rows: np.ndarray, shards: np.ndarray, slots: np.ndarray
+    ) -> None:
+        """Make admitted rows visible to routing. Call ONLY after their
+        device content is written in every scorer replica."""
+        rows = np.asarray(rows, dtype=np.int64)
+        self._shard_of[rows] = np.asarray(shards, dtype=np.int32)
+        self._slot_of[rows] = np.asarray(slots, dtype=np.int32)
+        self._admitted.extend(int(r) for r in rows)
+        self.admitted_total += rows.size
+
+    def grow(self, n_rows: int) -> None:
+        """Extend the row space (hot-swap appended new entities to the
+        backing table). New rows start non-resident; device capacity is
+        unchanged — admission headroom absorbs them."""
+        n_rows = int(n_rows)
+        if n_rows <= self.n_rows:
+            return
+        extra = n_rows - self._slot_of.size
+        if extra > 0:
+            self._shard_of = np.concatenate(
+                [self._shard_of, np.zeros(extra, dtype=np.int32)]
+            )
+            self._slot_of = np.concatenate(
+                [self._slot_of, np.full(extra, -1, dtype=np.int32)]
+            )
+        self.n_rows = n_rows
+
+    def unpublish(self, rows: np.ndarray) -> None:
+        """Drop rows from routing (hot-swap invalidation). Their slots are
+        NOT freed for reuse — a subsequent admission re-publishes them."""
+        rows = np.asarray(rows, dtype=np.int64)
+        keep = rows[(rows >= 0) & (rows < self.n_rows)]
+        self._slot_of[keep] = -1
+
+    # ------------------------------------------------------------ counters
+
+    @property
+    def resident_rows(self) -> int:
+        return int(np.count_nonzero(self._slot_of[: self.n_rows] >= 0))
+
+    @property
+    def device_rows(self) -> int:
+        return self.num_shards * self.shard_capacity
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def reset_counters(self) -> None:
+        self.resident_lookups = 0
+        self.deferred_lookups = 0
+        self.cold_lookups = 0
+
+    def stats(self) -> Dict[str, float]:
+        total = (
+            self.resident_lookups + self.deferred_lookups + self.cold_lookups
+        )
+        return {
+            "num_shards": self.num_shards,
+            "shard_capacity": self.shard_capacity,
+            "device_rows": self.device_rows,
+            "resident_rows": self.resident_rows,
+            "base_rows": self.base_rows,
+            "resident_lookups": self.resident_lookups,
+            "deferred_lookups": self.deferred_lookups,
+            "cold_lookups": self.cold_lookups,
+            "total_lookups": total,
+            "admitted_total": self.admitted_total,
+            "evicted_total": self.evicted_total,
+        }
+
+
+class RoutingIndex:
+    """Per-coordinate :class:`CoordinateRouting`, shared across every
+    scorer replica in multi-scorer mode (one device table per replica, ONE
+    routing truth — replicas can only disagree about content mid-admission,
+    never about where a row lives)."""
+
+    def __init__(self, coordinates: Dict[str, CoordinateRouting]):
+        self.coordinates = dict(coordinates)
+
+    def __getitem__(self, cid: str) -> CoordinateRouting:
+        return self.coordinates[cid]
+
+    def __contains__(self, cid: str) -> bool:
+        return cid in self.coordinates
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        return {cid: c.stats() for cid, c in self.coordinates.items()}
+
+    def reset_counters(self) -> None:
+        for c in self.coordinates.values():
+            c.reset_counters()
+
+
+def build_routing(
+    re_tables: Dict[str, int],
+    num_shards: int,
+    device_budget_rows: Optional[int] = None,
+    headroom_fraction: float = 0.25,
+) -> RoutingIndex:
+    """Routing for a set of RE coordinates (``cid -> n_rows``).
+
+    ``device_budget_rows`` caps TOTAL device data rows per coordinate
+    (across shards). ``None`` = full residency: every row resident, plus
+    ``headroom_fraction`` extra slots so hot-swaps can append new entities
+    without a table rebuild. A finite budget splits into a resident base
+    (the first ``(1 - headroom_fraction) * budget`` rows — the packed
+    table's hot prefix) and admission headroom for the long tail.
+    """
+    coords: Dict[str, CoordinateRouting] = {}
+    for cid, n_rows in re_tables.items():
+        n_rows = int(n_rows)
+        if device_budget_rows is None:
+            base = n_rows
+            budget = n_rows + max(num_shards, int(n_rows * headroom_fraction))
+        else:
+            budget = max(int(device_budget_rows), num_shards)
+            base = min(n_rows, int(budget * (1.0 - headroom_fraction)))
+            if budget >= n_rows + num_shards:
+                base = n_rows  # budget covers the table: all pinned
+        cap = max(1, -(-budget // num_shards))  # ceil
+        coords[cid] = CoordinateRouting(
+            n_rows=n_rows,
+            num_shards=num_shards,
+            shard_capacity=cap,
+            resident_rows=base,
+        )
+    return RoutingIndex(coords)

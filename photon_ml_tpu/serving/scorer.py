@@ -22,6 +22,8 @@ that only runs when jit traces).
 from __future__ import annotations
 
 import dataclasses
+import operator
+from itertools import chain
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -41,12 +43,88 @@ class ScoreRequest:
     offset: float = 0.0
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class ScoreResult:
     request_id: str
     score: float  # margin z including the request offset (GameModel.score + offset)
     mean: float   # task link-inverse of the margin
     cold_coordinates: Tuple[str, ...] = ()  # RE coordinates served FE-only
+
+
+_EMPTY_FEATS: Dict[int, float] = {}
+_FEAT_VALUES = operator.methodcaller("values")
+_REQ_OFFSET = operator.attrgetter("offset")
+_REQ_ENTITY_IDS = operator.attrgetter("entity_ids")
+
+
+def featurize_requests(
+    requests: Sequence[ScoreRequest],
+    n: int,
+    bucket: int,
+    shard_nnz: Dict[str, int],
+    shard_dim: Dict[str, int],
+) -> Tuple[Dict[str, Tuple[np.ndarray, np.ndarray]], np.ndarray]:
+    """Pack ``n`` requests into padded ``[bucket, K]`` value/index arrays
+    per shard plus a ``[bucket]`` offsets vector.
+
+    One flat ``np.fromiter`` pass over all nonzeros per shard, fed by
+    C-level ``chain.from_iterable`` iteration (the per-row dict loop this
+    replaces was the second-largest serving cost after the cache fill, and
+    a nested generator expression here costs two frame resumes per
+    nonzero); output is bit-identical to the row-at-a-time packing — same
+    dict iteration order, same zero padding. Shared by the single-table
+    and the sharded scorer so their featurization cannot drift apart."""
+    shards: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for shard, k in shard_nnz.items():
+        dim = shard_dim[shard]
+        vals = np.zeros((bucket, k), dtype=np.float32)
+        idx = np.zeros((bucket, k), dtype=np.int32)
+        feats_list = [req.features.get(shard) or _EMPTY_FEATS
+                      for req in requests]
+        lens = np.fromiter(map(len, feats_list), dtype=np.int64, count=n)
+        total = int(lens.sum())
+        if total:
+            if int(lens.max()) > k:
+                i = int(np.argmax(lens))
+                raise ValueError(
+                    f"request {requests[i].request_id!r} has {int(lens[i])} "
+                    f"nonzeros in shard {shard!r} but the scorer was built "
+                    f"with max_nnz={k} — raise max_nnz"
+                )
+            flat_idx = np.fromiter(
+                chain.from_iterable(feats_list),
+                dtype=np.int64, count=total,
+            )
+            if flat_idx.size and (
+                int(flat_idx.min()) < 0 or int(flat_idx.max()) >= dim
+            ):
+                rows_of = np.repeat(np.arange(n), lens)
+                bad = int(rows_of[(flat_idx < 0) | (flat_idx >= dim)][0])
+                bad_c = next(
+                    c for c in requests[bad].features[shard]
+                    if not 0 <= int(c) < dim
+                )
+                raise ValueError(
+                    f"request {requests[bad].request_id!r}: feature index "
+                    f"{int(bad_c)} out of range for shard {shard!r} "
+                    f"(dim {dim})"
+                )
+            flat_val = np.fromiter(
+                chain.from_iterable(map(_FEAT_VALUES, feats_list)),
+                dtype=np.float32, count=total,
+            )
+            rows = np.repeat(np.arange(n), lens)
+            starts = np.repeat(np.cumsum(lens) - lens, lens)
+            cols = np.arange(total) - starts
+            idx[rows, cols] = flat_idx
+            vals[rows, cols] = flat_val
+        shards[shard] = (vals, idx)
+    offsets = np.zeros(bucket, dtype=np.float32)
+    if n:
+        offsets[:n] = np.fromiter(
+            map(_REQ_OFFSET, requests), dtype=np.float32, count=n
+        )
+    return shards, offsets
 
 
 class _FullTable:
@@ -318,35 +396,9 @@ class GameScorer:
         return shape_changed
 
     def _featurize(self, requests: Sequence[ScoreRequest], bucket: int):
-        shards = {}
-        for shard, k in self._shard_nnz.items():
-            dim = self._shard_dim[shard]
-            vals = np.zeros((bucket, k), dtype=np.float32)
-            idx = np.zeros((bucket, k), dtype=np.int32)
-            for i, req in enumerate(requests):
-                feats = req.features.get(shard)
-                if not feats:
-                    continue
-                if len(feats) > k:
-                    raise ValueError(
-                        f"request {req.request_id!r} has {len(feats)} nonzeros "
-                        f"in shard {shard!r} but the scorer was built with "
-                        f"max_nnz={k} — raise max_nnz"
-                    )
-                for j, (c, v) in enumerate(feats.items()):
-                    c = int(c)
-                    if not 0 <= c < dim:
-                        raise ValueError(
-                            f"request {req.request_id!r}: feature index {c} "
-                            f"out of range for shard {shard!r} (dim {dim})"
-                        )
-                    idx[i, j] = c
-                    vals[i, j] = float(v)
-            shards[shard] = (vals, idx)
-        offsets = np.zeros(bucket, dtype=np.float32)
-        for i, req in enumerate(requests):
-            offsets[i] = req.offset
-        return shards, offsets
+        return featurize_requests(
+            requests, len(requests), bucket, self._shard_nnz, self._shard_dim
+        )
 
     def score_batch(
         self,
@@ -381,14 +433,22 @@ class GameScorer:
         for cid, _, re_type in self._re_specs:
             table = self._artifact.tables[cid]
             entity_rows = np.full(bucket, -1, dtype=np.int64)
-            ids, where = [], []
-            for i, req in enumerate(requests):
-                eid = req.entity_ids.get(re_type)
-                if eid is not None:
-                    ids.append(str(eid))
-                    where.append(i)
-            if ids:
-                entity_rows[np.asarray(where)] = table.entity_index.get_indices(ids)
+            # ids stay C-level; the common every-request-carries-an-id
+            # case hands the whole list to one vectorized lookup
+            ids = list(
+                map(
+                    operator.methodcaller("get", re_type),
+                    map(_REQ_ENTITY_IDS, requests),
+                )
+            )
+            if None not in ids:
+                entity_rows[:n] = table.entity_index.get_indices(ids)
+            else:
+                where = [i for i, e in enumerate(ids) if e is not None]
+                if where:
+                    entity_rows[np.asarray(where)] = (
+                        table.entity_index.get_indices([ids[i] for i in where])
+                    )
             for i in range(n):
                 if entity_rows[i] < 0:
                     cold[i].append(cid)

@@ -1,0 +1,600 @@
+"""Sharded device-resident serving: per-shard RE tables + entity routing.
+
+The single-table :class:`~photon_ml_tpu.serving.scorer.GameScorer` keeps
+one ``[rows+1, dim]`` device table per RE coordinate (or a host-side LRU
+cache in front of it — whose per-request scatter fill is exactly the
+compile-storm and host-hop this module removes from the hot path). Here
+each coordinate's table is partitioned across ``S`` shards of a serving
+mesh (``parallel/mesh.py``; the cyclic row layout mirrors the grid
+placement of ``parallel/grid_features.py``), stacked as ONE device array
+``[S, cap+1, dim]`` sharded over its leading axis — so a batch of B
+requests becomes a single jitted two-coordinate gather
+``table[shard, slot]`` (one gather per shard after XLA partitioning),
+with no host work beyond the O(B) routing-index probe.
+
+Residency semantics, in order of degradation:
+
+- resident entity  → its device row, bit-identical to the packed table;
+- known, non-resident (cold long tail beyond the device budget) → the
+  zero cold slot NOW + queued for asynchronous admission
+  (``serving/admission.py``), so the next request finds it resident;
+- unknown entity → the zero cold slot, the Photon-ML left-join FE-only
+  fallback — same as the single-table scorer.
+
+The scorer mirrors ``GameScorer``'s public surface (score_batch,
+compile_count, hot-swap hooks) so ``MicroBatcher``/``ContinuousBatcher``,
+``HotSwapManager``, and ``replay_requests`` drive either interchangeably.
+"""
+
+from __future__ import annotations
+
+import operator
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from photon_ml_tpu.serving.artifact import ServingArtifact
+from photon_ml_tpu.serving.routing import (
+    CoordinateRouting,
+    RoutingIndex,
+    build_routing,
+)
+from photon_ml_tpu.serving.scorer import (
+    _REQ_ENTITY_IDS,
+    ScoreRequest,
+    ScoreResult,
+    featurize_requests,
+)
+from photon_ml_tpu.telemetry import note_jit_trace, span
+
+
+_SCATTER_FN = None
+
+
+def _donated_scatter():
+    """Cached jitted row scatter with the table buffer DONATED: the write
+    lands in place instead of copying the whole ``[S, cap+1, dim]`` table
+    per admission step (a ~23x step-cost difference at a 16k-row budget —
+    the copy was the dominant p99 spike under continuous load). Donation
+    invalidates the previous array object, so every caller must hold the
+    owning scorer's ``write_lock`` (scoring holds it across its gather)."""
+    global _SCATTER_FN
+    if _SCATTER_FN is None:
+        import jax
+
+        _SCATTER_FN = jax.jit(
+            lambda table, shards, slots, values: table.at[shards, slots].set(
+                values
+            ),
+            donate_argnums=0,
+        )
+    return _SCATTER_FN
+
+
+def serving_mesh(num_devices: Optional[int] = None):
+    """1-D serving mesh over the available devices (the shard axis of the
+    stacked RE tables is laid out over it). Degenerates to a single-device
+    mesh on CPU; on a real slice each table shard lives in its own HBM."""
+    from photon_ml_tpu.parallel.mesh import data_parallel_mesh
+
+    return data_parallel_mesh(num_devices=num_devices)
+
+
+class ShardedReTable:
+    """One RE coordinate's device storage for one scorer replica.
+
+    Stacked array ``[S, cap+1, dim]``: shard ``s`` holds data slots
+    ``0..cap-1`` plus the permanently-zero cold slot ``cap``. WHERE a row
+    lives is owned by the shared :class:`CoordinateRouting`; this object
+    owns only the bytes (each replica has its own copy of the bytes, all
+    replicas share one routing truth).
+
+    The host backing store (the packed artifact table, possibly mmap'd)
+    stays authoritative for non-resident rows; hot-swap row updates that
+    diverge from it are kept in an override map so an evicted row re-admits
+    with its swapped content, not the stale packed bytes.
+    """
+
+    def __init__(
+        self,
+        backing: np.ndarray,
+        routing: CoordinateRouting,
+        mesh=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        if backing.ndim != 2:
+            raise ValueError(f"backing store must be 2-D, got {backing.shape}")
+        self._backing = backing
+        self._overrides: Dict[int, np.ndarray] = {}
+        self.routing = routing
+        self._mesh = mesh
+        S, cap, dim = routing.num_shards, routing.shard_capacity, backing.shape[1]
+        host = np.zeros((S, cap + 1, dim), dtype=np.float32)
+        base = routing.base_rows
+        if base:
+            r = np.arange(base)
+            host[r % S, r // S] = np.asarray(backing[:base], dtype=np.float32)
+        self._table = self._place(host)
+
+    def _place(self, host: np.ndarray):
+        import jax
+        import jax.numpy as jnp
+
+        if self._mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from photon_ml_tpu.parallel.mesh import DATA_AXIS, place
+
+            n_dev = self._mesh.devices.size
+            if host.shape[0] % n_dev == 0:
+                return place(host, self._mesh, P(DATA_AXIS))
+        return jnp.asarray(host)
+
+    # ------------------------------------------------------------- reading
+
+    @property
+    def table(self):
+        """Device array [S, cap+1, dim]; slot ``cap`` of every shard is the
+        zero cold slot."""
+        return self._table
+
+    @property
+    def cold_slot(self) -> int:
+        return self.routing.cold_slot
+
+    @property
+    def capacity(self) -> int:
+        """Total device data rows across shards."""
+        return self.routing.device_rows
+
+    def host_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Authoritative host-side content for global rows: the backing
+        store with hot-swap overrides applied; rows beyond the store (new
+        entities appended by a swap) default to zero unless overridden."""
+        rows = np.asarray(rows, dtype=np.int64)
+        out = np.zeros((rows.size, self._backing.shape[1]), dtype=np.float32)
+        in_store = rows < self._backing.shape[0]
+        if in_store.any():
+            out[in_store] = np.asarray(
+                self._backing[rows[in_store]], dtype=np.float32
+            )
+        if self._overrides:
+            for i, r in enumerate(rows):
+                ov = self._overrides.get(int(r))
+                if ov is not None:
+                    out[i] = ov
+        return out
+
+    # ------------------------------------------------------------- writing
+
+    def write_slots(
+        self, shards: np.ndarray, slots: np.ndarray, values: np.ndarray
+    ) -> None:
+        """Scatter rows into (shard, slot) storage — genuinely in place
+        (the table buffer is donated to the jitted scatter, no full-table
+        copy), no shape change, no retrace. Callers padding to a fixed
+        batch shape (the admission tier) aim the pad writes at
+        ``(0, cold_slot)`` with zero values, which keeps the cold slot
+        zero and the scatter program count at one.
+
+        Donation invalidates the prior table array object: hold the owning
+        scorer's ``write_lock`` so no in-flight gather still references it.
+        """
+        import jax.numpy as jnp
+
+        self._table = _donated_scatter()(
+            self._table,
+            jnp.asarray(np.asarray(shards, dtype=np.int32)),
+            jnp.asarray(np.asarray(slots, dtype=np.int32)),
+            jnp.asarray(np.ascontiguousarray(values, dtype=np.float32)),
+        )
+
+    def update_rows(self, rows: np.ndarray, values: np.ndarray) -> None:
+        """Hot-swap hook: update/append global rows in place. Resident rows
+        are overwritten in their slots; non-resident rows are admitted
+        immediately (allocating headroom slots, evicting the oldest
+        admitted rows when full). Raises only when the coordinate has no
+        headroom left for genuinely new rows."""
+        rows = np.asarray(rows, dtype=np.int64).ravel()
+        values = np.asarray(values, dtype=np.float32).reshape(rows.size, -1)
+        if rows.size == 0:
+            return
+        if rows.max() >= self.routing.n_rows:
+            self.routing.grow(int(rows.max()) + 1)
+        for r, v in zip(rows, values):
+            self._overrides[int(r)] = np.array(v, dtype=np.float32)
+        res_slots = self.routing._slot_of[rows]
+        resident = res_slots >= 0
+        new_rows = np.unique(rows[~resident])
+        if new_rows.size:
+            # evicted rows are unpublished inside allocate(); their slots
+            # are exactly the ones reused here, so the new content below
+            # overwrites them with no separate zeroing pass
+            a_shards, a_slots, _ = self.routing.allocate(new_rows.size)
+            self.write_slots(
+                a_shards, a_slots, self.host_rows(new_rows)
+            )
+            self.routing.publish(new_rows, a_shards, a_slots)
+            res_slots = self.routing._slot_of[rows]
+        # only still-resident rows get the in-place write: a row of this
+        # batch evicted to make room stays FE-only until re-admission (its
+        # override already carries the new content)
+        resident = res_slots >= 0
+        if resident.any():
+            self.write_slots(
+                self.routing._shard_of[rows[resident]],
+                res_slots[resident],
+                values[resident],
+            )
+
+    def fits(self, targets: np.ndarray) -> bool:
+        """Whether a hot-swap touching these global rows stays in-shape:
+        every non-resident target can claim a headroom slot (free or by
+        evicting an admitted row)."""
+        targets = np.asarray(targets, dtype=np.int64).ravel()
+        known = targets[targets < self.routing.n_rows]
+        resident = (
+            self.routing._slot_of[known] >= 0
+            if known.size
+            else np.empty(0, dtype=bool)
+        )
+        n_new = np.unique(targets).size - np.unique(known[resident]).size
+        return n_new <= self.routing.free_slots + len(self.routing._admitted)
+
+    def stats(self) -> Dict[str, float]:
+        return self.routing.stats()
+
+
+class ShardedGameScorer:
+    """``GameScorer`` with sharded device-resident RE tables.
+
+    Public surface mirrors :class:`GameScorer` (``score_batch`` /
+    ``compile_count`` / hot-swap hooks / empty ``caches``), so every
+    existing driver works unchanged. Differences:
+
+    - RE rows come from one two-coordinate gather over the stacked
+      ``[S, cap+1, dim]`` table per coordinate — the gathered bytes (and
+      therefore the scores) are bit-identical to the single-table scorer.
+    - ``num_shards`` / ``device_budget_rows`` bound device memory; the
+      long tail beyond the budget starts cold and is pulled on-device by
+      an :class:`~photon_ml_tpu.serving.admission.AdmissionController`
+      attached via :meth:`attach_admission`.
+    - ``routing`` may be a shared :class:`RoutingIndex` (multi-scorer
+      mode: every replica gathers through the same entity placement).
+    """
+
+    def __init__(
+        self,
+        artifact: ServingArtifact,
+        max_nnz: Optional[Union[int, Dict[str, int]]] = None,
+        num_shards: int = 4,
+        device_budget_rows: Optional[int] = None,
+        mesh=None,
+        routing: Optional[RoutingIndex] = None,
+        headroom_fraction: float = 0.25,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.losses.pointwise import mean_function
+
+        self._artifact = artifact
+        self._task = artifact.task
+        self.num_shards = int(num_shards)
+        self.device_budget_rows = device_budget_rows
+        dims = artifact.shard_dims()
+        self._shard_nnz: Dict[str, int] = {}
+        for shard, dim in dims.items():
+            if isinstance(max_nnz, dict):
+                k = max_nnz.get(shard, dim)
+            elif max_nnz is not None:
+                k = int(max_nnz)
+            else:
+                k = dim
+            self._shard_nnz[shard] = max(1, min(int(k), dim))
+        self._shard_dim = dims
+
+        self._fe_specs: List[Tuple[str, str]] = []
+        self._re_specs: List[Tuple[str, str, str]] = []
+        self.caches: Dict[str, object] = {}  # no host cache on this path
+        self._providers: Dict[str, ShardedReTable] = {}
+        self._mesh = mesh
+        self._headroom_fraction = float(headroom_fraction)
+        self._admission = None
+        # serializes donated table writes against in-flight gathers: the
+        # scoring thread holds it across param capture + score + sync,
+        # writers (admission, hot swap) hold it across write_slots
+        self.write_lock = threading.Lock()
+        fe_params: Dict[str, object] = {}
+        re_rows = {
+            cid: t.n_entities
+            for cid, t in artifact.tables.items()
+            if t.is_random_effect
+        }
+        if routing is None:
+            routing = build_routing(
+                re_rows,
+                num_shards=self.num_shards,
+                device_budget_rows=device_budget_rows,
+                headroom_fraction=self._headroom_fraction,
+            )
+        self._routing = routing
+        for cid in sorted(artifact.tables):
+            table = artifact.tables[cid]
+            if table.is_random_effect:
+                self._re_specs.append(
+                    (cid, table.feature_shard, table.random_effect_type)
+                )
+                self._providers[cid] = ShardedReTable(
+                    np.asarray(table.weights),
+                    routing[cid],
+                    mesh=mesh,
+                )
+            else:
+                self._fe_specs.append((cid, table.feature_shard))
+                fe_params[cid] = jnp.asarray(
+                    np.ascontiguousarray(table.weights, dtype=np.float32)
+                )
+        self._fe_params = fe_params
+        self._compiles = 0
+
+        fe_specs = tuple(self._fe_specs)
+        re_specs = tuple(self._re_specs)
+        task = self._task
+
+        def _score(params, batch):
+            # trace-time side effect: runs once per compiled shape signature
+            self._compiles += 1
+            note_jit_trace("serving_score")
+            z = batch["offsets"]
+            for cid, shard in fe_specs:
+                vals, idx = batch["shards"][shard]
+                z = z + (vals * params["fe"][cid][idx]).sum(axis=1)
+            for cid, shard, _ in re_specs:
+                vals, idx = batch["shards"][shard]
+                # THE sharded gather: [B] shard ids + [B] slots against the
+                # stacked [S, cap+1, dim] table — XLA partitions this into
+                # one gather per shard over the mesh
+                rows = params["re"][cid][
+                    batch["re_shards"][cid], batch["slots"][cid]
+                ]
+                z = z + (vals * jnp.take_along_axis(rows, idx, axis=1)).sum(axis=1)
+            return z, mean_function(task, z)
+
+        self._score_fn = jax.jit(_score)
+
+    # ---------------------------------------------------------- properties
+
+    @property
+    def compile_count(self) -> int:
+        """XLA traces of the score function — one per bucket size."""
+        return self._compiles
+
+    @property
+    def task(self):
+        return self._task
+
+    @property
+    def artifact(self) -> ServingArtifact:
+        return self._artifact
+
+    @property
+    def routing(self) -> RoutingIndex:
+        return self._routing
+
+    def cache_stats(self) -> Dict[str, Dict[str, float]]:
+        return {}
+
+    def residency_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-coordinate device residency + lookup accounting (the sharded
+        replacement for ``cache_stats``/``cache_hit_rate``)."""
+        return self._routing.stats()
+
+    def attach_admission(self, controller) -> None:
+        """Route deferred (known, non-resident) lookups to an admission
+        controller; without one they are only counted."""
+        self._admission = controller
+
+    # ------------------------------------------------------ hot-swap hooks
+
+    def set_artifact(self, artifact: ServingArtifact) -> None:
+        fe = [
+            (cid, t.feature_shard)
+            for cid, t in sorted(artifact.tables.items())
+            if not t.is_random_effect
+        ]
+        re = [
+            (cid, t.feature_shard, t.random_effect_type)
+            for cid, t in sorted(artifact.tables.items())
+            if t.is_random_effect
+        ]
+        if fe != self._fe_specs or re != self._re_specs:
+            raise ValueError(
+                "candidate artifact changes the coordinate structure "
+                f"(have fe={self._fe_specs} re={self._re_specs}, candidate "
+                f"fe={fe} re={re}) — a structural change needs a new scorer, "
+                "not a hot swap"
+            )
+        for cid, shard in self._fe_specs:
+            if artifact.tables[cid].dim != self._artifact.tables[cid].dim:
+                raise ValueError(
+                    f"candidate artifact changes fixed-effect dim of {cid!r}"
+                )
+        self._artifact = artifact
+
+    def update_fixed_effect(self, cid: str, weights: np.ndarray) -> None:
+        import jax.numpy as jnp
+
+        old = self._fe_params.get(cid)
+        if old is None:
+            raise ValueError(f"{cid!r} is not a fixed-effect coordinate")
+        w = np.ascontiguousarray(weights, dtype=np.float32)
+        if w.shape != old.shape:
+            raise ValueError(
+                f"fixed-effect update for {cid!r} has shape {w.shape}, "
+                f"scorer holds {old.shape}"
+            )
+        self._fe_params[cid] = jnp.asarray(w)
+
+    def update_random_effect_rows(
+        self, cid: str, rows: np.ndarray, values: np.ndarray
+    ) -> None:
+        provider = self._providers.get(cid)
+        if provider is None:
+            raise ValueError(f"{cid!r} is not a random-effect coordinate")
+        with self.write_lock:
+            provider.update_rows(rows, values)
+
+    def rebind_random_effect(self, cid: str, backing: np.ndarray) -> bool:
+        """Rebuild one coordinate's device shards from a new backing table.
+        Stays in-shape (False) when the shared routing's shard capacity
+        already accommodates the new row count — then only the bytes are
+        rebuilt; grows the routing (True, one expected retrace) otherwise.
+        In multi-scorer mode the first replica to regrow updates the SHARED
+        routing; later replicas see it already sized and rebuild in-shape.
+        """
+        provider = self._providers.get(cid)
+        if provider is None:
+            raise ValueError(f"{cid!r} is not a random-effect coordinate")
+        backing = np.asarray(backing)
+        n_new = backing.shape[0]
+        routing = self._routing[cid]
+        old_cap = routing.shard_capacity
+        if n_new > routing.device_rows or routing.n_rows != n_new:
+            fresh = build_routing(
+                {cid: n_new},
+                num_shards=routing.num_shards,
+                device_budget_rows=self.device_budget_rows,
+                headroom_fraction=self._headroom_fraction,
+            )[cid]
+            if fresh.shard_capacity < old_cap:
+                # never shrink a shared layout other replicas still serve
+                fresh = CoordinateRouting(
+                    n_rows=n_new,
+                    num_shards=routing.num_shards,
+                    shard_capacity=old_cap,
+                    resident_rows=fresh.base_rows,
+                )
+            self._routing.coordinates[cid] = fresh
+            routing = fresh
+        with self.write_lock:
+            self._providers[cid] = ShardedReTable(
+                backing, routing, mesh=self._mesh
+            )
+        return routing.shard_capacity != old_cap
+
+    # -------------------------------------------------------------- scoring
+
+    def _featurize(self, requests: Sequence[ScoreRequest], bucket: int):
+        return featurize_requests(
+            requests, len(requests), bucket, self._shard_nnz, self._shard_dim
+        )
+
+    def score_batch(
+        self,
+        requests: Sequence[ScoreRequest],
+        bucket_size: Optional[int] = None,
+    ) -> List[ScoreResult]:
+        n = len(requests)
+        bucket = int(bucket_size) if bucket_size is not None else n
+        if n == 0:
+            return []
+        if n > bucket:
+            raise ValueError(f"{n} requests do not fit bucket size {bucket}")
+        with span("serve/score_batch", n=n, bucket=bucket):
+            return self._score_batch_impl(requests, n, bucket)
+
+    def _score_batch_impl(
+        self, requests: Sequence[ScoreRequest], n: int, bucket: int
+    ) -> List[ScoreResult]:
+        import jax.numpy as jnp
+
+        with span("serve/featurize", n=n):
+            shards, offsets = self._featurize(requests, bucket)
+        re_shards: Dict[str, np.ndarray] = {}
+        slots: Dict[str, np.ndarray] = {}
+        cold: Dict[int, List[str]] = {}
+        with span("serve/route", n=n):
+            for cid, _, re_type in self._re_specs:
+                table = self._artifact.tables[cid]
+                entity_rows = np.full(bucket, -1, dtype=np.int64)
+                # mirror of GameScorer's route: ids stay C-level, and
+                # the common every-request-carries-an-id case hands the
+                # whole list to one vectorized lookup
+                ids = list(
+                    map(
+                        operator.methodcaller("get", re_type),
+                        map(_REQ_ENTITY_IDS, requests),
+                    )
+                )
+                if None not in ids:
+                    entity_rows[:n] = table.entity_index.get_indices(ids)
+                else:
+                    where = [i for i, e in enumerate(ids) if e is not None]
+                    if where:
+                        entity_rows[np.asarray(where)] = (
+                            table.entity_index.get_indices(
+                                [ids[i] for i in where]
+                            )
+                        )
+                routing = self._routing[cid]
+                cid_shards, cid_slots, deferred = routing.route(
+                    entity_rows[:n]
+                )
+                if deferred.size and self._admission is not None:
+                    self._admission.note_deferred(cid, deferred)
+                # pad rows (and this batch's FE-only rows) gather the zero
+                # cold slot of shard 0
+                full_shards = np.zeros(bucket, dtype=np.int32)
+                full_slots = np.full(
+                    bucket, routing.cold_slot, dtype=np.int32
+                )
+                full_shards[:n] = cid_shards
+                full_slots[:n] = cid_slots
+                re_shards[cid] = full_shards
+                slots[cid] = full_slots
+                served_cold = np.nonzero(
+                    full_slots[:n] == routing.cold_slot
+                )[0]
+                for i in served_cold:
+                    cold.setdefault(int(i), []).append(cid)
+
+        batch = {
+            "offsets": jnp.asarray(offsets),
+            "shards": {
+                shard: (jnp.asarray(v), jnp.asarray(i))
+                for shard, (v, i) in shards.items()
+            },
+            "re_shards": {
+                cid: jnp.asarray(s) for cid, s in re_shards.items()
+            },
+            "slots": {cid: jnp.asarray(s) for cid, s in slots.items()},
+        }
+        # write_lock spans table capture through host sync: a donated
+        # admission scatter between the capture and the gather would
+        # invalidate the captured array
+        with self.write_lock:
+            params = {
+                "fe": self._fe_params,
+                "re": {
+                    cid: self._providers[cid].table
+                    for cid, _, _ in self._re_specs
+                },
+            }
+            with span("serve/gather_score", n=n, bucket=bucket):
+                z, mean = self._score_fn(params, batch)
+                z_list = np.asarray(z)[:n].tolist()
+                mean_list = np.asarray(mean)[:n].tolist()
+        empty: Tuple[str, ...] = ()
+        return [
+            ScoreResult(
+                request_id=req.request_id,
+                score=z_list[i],
+                mean=mean_list[i],
+                cold_coordinates=tuple(cold[i]) if i in cold else empty,
+            )
+            for i, req in enumerate(requests)
+        ]

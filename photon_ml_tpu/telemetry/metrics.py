@@ -169,6 +169,9 @@ class MetricsRegistry:
             "num_swaps",
             "swap_blackout_max_ms",
             "requests_per_s",
+            "device_resident_rate",
+            "deferred_rate",
+            "deferred_lookups",
         ):
             value = snap.get(key)
             if isinstance(value, (int, float)):
@@ -186,6 +189,35 @@ class MetricsRegistry:
         compiles = snap.get("xla_compiles")
         if isinstance(compiles, (int, float)) and "compile_count" not in norm:
             norm["compile_count"] = float(compiles)
+        residency = snap.get("residency")
+        if isinstance(residency, dict):
+            # nested per-coordinate ({cid: {...}}) or one flat stats dict
+            coords = [
+                v for v in residency.values() if isinstance(v, dict)
+            ] or [residency]
+            for key, agg in (
+                ("resident_rows", sum),
+                ("device_rows", sum),
+                ("num_shards", max),
+            ):
+                values = [
+                    c[key] for c in coords
+                    if isinstance(c.get(key), (int, float))
+                ]
+                if values:
+                    norm[f"residency_{key}"] = float(agg(values))
+        admission = snap.get("admission")
+        if isinstance(admission, dict):
+            for key in (
+                "admitted_total",
+                "evicted_total",
+                "dropped_total",
+                "queue_depth",
+                "deferred_total",
+            ):
+                value = admission.get(key)
+                if isinstance(value, (int, float)):
+                    norm[f"admission_{key}"] = float(value)
         swaps = snap.get("swaps")
         if isinstance(swaps, dict):
             if isinstance(swaps.get("num_swaps"), (int, float)):

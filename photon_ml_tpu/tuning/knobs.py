@@ -178,6 +178,67 @@ register_knob(KnobSpec(
 ))
 
 register_knob(KnobSpec(
+    name="serving.shards",
+    kind="int",
+    default=4,
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "phase:serving",
+        "metric:serving.device_resident_rate",
+        "metric:serving.latency_p99_ms",
+        "metric:serving.requests_per_s",
+    ),
+    candidates=(1, 2, 4, 8),
+    description=(
+        "Device shards per random-effect table in sharded serving mode. "
+        "More shards spread rows (and gather traffic) across more devices "
+        "at one extra gather per shard per batch; on a single device the "
+        "count only shapes the stacked table layout."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="serving.admit_batch",
+    kind="int",
+    default=64,
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "phase:serving",
+        "metric:serving.deferred_rate",
+        "metric:serving.admission_dropped_total",
+        "metric:serving.admission_queue_depth",
+    ),
+    candidates=(16, 64, 256, 1024),
+    description=(
+        "Rows copied host→device per async admission step (one fixed-shape "
+        "scatter). Bigger batches drain a cold-start burst faster but hold "
+        "the routing lock longer per step and stage more bytes at once."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="serving.batch_deadline_ms",
+    kind="float",
+    default=2.0,
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "phase:serving",
+        "metric:serving.latency_p99_ms",
+        "metric:serving.batch_fill",
+        "metric:serving.requests_per_s",
+    ),
+    candidates=(0.5, 1.0, 2.0, 5.0),
+    description=(
+        "Continuous-batching deadline: a forming bucket is scored once its "
+        "oldest request has waited this long. Longer deadlines fill buckets "
+        "(throughput) at the cost of added tail latency under light load."
+    ),
+))
+
+register_knob(KnobSpec(
     name="train.schedule",
     kind="str",
     default="sync",

@@ -184,6 +184,70 @@ def _propose_one(spec: KnobSpec, report: RunReport) -> KnobProposal:
             else:
                 why = f"cache hit rate {hit:.0%} is fine at current capacity"
 
+    elif spec.name == "serving.shards":
+        resident = ev.get("metric:serving.device_resident_rate")
+        if resident is not None:
+            why = (
+                f"device residency {resident:.0%}; shard count trades gather "
+                "fan-out for per-device rows — move it only via A/B on the "
+                "target mesh"
+            )
+
+    elif spec.name == "serving.admit_batch":
+        deferred = ev.get("metric:serving.deferred_rate")
+        dropped = _f("metric:serving.admission_dropped_total")
+        if deferred is not None:
+            ladder = list(spec.candidates)
+            idx = ladder.index(spec.default) if spec.default in ladder else 1
+            if dropped > 0 and idx + 1 < len(ladder):
+                value = ladder[idx + 1]
+                why = (
+                    f"admission dropped {int(dropped)} queued rows — the "
+                    "drain can't keep up with the deferred stream; bigger "
+                    "steps move more rows per scatter"
+                )
+            elif deferred < 0.01 and idx > 0:
+                value = ladder[idx - 1]
+                why = (
+                    f"deferred rate {deferred:.1%} — the cold tail is thin; "
+                    "smaller steps shorten the routing-lock hold for free"
+                )
+            else:
+                why = (
+                    f"deferred rate {deferred:.1%} with no drops — admission "
+                    "keeps up at the default step size"
+                )
+
+    elif spec.name == "serving.batch_deadline_ms":
+        fill = ev.get("metric:serving.batch_fill")
+        p99 = ev.get("metric:serving.latency_p99_ms")
+        if fill is not None and p99 is not None:
+            ladder = list(spec.candidates)
+            idx = ladder.index(spec.default) if spec.default in ladder else 1
+            if fill < 0.5 and idx + 1 < len(ladder):
+                value = ladder[idx + 1]
+                why = (
+                    f"batch fill {fill:.0%} at p99 {p99:.2f}ms — buckets "
+                    "score half-empty; a longer deadline lets them fill"
+                )
+            elif fill > 0.9 and idx > 0:
+                value = ladder[idx - 1]
+                why = (
+                    f"buckets already fill ({fill:.0%}) before the deadline; "
+                    "a shorter one trims queueing from the tail"
+                )
+            else:
+                why = (
+                    f"fill {fill:.0%} / p99 {p99:.2f}ms balance at the "
+                    "default deadline"
+                )
+        elif fill is not None:
+            why = (
+                f"batch fill {fill:.0%} but no latency evidence — the "
+                "deadline trades the two, keep the default until both are "
+                "measured"
+            )
+
     elif spec.name == "serving.max_nnz":
         p99 = ev.get("metric:serving.latency_p99_ms")
         why = (

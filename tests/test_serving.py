@@ -213,6 +213,17 @@ class TestHotEntityCache:
             cache.hits / (cache.hits + cache.misses)
         )
 
+    def test_hit_rate_and_stats_before_any_lookup(self):
+        """Regression: ``hit_rate()``/``stats()`` on a fresh cache (zero
+        lookups) must return 0.0, not raise ZeroDivisionError — the
+        introspection endpoint scrapes caches that may never have served."""
+        backing = np.ones((4, 2), dtype=np.float32)
+        cache = HotEntityCache(backing, capacity=2)
+        assert cache.hit_rate() == 0.0
+        stats = cache.stats()
+        assert stats["hit_rate"] == 0.0
+        assert stats["hits"] == 0 and stats["misses"] == 0
+
     def test_duplicate_entities_in_one_batch_hit(self):
         backing = np.ones((4, 2), dtype=np.float32)
         cache = HotEntityCache(backing, capacity=2)
@@ -248,6 +259,83 @@ class TestHotEntityCache:
         stats = snap["caches"]["per_user"]
         assert stats["hits"] + stats["misses"] == len(requests)
         assert snap["cache_hit_rate"] == pytest.approx(stats["hit_rate"])
+
+
+class TestFullTableHeadroom:
+    def test_pad_rows_reserve_zero_headroom(self):
+        """``pad_rows`` puts zero rows between the live rows and the cold
+        slot; appends land in them in place (no shape change)."""
+        from photon_ml_tpu.serving.scorer import _FullTable
+
+        backing = np.arange(12, dtype=np.float32).reshape(6, 2)
+        table = _FullTable(backing, pad_rows=8)
+        assert table.capacity == 8 and table.cold_slot == 8
+        dev = np.asarray(table.table)
+        assert dev.shape == (9, 2)
+        np.testing.assert_array_equal(dev[6:], 0.0)  # headroom + cold
+        table.update_rows(np.array([6]), np.array([[5.0, 7.0]]))
+        dev = np.asarray(table.table)
+        np.testing.assert_array_equal(dev[6], [5.0, 7.0])
+        assert table.num_rows == 7
+        with pytest.raises(ValueError, match="capacity"):
+            table.update_rows(np.array([8]), np.array([[1.0, 1.0]]))
+
+    def test_hot_swap_append_into_headroom_zero_retrace(self, glmix):
+        """Acceptance: with ``growth_headroom`` a swap can append a brand
+        new entity into a zero headroom row — content becomes servable
+        with ZERO added compiles (shape unchanged, params are jit args)."""
+        from photon_ml_tpu.indexmap import DefaultIndexMap
+        from photon_ml_tpu.serving import ServingArtifact, ServingTable
+
+        _, _, artifact = glmix
+        scorer = GameScorer(artifact, growth_headroom=True)
+        per = artifact.tables["per_user"]
+        n = per.weights.shape[0]
+        provider = scorer._providers["per_user"]
+        assert provider.capacity > n  # headroom actually reserved
+
+        req = ScoreRequest(
+            request_id="new-entity",
+            features={"global": {0: 1.0}, "per_entity": {0: 1.0}},
+            entity_ids={"userId": "brand-new"},
+        )
+        scorer.score_batch([req], bucket_size=4)
+        warm = scorer.compile_count
+
+        new_row = np.full((1, per.dim), 0.25, dtype=np.float32)
+        ids = {
+            per.entity_index.get_feature_name(i): i for i in range(n)
+        }
+        ids["brand-new"] = n
+        candidate = ServingArtifact(
+            task=artifact.task,
+            tables={
+                **{
+                    cid: t
+                    for cid, t in artifact.tables.items()
+                    if cid != "per_user"
+                },
+                "per_user": ServingTable(
+                    feature_shard=per.feature_shard,
+                    random_effect_type=per.random_effect_type,
+                    weights=np.vstack([np.asarray(per.weights), new_row]),
+                    entity_index=DefaultIndexMap(ids),
+                ),
+            },
+            model_name=artifact.model_name,
+        )
+        before = scorer.score_batch([req], bucket_size=4)[0]
+        assert before.cold_coordinates == ("per_user",)
+        # the swap: append bytes into the headroom row, then flip the
+        # artifact (entity index) so routing can see the new entity
+        scorer.update_random_effect_rows(
+            "per_user", np.array([n]), new_row
+        )
+        scorer.set_artifact(candidate)
+        after = scorer.score_batch([req], bucket_size=4)[0]
+        assert after.cold_coordinates == ()
+        assert after.score == pytest.approx(before.score + 0.25)
+        assert scorer.compile_count == warm  # zero retraces
 
 
 class TestMetrics:
@@ -763,10 +851,14 @@ class TestServingBench:
         assert payload["value"] > 0
         assert payload["requests_per_s"] > 0
         assert payload["latency_p50_s"] <= payload["latency_p99_s"]
-        assert 0.0 <= payload["cache_hit_rate"] <= 1.0
-        # compile-once-per-bucket holds on the bench path too
+        assert payload["serving_mode"] == "sharded-continuous"
+        assert 0.0 <= payload["device_resident_rate"] <= 1.0
+        assert payload["admission"]["admitted_total"] >= 0
+        assert "per_user" in payload["residency"]
+        # compile-once-per-bucket holds on the bench path too, even with
+        # the admission tier scattering rows in the background
         assert payload["warm_compiles"] == len(payload["bucket_sizes"])
-        assert payload["post_replay_compiles"] == payload["warm_compiles"]
+        assert payload["post_warmup_compiles"] == 0
         # smoke must not overwrite a committed measurement
         mtime_after = (
             os.path.getmtime(out_path) if os.path.exists(out_path) else None

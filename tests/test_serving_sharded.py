@@ -1,0 +1,482 @@
+"""Sharded device-resident serving tests.
+
+The load-bearing guarantees, per ISSUE acceptance criteria:
+
+- the sharded scorer is BITWISE equal to the single-table ``GameScorer``
+  on the same requests, for any shard count — the stacked ``[S, cap+1]``
+  gather must reproduce the exact rows, and the accumulation order is
+  shared, so scores match bit for bit, not to a tolerance;
+- cold entities (beyond the device budget, or absent from the model)
+  degrade to the FE-only left-join score through the zero cold slot;
+- one compiled XLA program per (bucket, shard-layout) signature: replaying
+  traffic after warmup adds ZERO retraces, including while the admission
+  tier scatters rows in the background;
+- routing publication ordering: a row is never routable before its bytes
+  are written on every replica, and eviction unpublishes first;
+- the continuous microbatcher forms buckets to a deadline, backpressures
+  at ``max_queue``, and resolves stranded handles on stop;
+- multi-scorer mode: replicas share one routing index and agree on every
+  score; a coordinated hot swap keeps all replicas on one generation.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.indexmap import DefaultIndexMap
+from photon_ml_tpu.serving import (
+    AdmissionController,
+    ContinuousBatcher,
+    CoordinatedHotSwap,
+    GameScorer,
+    HotSwapManager,
+    ScoreRequest,
+    ServingArtifact,
+    ServingTable,
+    ShardedGameScorer,
+    build_routing,
+    replay_requests,
+)
+from photon_ml_tpu.types import TaskType
+
+N_ENT = 64
+D_RE = 4
+D_FE = 16
+
+
+def _artifact(n_ent=N_ENT, seed=5):
+    rng = np.random.default_rng(seed)
+    return ServingArtifact(
+        task=TaskType.LOGISTIC_REGRESSION,
+        tables={
+            "fixed": ServingTable(
+                feature_shard="global", random_effect_type=None,
+                weights=(rng.standard_normal(D_FE) * 0.1).astype(np.float32),
+            ),
+            "per_user": ServingTable(
+                feature_shard="per_user", random_effect_type="userId",
+                weights=(
+                    rng.standard_normal((n_ent, D_RE)) * 0.3
+                ).astype(np.float32),
+                entity_index=DefaultIndexMap(
+                    {f"u{i}": i for i in range(n_ent)}
+                ),
+            ),
+        },
+        model_name="sharded-test",
+    )
+
+
+def _requests(n, n_ent=N_ENT, seed=9, ghost_every=0, missing_every=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        if missing_every and i % missing_every == 0:
+            ids = {}
+        elif ghost_every and i % ghost_every == 0:
+            ids = {"userId": f"ghost-{i}"}
+        else:
+            ids = {"userId": f"u{int(rng.integers(0, n_ent))}"}
+        out.append(
+            ScoreRequest(
+                request_id=f"r{i}",
+                features={
+                    "global": {
+                        int(c): float(v)
+                        for c, v in zip(
+                            rng.integers(0, D_FE, 6), rng.standard_normal(6)
+                        )
+                    },
+                    "per_user": {
+                        j: float(v)
+                        for j, v in enumerate(rng.standard_normal(D_RE))
+                    },
+                },
+                entity_ids=ids,
+                offset=float(rng.standard_normal() * 0.1),
+            )
+        )
+    return out
+
+
+MAX_NNZ = {"global": 6, "per_user": D_RE}
+
+
+class TestShardedParity:
+    @pytest.mark.parametrize("num_shards", [1, 2, 4])
+    def test_bitwise_parity_with_single_table(self, num_shards):
+        """Acceptance: sharded gather == single-table gather bit for bit,
+        including ghost entities (FE-only) and id-less requests."""
+        artifact = _artifact()
+        reqs = _requests(48, ghost_every=7, missing_every=11)
+        want = GameScorer(artifact, max_nnz=MAX_NNZ).score_batch(
+            reqs, bucket_size=48
+        )
+        sharded = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=num_shards
+        )
+        got = sharded.score_batch(reqs, bucket_size=48)
+        for g, w in zip(got, want):
+            assert g.request_id == w.request_id
+            assert g.score == w.score  # bitwise, not allclose
+            assert g.mean == w.mean
+            assert g.cold_coordinates == w.cold_coordinates
+
+    def test_cold_entities_degrade_to_fe_only(self):
+        """A ghost entity's score equals the same request scored with no
+        entity id at all (the zero cold slot contributes nothing)."""
+        artifact = _artifact()
+        base = _requests(8)
+        ghost = [
+            ScoreRequest(
+                request_id=r.request_id, features=r.features,
+                entity_ids={"userId": "nobody"}, offset=r.offset,
+            )
+            for r in base
+        ]
+        bare = [
+            ScoreRequest(
+                request_id=r.request_id, features=r.features,
+                entity_ids={}, offset=r.offset,
+            )
+            for r in base
+        ]
+        scorer = ShardedGameScorer(artifact, max_nnz=MAX_NNZ, num_shards=2)
+        got_ghost = scorer.score_batch(ghost, bucket_size=8)
+        got_bare = scorer.score_batch(bare, bucket_size=8)
+        for g, b in zip(got_ghost, got_bare):
+            assert g.score == b.score
+            assert g.cold_coordinates == ("per_user",)
+
+    def test_budget_limited_scorer_serves_tail_fe_only_then_admits(self):
+        """Beyond-budget entities score FE-only until admission copies
+        their rows on-device; after a drain they match the full table."""
+        artifact = _artifact()
+        reqs = _requests(32, seed=3)
+        want = GameScorer(artifact, max_nnz=MAX_NNZ).score_batch(
+            reqs, bucket_size=32
+        )
+        scorer = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2, device_budget_rows=32
+        )
+        admission = AdmissionController([scorer], admit_batch=8)
+        scorer.attach_admission(admission)
+        admission.warmup()
+        first = scorer.score_batch(reqs, bucket_size=32)
+        deferred_ids = {
+            i for i, r in enumerate(first) if r.cold_coordinates
+        }
+        assert deferred_ids, "fixture must exercise the cold tail"
+        admission.drain()
+        second = scorer.score_batch(reqs, bucket_size=32)
+        for i, (g, w) in enumerate(zip(second, want)):
+            if not g.cold_coordinates:
+                assert g.score == w.score, i
+        # the drain made at least part of the tail resident
+        assert sum(1 for r in second if r.cold_coordinates) < len(
+            deferred_ids
+        )
+
+
+class TestRouting:
+    def test_cyclic_layout_and_cold_slot(self):
+        routing = build_routing({"c": 10}, num_shards=2)["c"]
+        shards, slots, deferred = routing.route(
+            np.array([0, 1, 2, 3, -1], dtype=np.int64)
+        )
+        # row r -> (shard r % S, slot r // S)
+        assert shards.tolist()[:4] == [0, 1, 0, 1]
+        assert slots.tolist()[:4] == [0, 0, 1, 1]
+        assert slots[4] == routing.cold_slot and shards[4] == 0
+        assert deferred.size == 0
+        assert routing.cold_lookups == 1 and routing.resident_lookups == 4
+
+    def test_budget_splits_resident_and_deferred(self):
+        routing = build_routing(
+            {"c": 100}, num_shards=2, device_budget_rows=16
+        )["c"]
+        rows = np.arange(40, dtype=np.int64)
+        _, slots, deferred = routing.route(rows)
+        resident = slots != routing.cold_slot
+        assert int(resident.sum()) == routing.base_rows
+        assert set(deferred.tolist()) == set(
+            rows[~resident].tolist()
+        )
+
+    def test_allocate_publish_evict_ordering(self):
+        routing = build_routing(
+            {"c": 100}, num_shards=2, device_budget_rows=16
+        )["c"]
+        free0 = routing.free_slots
+        assert free0 > 0
+        # admit `free0` rows: all slots come from the free list
+        rows = np.arange(50, 50 + free0, dtype=np.int64)
+        shards, slots, evicted = routing.allocate(free0)
+        assert evicted == []
+        # not routable until published
+        _, s2, _ = routing.route(rows)
+        assert (s2 == routing.cold_slot).all()
+        routing.publish(rows, shards, slots)
+        _, s3, _ = routing.route(rows)
+        assert (s3 != routing.cold_slot).all()
+        # next allocate must evict the OLDEST admitted rows, unpublishing
+        # them before their slots are handed out
+        _, _, evicted = routing.allocate(2)
+        assert evicted == [50, 51]
+        assert not routing.is_resident(50)
+        assert not routing.is_resident(51)
+
+    def test_allocate_raises_without_headroom(self):
+        routing = build_routing({"c": 4}, num_shards=2)["c"]
+        # full-residency layout: every slot holds a base row
+        if routing.free_slots == 0 and not routing._admitted:
+            with pytest.raises(RuntimeError, match="headroom"):
+                routing.allocate(1)
+
+
+class TestAdmission:
+    def _pair(self, budget=32, admit=8, n_ent=N_ENT):
+        artifact = _artifact(n_ent=n_ent)
+        scorer = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2,
+            device_budget_rows=budget,
+        )
+        admission = AdmissionController([scorer], admit_batch=admit)
+        scorer.attach_admission(admission)
+        admission.warmup()
+        return scorer, admission
+
+    def test_note_deferred_dedups_and_keeps_order(self):
+        scorer, admission = self._pair()
+        admission.note_deferred("per_user", np.array([40, 41, 40, 42]))
+        admission.note_deferred("per_user", np.array([41, 43]))
+        assert admission.queue_depth == 4
+        assert admission.deferred_total == 6
+
+    def test_queue_overflow_drops(self):
+        scorer, _ = self._pair()
+        admission = AdmissionController(
+            [scorer], admit_batch=8, max_queue=4
+        )
+        admission.note_deferred("per_user", np.arange(40, 50))
+        assert admission.queue_depth == 4
+        assert admission.dropped_total == 6
+
+    def test_capacity_cap_requeues_overflow_at_head(self):
+        """A step can only claim free+evictable slots; overflow rows go
+        back to the queue head so the next step admits them first."""
+        scorer, admission = self._pair(budget=32, admit=32)
+        routing = scorer.routing["per_user"]
+        capacity = routing.free_slots + len(routing._admitted)
+        over = np.arange(
+            routing.base_rows, routing.base_rows + capacity + 3,
+            dtype=np.int64,
+        )
+        admission.note_deferred("per_user", over)
+        admitted = admission.step()
+        assert admitted == capacity
+        assert admission.queue_depth == 3
+        # requeued rows are the ones beyond capacity, in order
+        q = list(admission._queues["per_user"])
+        assert q == over[capacity:].tolist()
+
+    def test_warmup_precompiles_the_scatter(self):
+        """The fixed-shape admission scatter compiles during warmup, not
+        during the first live admit (which must stay copy-only)."""
+        scorer, admission = self._pair()
+        admission.note_deferred("per_user", np.array([40, 41]))
+        before = scorer.compile_count
+        admitted = admission.step()
+        assert admitted == 2
+        assert scorer.compile_count == before  # score fn untouched
+        assert scorer.routing["per_user"].is_resident(40)
+
+    def test_background_thread_drains(self):
+        scorer, admission = self._pair()
+        admission.note_deferred("per_user", np.arange(40, 56))
+        admission.start(interval_s=0.001)
+        try:
+            deadline = time.time() + 5.0
+            while admission.queue_depth and time.time() < deadline:
+                time.sleep(0.005)
+        finally:
+            admission.stop()
+        assert admission.queue_depth == 0
+        assert admission.admitted_total == 16
+
+    def test_multi_replica_rows_written_everywhere_before_publish(self):
+        """Multi-scorer mode: an admitted row gathers identical (real)
+        bytes from every replica — content lands on all devices before
+        routing publishes it."""
+        artifact = _artifact()
+        routing = None
+        scorers = []
+        for _ in range(2):
+            s = ShardedGameScorer(
+                artifact, max_nnz=MAX_NNZ, num_shards=2,
+                device_budget_rows=32, routing=routing,
+            )
+            routing = s.routing
+            scorers.append(s)
+        admission = AdmissionController(scorers, admit_batch=8)
+        for s in scorers:
+            s.attach_admission(admission)
+        admission.warmup()
+        reqs = _requests(32, seed=3)
+        scorers[0].score_batch(reqs, bucket_size=32)
+        admission.drain()
+        a = scorers[0].score_batch(reqs, bucket_size=32)
+        b = scorers[1].score_batch(reqs, bucket_size=32)
+        for x, y in zip(a, b):
+            assert x.score == y.score
+
+
+class TestCompileDiscipline:
+    def test_zero_post_warmup_retraces_with_admission(self):
+        """Acceptance: after one warmup pass per bucket, replaying traffic
+        (with background admission scattering rows) adds zero compiles."""
+        artifact = _artifact()
+        reqs = _requests(96, seed=21)
+        buckets = (1, 4, 16, 32)
+        scorer = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2, device_budget_rows=32
+        )
+        for b in buckets:
+            scorer.score_batch(reqs[:b], bucket_size=b)
+        warm = scorer.compile_count
+        assert warm == len(buckets)
+        admission = AdmissionController([scorer], admit_batch=8)
+        scorer.attach_admission(admission)
+        admission.warmup()
+        results, snapshot = replay_requests(
+            [scorer], reqs, bucket_sizes=buckets,
+            model_id="sharded-test", continuous=True,
+            max_wait_s=0.001, max_queue=64, admission=admission,
+        )
+        assert len(results) == len(reqs)
+        assert scorer.compile_count == warm
+        assert snapshot["residency"]["per_user"]["resident_lookups"] > 0
+
+
+class TestContinuousBatcher:
+    def _scorer(self):
+        return ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+
+    def test_full_bucket_drains_without_deadline(self):
+        scorer = self._scorer()
+        reqs = _requests(16, seed=2)
+        with ContinuousBatcher(
+            scorer, bucket_sizes=(4, 16), max_wait_s=10.0, max_queue=32
+        ) as batcher:
+            handles = batcher.submit_many(reqs)
+            got = [h.result(timeout=10.0) for h in handles]
+        want = scorer.score_batch(reqs, bucket_size=16)
+        assert [g.score for g in got] == [w.score for w in want]
+
+    def test_deadline_drains_partial_bucket(self):
+        scorer = self._scorer()
+        with ContinuousBatcher(
+            scorer, bucket_sizes=(4, 16), max_wait_s=0.005, max_queue=32
+        ) as batcher:
+            h = batcher.submit(_requests(1, seed=4)[0])
+            got = h.result(timeout=10.0)
+        assert got.request_id == "r0"
+
+    def test_backpressure_bounds_queue(self):
+        scorer = self._scorer()
+        reqs = _requests(24, seed=6)
+        batcher = ContinuousBatcher(
+            scorer, bucket_sizes=(8,), max_wait_s=0.001, max_queue=8
+        )
+        with batcher:
+            handles = batcher.submit_many(reqs)  # blocks internally, no error
+            assert len(handles) == 24
+            for h in handles:
+                h.result(timeout=10.0)
+        assert batcher.queue_depth == 0
+
+    def test_stop_resolves_stranded_handles(self):
+        scorer = self._scorer()
+        batcher = ContinuousBatcher(
+            scorer, bucket_sizes=(8,), max_wait_s=30.0, max_queue=8
+        )
+        batcher.start()
+        h = batcher.submit(_requests(1, seed=8)[0])
+        batcher.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            h.result(timeout=1.0)
+
+    def test_submit_after_stop_raises(self):
+        scorer = self._scorer()
+        batcher = ContinuousBatcher(scorer, bucket_sizes=(8,))
+        batcher.start()
+        batcher.stop()
+        with pytest.raises(RuntimeError):
+            batcher.submit(_requests(1)[0])
+
+    def test_concurrent_submitters_all_resolve(self):
+        scorer = self._scorer()
+        reqs = _requests(60, seed=12)
+        out = {}
+        with ContinuousBatcher(
+            scorer, bucket_sizes=(4, 16), max_wait_s=0.002, max_queue=32
+        ) as batcher:
+            def worker(chunk):
+                for h, r in zip(batcher.submit_many(chunk), chunk):
+                    out[r.request_id] = h.result(timeout=10.0)
+            threads = [
+                threading.Thread(target=worker, args=(reqs[i::3],))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert len(out) == 60
+        want = {
+            w.request_id: w.score
+            for w in scorer.score_batch(reqs, bucket_size=64)
+        }
+        for rid, res in out.items():
+            assert res.score == want[rid]
+
+
+class TestCoordinatedHotSwap:
+    def test_replicas_swap_as_one_generation(self):
+        from photon_ml_tpu.incremental.delta import build_delta
+
+        artifact = _artifact()
+        routing = None
+        scorers = []
+        for _ in range(2):
+            s = ShardedGameScorer(
+                artifact, max_nnz=MAX_NNZ, num_shards=2, routing=routing
+            )
+            routing = s.routing
+            scorers.append(s)
+        managers = [HotSwapManager(s) for s in scorers]
+        coord = CoordinatedHotSwap(managers)
+        delta = build_delta(
+            {"per_user": {"u3": {0: 9.0, 2: -1.5}}}, artifact,
+            generation=1,
+        )
+        reports = coord.apply_delta(delta)
+        assert len(reports) == 2
+        assert all(not r.rolled_back for r in reports)
+        assert coord.generation == 1
+        req = _requests(4, seed=30)
+        req = [
+            ScoreRequest(
+                request_id=r.request_id, features=r.features,
+                entity_ids={"userId": "u3"}, offset=r.offset,
+            )
+            for r in req
+        ]
+        a = scorers[0].score_batch(req, bucket_size=4)
+        b = scorers[1].score_batch(req, bucket_size=4)
+        for x, y in zip(a, b):
+            assert x.score == y.score
