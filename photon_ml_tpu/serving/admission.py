@@ -102,6 +102,22 @@ class AdmissionController:
         with self._lock:
             return sum(len(q) for q in self._queues.values())
 
+    @property
+    def scorers(self) -> List[object]:
+        """The scorer replicas this controller writes before publishing."""
+        return list(self._scorers)
+
+    def _requeue(self, cid: str, rows: np.ndarray) -> None:
+        """Put rows back at the queue HEAD so the next step takes them
+        first (they were dequeued earliest)."""
+        with self._lock:
+            q = self._queues.get(cid)
+            if q is None:
+                q = self._queues[cid] = OrderedDict()
+            for r in rows.tolist()[::-1]:
+                q[r] = None
+                q.move_to_end(r, last=False)
+
     # ------------------------------------------------------------- admit
 
     def step(self) -> int:
@@ -121,8 +137,36 @@ class AdmissionController:
         return admitted
 
     def _admit(self, cid: str, rows: np.ndarray) -> int:
-        primary = self._scorers[0]._providers[cid]
-        routing = primary.routing
+        while True:
+            primary = self._scorers[0]._providers[cid]
+            routing = primary.routing
+            # routing.lock serializes this step against hot-swap
+            # update_rows/rebind on other threads: allocate's
+            # check-then-pop and the write-everywhere-then-publish
+            # sequence must not interleave with theirs
+            with routing.lock:
+                if self._scorers[0]._providers[cid] is not primary:
+                    # a rebind swapped the provider (and its routing)
+                    # between the read above and the lock acquisition;
+                    # retry against the new pair
+                    continue
+                if any(
+                    s._providers[cid].routing is not routing
+                    for s in self._scorers[1:]
+                ):
+                    # mid-fan-out of a regrowing coordinated hot swap:
+                    # replica tables briefly disagree on layout, so slots
+                    # allocated here could land out of bounds on a
+                    # not-yet-rebound replica — requeue for a later step
+                    self._requeue(cid, rows)
+                    return 0
+                return self._admit_locked(cid, primary, routing, rows)
+
+    def _admit_locked(self, cid: str, primary, routing, rows) -> int:
+        # a hot swap can defer rows from a newer entity index before this
+        # coordinate's routing has grown; they re-enter the queue through
+        # route() once the swap lands, so just skip them this step
+        rows = rows[rows < routing.n_rows]
         # rows can have been admitted since they were queued (hot-swap
         # update_rows, or a previous step when the same row was queued twice
         # under different coordinates); they may also have been evicted
@@ -140,11 +184,7 @@ class AdmissionController:
         if fresh.size > capacity:
             overflow = fresh[capacity:]
             fresh = fresh[:capacity]
-            with self._lock:
-                q = self._queues[cid]
-                for r in overflow.tolist()[::-1]:
-                    q[r] = None
-                    q.move_to_end(r, last=False)
+            self._requeue(cid, overflow)
         with span("serve/admit", cid=cid, rows=int(fresh.size)):
             k = self.admit_batch
             shards = np.zeros(k, dtype=np.int32)

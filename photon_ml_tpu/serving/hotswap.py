@@ -93,7 +93,12 @@ class _Undo:
     fingerprint: Optional[str]
     fe: Dict[str, np.ndarray]
     re_inplace: Dict[str, Tuple[np.ndarray, np.ndarray]]  # cid -> (rows, old)
-    re_rebuilt: Dict[str, object]  # cid -> previous provider object
+    # cid -> (previous provider, the routing coordinate it was built
+    # against, or None for non-sharded providers). A regrowing rebind
+    # replaces the shared routing coordinate too, so rollback must restore
+    # the (provider, routing) pair together — a provider gathered through a
+    # mismatched layout serves other rows' bytes.
+    re_rebuilt: Dict[str, Tuple[object, Optional[object]]]
     cache_rebinds: Dict[str, Tuple[object, np.ndarray]]  # cid -> (old backing, rows)
 
 
@@ -218,7 +223,10 @@ class HotSwapManager:
                 undo.re_inplace[cid] = (targets, old_rows)
             else:
                 rebind_plan[cid] = (np.asarray(new_table.weights), targets)
-                undo.re_rebuilt[cid] = provider
+                undo.re_rebuilt[cid] = (
+                    provider,
+                    getattr(provider, "routing", None),
+                )
 
         # ------------------------- critical section: the blackout -------
         compiles_before = self._scorer.compile_count
@@ -322,8 +330,12 @@ class HotSwapManager:
             self._scorer.update_fixed_effect(cid, w)
         for cid, (rows, old_rows) in undo.re_inplace.items():
             self._scorer.update_random_effect_rows(cid, rows, old_rows)
-        for cid, provider in undo.re_rebuilt.items():
-            self._scorer._providers[cid] = provider
+        for cid, (provider, routing) in undo.re_rebuilt.items():
+            restore = getattr(self._scorer, "restore_random_effect", None)
+            if restore is not None:
+                restore(cid, provider, routing)
+            else:
+                self._scorer._providers[cid] = provider
         for cid, (backing, rows) in undo.cache_rebinds.items():
             cache = self._scorer.caches[cid]
             cache.rebind(np.asarray(backing))

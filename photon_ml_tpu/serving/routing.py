@@ -25,10 +25,19 @@ immediately fall back to the cold slot → FE-only score) and only then
 reusing the slot's device storage. A reader can therefore never gather
 another entity's coefficients; the worst case is one FE-only score during
 the handover, identical to the cold-entity degradation.
+
+That contract covers READERS only. WRITERS (the background admission
+thread, hot-swap row updates, rebinds) mutate ``_free``/``_admitted``/
+``slot_of`` non-atomically, so every mutation sequence must hold
+``CoordinateRouting.lock`` — otherwise two threads can pop the same free
+slot or publish two rows into one slot. Lock ordering across the serving
+stack: ``routing.lock`` (outer) → ``scorer.write_lock`` (inner); the
+scoring thread takes only ``write_lock``, so the pair cannot deadlock.
 """
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -60,6 +69,11 @@ class CoordinateRouting:
         self.n_rows = int(n_rows)
         self.num_shards = int(num_shards)
         self.shard_capacity = int(shard_capacity)
+        # serializes WRITERS (allocate/publish/grow/unpublish and every
+        # multi-step sequence built on them); re-entrant so a caller
+        # holding it for a compound mutation can still call the
+        # individual methods. Acquire BEFORE any scorer write_lock.
+        self.lock = threading.RLock()
         self.cold_slot = self.shard_capacity
         device_rows = self.num_shards * self.shard_capacity
         base = device_rows if resident_rows is None else int(resident_rows)
@@ -111,8 +125,14 @@ class CoordinateRouting:
         if not n_known:
             return shards, slots, np.empty(0, dtype=np.int64)
         krows = rows[known]
-        kslots = self._slot_of[krows]
-        kshards = self._shard_of[krows]
+        # a concurrent hot swap can hand out rows from a newer entity
+        # index before this coordinate's routing has grown; such rows are
+        # deferred (cold slot now, admitted once the swap lands), never an
+        # out-of-bounds read of the placement arrays
+        in_range = krows < self._slot_of.size
+        safe = np.where(in_range, krows, 0)
+        kslots = np.where(in_range, self._slot_of[safe], -1)
+        kshards = np.where(in_range, self._shard_of[safe], 0)
         resident = kslots >= 0
         n_res = int(np.count_nonzero(resident))
         self.resident_lookups += n_res
@@ -143,65 +163,73 @@ class CoordinateRouting:
         (already unpublished here — the caller must zero/overwrite their
         device slots before publishing new occupants). Raises when the
         coordinate has fewer than ``k`` evictable slots in total."""
-        shards = np.empty(k, dtype=np.int32)
-        slots = np.empty(k, dtype=np.int32)
-        evicted: List[int] = []
-        for i in range(k):
-            if self._free:
-                shard, slot = self._free.popleft()
-            elif self._admitted:
-                victim = self._admitted.popleft()
-                shard, slot = self.placement(victim)
-                # unpublish BEFORE the slot is reused: readers of the
-                # victim fall back to FE-only from this point on
-                self._slot_of[victim] = -1
-                self.evicted_total += 1
-                evicted.append(victim)
-            else:
-                raise RuntimeError(
-                    f"no admission headroom: {self.base_rows} base rows "
-                    f"fill all {self.num_shards}x{self.shard_capacity} "
-                    "device slots — raise the device budget or lower the "
-                    "resident base"
-                )
-            shards[i] = shard
-            slots[i] = slot
-        return shards, slots, evicted
+        with self.lock:
+            shards = np.empty(k, dtype=np.int32)
+            slots = np.empty(k, dtype=np.int32)
+            evicted: List[int] = []
+            for i in range(k):
+                if self._free:
+                    shard, slot = self._free.popleft()
+                elif self._admitted:
+                    victim = self._admitted.popleft()
+                    shard, slot = self.placement(victim)
+                    # unpublish BEFORE the slot is reused: readers of the
+                    # victim fall back to FE-only from this point on
+                    self._slot_of[victim] = -1
+                    self.evicted_total += 1
+                    evicted.append(victim)
+                else:
+                    raise RuntimeError(
+                        f"no admission headroom: {self.base_rows} base rows "
+                        f"fill all {self.num_shards}x{self.shard_capacity} "
+                        "device slots — raise the device budget or lower "
+                        "the resident base"
+                    )
+                shards[i] = shard
+                slots[i] = slot
+            return shards, slots, evicted
 
     def publish(
         self, rows: np.ndarray, shards: np.ndarray, slots: np.ndarray
     ) -> None:
         """Make admitted rows visible to routing. Call ONLY after their
         device content is written in every scorer replica."""
-        rows = np.asarray(rows, dtype=np.int64)
-        self._shard_of[rows] = np.asarray(shards, dtype=np.int32)
-        self._slot_of[rows] = np.asarray(slots, dtype=np.int32)
-        self._admitted.extend(int(r) for r in rows)
-        self.admitted_total += rows.size
+        with self.lock:
+            rows = np.asarray(rows, dtype=np.int64)
+            self._shard_of[rows] = np.asarray(shards, dtype=np.int32)
+            self._slot_of[rows] = np.asarray(slots, dtype=np.int32)
+            self._admitted.extend(int(r) for r in rows)
+            self.admitted_total += rows.size
 
     def grow(self, n_rows: int) -> None:
         """Extend the row space (hot-swap appended new entities to the
         backing table). New rows start non-resident; device capacity is
         unchanged — admission headroom absorbs them."""
-        n_rows = int(n_rows)
-        if n_rows <= self.n_rows:
-            return
-        extra = n_rows - self._slot_of.size
-        if extra > 0:
-            self._shard_of = np.concatenate(
-                [self._shard_of, np.zeros(extra, dtype=np.int32)]
-            )
-            self._slot_of = np.concatenate(
-                [self._slot_of, np.full(extra, -1, dtype=np.int32)]
-            )
-        self.n_rows = n_rows
+        with self.lock:
+            n_rows = int(n_rows)
+            if n_rows <= self.n_rows:
+                return
+            extra = n_rows - self._slot_of.size
+            if extra > 0:
+                # build the grown arrays fully, then install: lock-free
+                # route() readers only ever see a complete placement array
+                shard_of = np.concatenate(
+                    [self._shard_of, np.zeros(extra, dtype=np.int32)]
+                )
+                slot_of = np.concatenate(
+                    [self._slot_of, np.full(extra, -1, dtype=np.int32)]
+                )
+                self._shard_of = shard_of
+                self._slot_of = slot_of
+            self.n_rows = n_rows
 
     def unpublish(self, rows: np.ndarray) -> None:
         """Drop rows from routing (hot-swap invalidation). Their slots are
         NOT freed for reuse — a subsequent admission re-publishes them."""
-        rows = np.asarray(rows, dtype=np.int64)
-        keep = rows[(rows >= 0) & (rows < self.n_rows)]
-        self._slot_of[keep] = -1
+        with self.lock:
+            rows = np.asarray(rows, dtype=np.int64)
+            keep = rows[(rows >= 0) & (rows < self.n_rows)]
+            self._slot_of[keep] = -1
 
     # ------------------------------------------------------------ counters
 

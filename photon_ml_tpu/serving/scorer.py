@@ -395,6 +395,12 @@ class GameScorer:
         self._providers[cid] = rebuilt
         return shape_changed
 
+    def restore_random_effect(self, cid: str, provider, routing=None) -> None:
+        """Rollback hook (see HotSwapManager.rollback): reinstall a
+        snapshotted provider object. ``routing`` only exists for the
+        sharded scorer's shared-layout snapshots and is ignored here."""
+        self._providers[cid] = provider
+
     def _featurize(self, requests: Sequence[ScoreRequest], bucket: int):
         return featurize_requests(
             requests, len(requests), bucket, self._shard_nnz, self._shard_dim
@@ -434,13 +440,17 @@ class GameScorer:
             table = self._artifact.tables[cid]
             entity_rows = np.full(bucket, -1, dtype=np.int64)
             # ids stay C-level; the common every-request-carries-an-id
-            # case hands the whole list to one vectorized lookup
-            ids = list(
-                map(
+            # case hands the whole list to one vectorized lookup. Artifact
+            # entity indexes are keyed by str, so non-str ids (ints from
+            # upstream id tags) are coerced like ServingArtifact
+            # .entity_row does.
+            ids = [
+                e if type(e) is str or e is None else str(e)
+                for e in map(
                     operator.methodcaller("get", re_type),
                     map(_REQ_ENTITY_IDS, requests),
                 )
-            )
+            ]
             if None not in ids:
                 entity_rows[:n] = table.entity_index.get_indices(ids)
             else:

@@ -28,6 +28,7 @@ compile_count, hot-swap hooks) so ``MicroBatcher``/``ContinuousBatcher``,
 
 from __future__ import annotations
 
+import contextlib
 import operator
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple, Union
@@ -192,57 +193,88 @@ class ShardedReTable:
             jnp.asarray(np.ascontiguousarray(values, dtype=np.float32)),
         )
 
-    def update_rows(self, rows: np.ndarray, values: np.ndarray) -> None:
+    def update_rows(
+        self,
+        rows: np.ndarray,
+        values: np.ndarray,
+        replicas: Optional[Sequence[Tuple[object, "ShardedReTable"]]] = None,
+    ) -> None:
         """Hot-swap hook: update/append global rows in place. Resident rows
         are overwritten in their slots; non-resident rows are admitted
         immediately (allocating headroom slots, evicting the oldest
         admitted rows when full). Raises only when the coordinate has no
-        headroom left for genuinely new rows."""
+        headroom left for genuinely new rows.
+
+        ``replicas`` is the multi-scorer fan-out: ``(write_lock, table)``
+        pairs for EVERY replica of this coordinate (including this one).
+        Newly admitted rows are written to every replica's device table
+        before the shared routing publishes them — the same
+        write-everywhere-then-publish contract the admission controller
+        upholds, so no replica's scoring thread can route a fresh row to a
+        slot still holding the evicted victim's bytes. Defaults to this
+        table alone with no lock (single-replica callers already hold
+        their scorer's write_lock or run single-threaded).
+
+        The whole sequence runs under ``routing.lock`` so concurrent
+        admission steps and swaps cannot interleave allocate/publish."""
         rows = np.asarray(rows, dtype=np.int64).ravel()
         values = np.asarray(values, dtype=np.float32).reshape(rows.size, -1)
         if rows.size == 0:
             return
-        if rows.max() >= self.routing.n_rows:
-            self.routing.grow(int(rows.max()) + 1)
-        for r, v in zip(rows, values):
-            self._overrides[int(r)] = np.array(v, dtype=np.float32)
-        res_slots = self.routing._slot_of[rows]
-        resident = res_slots >= 0
-        new_rows = np.unique(rows[~resident])
-        if new_rows.size:
-            # evicted rows are unpublished inside allocate(); their slots
-            # are exactly the ones reused here, so the new content below
-            # overwrites them with no separate zeroing pass
-            a_shards, a_slots, _ = self.routing.allocate(new_rows.size)
-            self.write_slots(
-                a_shards, a_slots, self.host_rows(new_rows)
-            )
-            self.routing.publish(new_rows, a_shards, a_slots)
-            res_slots = self.routing._slot_of[rows]
-        # only still-resident rows get the in-place write: a row of this
-        # batch evicted to make room stays FE-only until re-admission (its
-        # override already carries the new content)
-        resident = res_slots >= 0
-        if resident.any():
-            self.write_slots(
-                self.routing._shard_of[rows[resident]],
-                res_slots[resident],
-                values[resident],
-            )
+        if replicas is None:
+            replicas = [(contextlib.nullcontext(), self)]
+        routing = self.routing
+        with routing.lock:
+            if rows.max() >= routing.n_rows:
+                routing.grow(int(rows.max()) + 1)
+            for _, table in replicas:
+                for r, v in zip(rows, values):
+                    table._overrides[int(r)] = np.array(v, dtype=np.float32)
+            res_slots = routing._slot_of[rows]
+            resident = res_slots >= 0
+            new_rows = np.unique(rows[~resident])
+            if new_rows.size:
+                # evicted rows are unpublished inside allocate(); their
+                # slots are exactly the ones reused here, so the new
+                # content below overwrites them with no separate zeroing
+                # pass — and publish() runs only after EVERY replica holds
+                # the bytes
+                a_shards, a_slots, _ = routing.allocate(new_rows.size)
+                for lock, table in replicas:
+                    with lock:
+                        table.write_slots(
+                            a_shards, a_slots, table.host_rows(new_rows)
+                        )
+                routing.publish(new_rows, a_shards, a_slots)
+                res_slots = routing._slot_of[rows]
+            # only still-resident rows get the in-place write: a row of
+            # this batch evicted to make room stays FE-only until
+            # re-admission (its override already carries the new content)
+            resident = res_slots >= 0
+            if resident.any():
+                w_shards = routing._shard_of[rows[resident]]
+                w_slots = res_slots[resident]
+                w_values = values[resident]
+                for lock, table in replicas:
+                    with lock:
+                        table.write_slots(w_shards, w_slots, w_values)
 
     def fits(self, targets: np.ndarray) -> bool:
         """Whether a hot-swap touching these global rows stays in-shape:
         every non-resident target can claim a headroom slot (free or by
         evicting an admitted row)."""
         targets = np.asarray(targets, dtype=np.int64).ravel()
-        known = targets[targets < self.routing.n_rows]
-        resident = (
-            self.routing._slot_of[known] >= 0
-            if known.size
-            else np.empty(0, dtype=bool)
-        )
-        n_new = np.unique(targets).size - np.unique(known[resident]).size
-        return n_new <= self.routing.free_slots + len(self.routing._admitted)
+        with self.routing.lock:
+            known = targets[targets < self.routing.n_rows]
+            resident = (
+                self.routing._slot_of[known] >= 0
+                if known.size
+                else np.empty(0, dtype=bool)
+            )
+            n_new = np.unique(targets).size - np.unique(known[resident]).size
+            return n_new <= self.routing.free_slots + len(
+                self.routing._admitted
+            )
 
     def stats(self) -> Dict[str, float]:
         return self.routing.stats()
@@ -304,6 +336,10 @@ class ShardedGameScorer:
         self._mesh = mesh
         self._headroom_fraction = float(headroom_fraction)
         self._admission = None
+        # multi-scorer mode: every replica sharing this scorer's routing
+        # index (including self); hot-swap row admission writes all of
+        # their tables before publishing. None = this scorer alone.
+        self._replica_group: Optional[List["ShardedGameScorer"]] = None
         # serializes donated table writes against in-flight gathers: the
         # scoring thread holds it across param capture + score + sync,
         # writers (admission, hot swap) hold it across write_slots
@@ -395,8 +431,35 @@ class ShardedGameScorer:
 
     def attach_admission(self, controller) -> None:
         """Route deferred (known, non-resident) lookups to an admission
-        controller; without one they are only counted."""
+        controller; without one they are only counted. When the controller
+        spans several replicas of this scorer's routing index, they become
+        this scorer's replica group: hot-swap row admission then writes
+        every replica's table before publishing (same contract as the
+        controller's own admits)."""
         self._admission = controller
+        peers = [
+            s
+            for s in getattr(controller, "scorers", [])
+            if getattr(s, "_routing", None) is self._routing
+        ]
+        if len(peers) > 1 and self in peers:
+            self.set_replica_group(peers)
+
+    def set_replica_group(
+        self, scorers: Sequence["ShardedGameScorer"]
+    ) -> None:
+        """Declare the replicas (including this scorer) that share this
+        scorer's routing index, so row-level hot swaps keep the
+        write-everywhere-before-publish ordering across all of them."""
+        scorers = list(scorers)
+        if self not in scorers:
+            raise ValueError("replica group must include this scorer")
+        for s in scorers:
+            if s._routing is not self._routing:
+                raise ValueError(
+                    "replica group must share one routing index"
+                )
+        self._replica_group = scorers
 
     # ------------------------------------------------------ hot-swap hooks
 
@@ -423,6 +486,16 @@ class ShardedGameScorer:
                 raise ValueError(
                     f"candidate artifact changes fixed-effect dim of {cid!r}"
                 )
+        # grow every RE coordinate's routing BEFORE the new entity indexes
+        # go live: a concurrent score_batch may resolve candidate-only
+        # entities the instant the artifact reference flips, and route()
+        # must already know the larger row space (they start non-resident,
+        # score FE-only, and queue for admission — never an index error)
+        for cid, _, _ in self._re_specs:
+            n_new = artifact.tables[cid].n_entities
+            routing = self._routing[cid]
+            if n_new > routing.n_rows:
+                routing.grow(n_new)
         self._artifact = artifact
 
     def update_fixed_effect(self, cid: str, weights: np.ndarray) -> None:
@@ -445,8 +518,14 @@ class ShardedGameScorer:
         provider = self._providers.get(cid)
         if provider is None:
             raise ValueError(f"{cid!r} is not a random-effect coordinate")
-        with self.write_lock:
-            provider.update_rows(rows, values)
+        group = self._replica_group or [self]
+        # routing.lock (taken inside update_rows) is the OUTER lock; each
+        # replica's write_lock is taken per device write inside it
+        provider.update_rows(
+            rows,
+            values,
+            replicas=[(s.write_lock, s._providers[cid]) for s in group],
+        )
 
     def rebind_random_effect(self, cid: str, backing: np.ndarray) -> bool:
         """Rebuild one coordinate's device shards from a new backing table.
@@ -462,29 +541,50 @@ class ShardedGameScorer:
         backing = np.asarray(backing)
         n_new = backing.shape[0]
         routing = self._routing[cid]
-        old_cap = routing.shard_capacity
-        if n_new > routing.device_rows or routing.n_rows != n_new:
-            fresh = build_routing(
-                {cid: n_new},
-                num_shards=routing.num_shards,
-                device_budget_rows=self.device_budget_rows,
-                headroom_fraction=self._headroom_fraction,
-            )[cid]
-            if fresh.shard_capacity < old_cap:
-                # never shrink a shared layout other replicas still serve
-                fresh = CoordinateRouting(
-                    n_rows=n_new,
+        # hold the OLD routing's lock across the whole swap: an admission
+        # step serialized behind it re-reads the provider afterwards and
+        # retries against the new routing (see AdmissionController._admit)
+        with routing.lock:
+            old_cap = routing.shard_capacity
+            if n_new > routing.device_rows or routing.n_rows != n_new:
+                fresh = build_routing(
+                    {cid: n_new},
                     num_shards=routing.num_shards,
-                    shard_capacity=old_cap,
-                    resident_rows=fresh.base_rows,
+                    device_budget_rows=self.device_budget_rows,
+                    headroom_fraction=self._headroom_fraction,
+                )[cid]
+                if fresh.shard_capacity < old_cap:
+                    # never shrink a shared layout other replicas still
+                    # serve
+                    fresh = CoordinateRouting(
+                        n_rows=n_new,
+                        num_shards=routing.num_shards,
+                        shard_capacity=old_cap,
+                        resident_rows=fresh.base_rows,
+                    )
+                self._routing.coordinates[cid] = fresh
+                routing = fresh
+            with self.write_lock:
+                self._providers[cid] = ShardedReTable(
+                    backing, routing, mesh=self._mesh
                 )
-            self._routing.coordinates[cid] = fresh
-            routing = fresh
-        with self.write_lock:
-            self._providers[cid] = ShardedReTable(
-                backing, routing, mesh=self._mesh
-            )
-        return routing.shard_capacity != old_cap
+            return routing.shard_capacity != old_cap
+
+    def restore_random_effect(
+        self, cid: str, provider, routing=None
+    ) -> None:
+        """Rollback hook: reinstall a snapshotted provider and — when the
+        forward swap regrew the shared layout — the routing coordinate it
+        was built against, as ONE step. Restoring only the provider would
+        leave the scorer routing with the grown layout while gathering
+        from the old-shape table (slots beyond the old capacity would read
+        other rows' bytes)."""
+        current = self._routing[cid]
+        with current.lock:
+            if routing is not None and routing is not current:
+                self._routing.coordinates[cid] = routing
+            with self.write_lock:
+                self._providers[cid] = provider
 
     # -------------------------------------------------------------- scoring
 
@@ -523,13 +623,17 @@ class ShardedGameScorer:
                 entity_rows = np.full(bucket, -1, dtype=np.int64)
                 # mirror of GameScorer's route: ids stay C-level, and
                 # the common every-request-carries-an-id case hands the
-                # whole list to one vectorized lookup
-                ids = list(
-                    map(
+                # whole list to one vectorized lookup. Artifact entity
+                # indexes are keyed by str, so non-str ids (ints from
+                # upstream id tags) are coerced like ServingArtifact
+                # .entity_row does.
+                ids = [
+                    e if type(e) is str or e is None else str(e)
+                    for e in map(
                         operator.methodcaller("get", re_type),
                         map(_REQ_ENTITY_IDS, requests),
                     )
-                )
+                ]
                 if None not in ids:
                     entity_rows[:n] = table.entity_index.get_indices(ids)
                 else:

@@ -445,6 +445,138 @@ class TestContinuousBatcher:
             assert res.score == want[rid]
 
 
+class TestRoutingThreadSafety:
+    def test_route_out_of_range_rows_defer_not_crash(self):
+        """Rows from a newer entity index (mid hot swap, before grow) are
+        deferred — never an out-of-bounds read of the placement arrays."""
+        routing = build_routing({"c": 10}, num_shards=2)["c"]
+        shards, slots, deferred = routing.route(
+            np.array([5, 12, -1], dtype=np.int64)
+        )
+        assert slots[0] != routing.cold_slot  # resident
+        assert slots[1] == routing.cold_slot  # beyond n_rows: deferred
+        assert deferred.tolist() == [12]
+
+    def test_concurrent_admission_and_hotswap_updates(self):
+        """The background admission thread and hot-swap row updates
+        mutate the SAME routing concurrently; the routing lock must keep
+        allocate/publish atomic — no double-popped slot, no two rows
+        published into one slot, no dead admission thread."""
+        artifact = _artifact(n_ent=128)
+        scorer = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2, device_budget_rows=32
+        )
+        admission = AdmissionController([scorer], admit_batch=8)
+        scorer.attach_admission(admission)
+        admission.warmup()
+        routing = scorer.routing["per_user"]
+        stop = threading.Event()
+        errors = []
+
+        def feed():
+            try:
+                rng = np.random.default_rng(0)
+                while not stop.is_set():
+                    admission.note_deferred(
+                        "per_user", rng.integers(0, 128, size=16)
+                    )
+                    time.sleep(0.0005)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def swap():
+            try:
+                rng = np.random.default_rng(1)
+                while not stop.is_set():
+                    rows = rng.integers(0, 128, size=4)
+                    vals = rng.standard_normal((4, D_RE)).astype(np.float32)
+                    scorer.update_random_effect_rows("per_user", rows, vals)
+                    time.sleep(0.0005)
+            except Exception as e:
+                errors.append(e)
+
+        admission.start(interval_s=0.0005)
+        threads = [threading.Thread(target=f) for f in (feed, swap, swap)]
+        for t in threads:
+            t.start()
+        time.sleep(0.7)
+        stop.set()
+        for t in threads:
+            t.join()
+        admission.stop()
+        assert errors == []
+        # the corruption detector: every resident row occupies a UNIQUE
+        # (shard, slot) pair — a lost lock would double-assign slots
+        with routing.lock:
+            slot_of = routing._slot_of[: routing.n_rows]
+            resident = np.nonzero(slot_of >= 0)[0]
+            pairs = {
+                (int(routing._shard_of[r]), int(slot_of[r]))
+                for r in resident
+            }
+            assert len(pairs) == resident.size
+            # and bookkeeping balances: occupied + free == all data slots
+            occupied = resident.size
+            assert occupied + routing.free_slots == routing.device_rows
+
+
+class TestEntityIdCoercion:
+    @pytest.mark.parametrize("sharded", [False, True])
+    def test_int_entity_ids_resolve_like_str(self, sharded):
+        """Artifact entity indexes are keyed by str; non-str ids must be
+        coerced (the pre-sharding route path did), not silently miss or
+        crash an off-heap index."""
+        rng = np.random.default_rng(7)
+        artifact = ServingArtifact(
+            task=TaskType.LOGISTIC_REGRESSION,
+            tables={
+                "fixed": ServingTable(
+                    feature_shard="global", random_effect_type=None,
+                    weights=(
+                        rng.standard_normal(D_FE) * 0.1
+                    ).astype(np.float32),
+                ),
+                "per_user": ServingTable(
+                    feature_shard="per_user", random_effect_type="userId",
+                    weights=(
+                        rng.standard_normal((8, D_RE)) * 0.3
+                    ).astype(np.float32),
+                    # numeric-string keys, as packed from int id tags
+                    entity_index=DefaultIndexMap(
+                        {str(i): i for i in range(8)}
+                    ),
+                ),
+            },
+            model_name="int-ids",
+        )
+        base = _requests(8, n_ent=8, seed=11)
+        as_str = [
+            ScoreRequest(
+                request_id=r.request_id, features=r.features,
+                entity_ids={"userId": str(i % 8)}, offset=r.offset,
+            )
+            for i, r in enumerate(base)
+        ]
+        as_int = [
+            ScoreRequest(
+                request_id=r.request_id, features=r.features,
+                entity_ids={"userId": i % 8}, offset=r.offset,
+            )
+            for i, r in enumerate(base)
+        ]
+        if sharded:
+            scorer = ShardedGameScorer(
+                artifact, max_nnz=MAX_NNZ, num_shards=2
+            )
+        else:
+            scorer = GameScorer(artifact, max_nnz=MAX_NNZ)
+        want = scorer.score_batch(as_str, bucket_size=8)
+        got = scorer.score_batch(as_int, bucket_size=8)
+        for g, w in zip(got, want):
+            assert g.score == w.score
+            assert g.cold_coordinates == w.cold_coordinates == ()
+
+
 class TestCoordinatedHotSwap:
     def test_replicas_swap_as_one_generation(self):
         from photon_ml_tpu.incremental.delta import build_delta
@@ -480,3 +612,71 @@ class TestCoordinatedHotSwap:
         b = scorers[1].score_batch(req, bucket_size=4)
         for x, y in zip(a, b):
             assert x.score == y.score
+
+    def test_row_update_writes_every_replica_before_publish(self):
+        """Hot-swap admission of a NEW row in multi-replica mode must land
+        the bytes on every replica's device table before the shared
+        routing publishes the row — otherwise replica k serves the evicted
+        victim's coefficients until its own swap lands."""
+        artifact = _artifact()
+        routing = None
+        scorers = []
+        for _ in range(2):
+            s = ShardedGameScorer(
+                artifact, max_nnz=MAX_NNZ, num_shards=2,
+                device_budget_rows=32, routing=routing,
+            )
+            routing = s.routing
+            scorers.append(s)
+        admission = AdmissionController(scorers, admit_batch=8)
+        for s in scorers:
+            s.attach_admission(admission)
+        assert scorers[0]._replica_group == scorers
+        vals = np.full((1, D_RE), 3.5, dtype=np.float32)
+        # row 40 is beyond the resident base (budget 32 → base 24): the
+        # update admits it into headroom through the replica-group path
+        scorers[0].update_random_effect_rows(
+            "per_user", np.array([40]), vals
+        )
+        coord = routing["per_user"]
+        assert coord.is_resident(40)
+        shard, slot = coord.placement(40)
+        for s in scorers:
+            got = np.asarray(s._providers["per_user"].table)[shard, slot]
+            np.testing.assert_array_equal(got, vals[0])
+
+    def test_rollback_after_regrow_restores_routing(self):
+        """A regrowing rebind replaces the shared routing coordinate; a
+        rollback must restore the (provider, routing) pair together, or
+        the scorer routes with the grown layout while gathering from the
+        old-shape table."""
+        from photon_ml_tpu.incremental.delta import build_delta
+
+        artifact = _artifact()
+        scorer = ShardedGameScorer(artifact, max_nnz=MAX_NNZ, num_shards=2)
+        manager = HotSwapManager(scorer)
+        routing_before = scorer.routing["per_user"]
+        reqs = _requests(16, seed=17)
+        before = scorer.score_batch(reqs, bucket_size=16)
+        # more new entities than the full-residency headroom (16 slots for
+        # N_ENT=64): forces the rebind + regrow path
+        delta = build_delta(
+            {
+                "per_user": {
+                    f"zz{i}": {0: 1.0 + i} for i in range(24)
+                }
+            },
+            artifact,
+            generation=1,
+        )
+        report = manager.apply_delta(delta)
+        assert report.regrew == ("per_user",)
+        assert scorer.routing["per_user"] is not routing_before
+        manager.rollback()
+        assert scorer.routing["per_user"] is routing_before
+        assert (
+            scorer._providers["per_user"].routing is routing_before
+        )
+        after = scorer.score_batch(reqs, bucket_size=16)
+        for b, a in zip(before, after):
+            assert b.score == a.score
