@@ -1093,6 +1093,234 @@ def _incremental_bench():
         sys.exit(1)
 
 
+# --- streaming out-of-core training bench ----------------------------------
+N_ST_ROWS = 512 if _SMOKE else 120_000      # training rows
+N_ST_VAL = 256 if _SMOKE else 20_000        # held-out rows (in-memory)
+D_ST = 24 if _SMOKE else 192                # global feature dim
+N_ST_FILES = 3 if _SMOKE else 12            # Avro part files
+ST_BLOCK_ROWS = 128 if _SMOKE else 8192     # rows per streamed block
+ST_PREFETCH = 2
+_STREAMING_PATH = os.path.join(_REPO, "BENCH_STREAMING.json")
+
+
+def _streaming_bench():
+    """A/B out-of-core streamed training against the in-memory fit on the
+    same on-disk Avro dataset: identical FE logistic problem, streamed in
+    fixed-shape blocks through the double-buffered prefetcher vs one
+    materialized design matrix. Reports wall clock both ways, the prefetch
+    hide ratio (decode seconds that never surfaced as a consumer stall),
+    the peak-host-RSS delta of the streamed fit plus its deterministic
+    staging bound, held-out AUC parity, and the post-warmup retrace count
+    (must be 0). Emits ONE JSON line and writes BENCH_STREAMING.json; an
+    exception emits an error line instead."""
+    import resource
+    import sys
+    import tempfile
+    import time as _time
+
+    try:
+        import jax
+
+        if _SMOKE:
+            jax.config.update("jax_platforms", "cpu")
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+        )
+        from photon_ml_tpu.io.data_reader import (
+            FeatureShardConfiguration,
+            read_game_data,
+            write_training_examples,
+        )
+        from photon_ml_tpu.opt import (
+            GlmOptimizationConfiguration,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.streaming import (
+            StreamingSource,
+            reset_stream_trace_counts,
+            stream_trace_counts,
+        )
+        from photon_ml_tpu.telemetry import get_registry
+        from photon_ml_tpu.types import RegularizationType, TaskType
+
+        summarize_telemetry = _bench_telemetry("streaming")
+        rng = np.random.default_rng(SEED)
+        w_true = rng.normal(size=D_ST).astype(np.float32)
+
+        def _sample(n, seed):
+            r = np.random.default_rng(seed)
+            X = r.normal(size=(n, D_ST)).astype(np.float32)
+            p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+            y = (p > r.random(n)).astype(np.float32)
+            return X, y
+
+        def _records(X, y):
+            for i in range(X.shape[0]):
+                yield {
+                    "label": float(y[i]),
+                    "features": [
+                        ("f", str(j), float(X[i, j])) for j in range(D_ST)
+                    ],
+                }
+
+        X_tr, y_tr = _sample(N_ST_ROWS, SEED + 1)
+        X_va, y_va = _sample(N_ST_VAL, SEED + 2)
+
+        shard_configs = {
+            "global": FeatureShardConfiguration(
+                feature_bags=("features",), add_intercept=True
+            ),
+        }
+        l2 = GlmOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=0.1,
+        )
+
+        def _estimator():
+            return GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinates={
+                    "fixed": FixedEffectCoordinateConfiguration("global", l2),
+                },
+            )
+
+        with tempfile.TemporaryDirectory() as tmp:
+            splits = np.linspace(0, N_ST_ROWS, N_ST_FILES + 1).astype(int)
+            paths = []
+            for i in range(N_ST_FILES):
+                p = os.path.join(tmp, f"part-{i:05d}.avro")
+                write_training_examples(
+                    p, _records(X_tr[splits[i]:splits[i + 1]],
+                                y_tr[splits[i]:splits[i + 1]])
+                )
+                paths.append(p)
+
+            val_path = os.path.join(tmp, "val.avro")
+            write_training_examples(val_path, _records(X_va, y_va))
+
+            # --- streamed fit FIRST: ru_maxrss is a high-water mark, so the
+            # in-memory fit (which materializes everything) must come after
+            # for the streamed delta to mean anything
+            rss0_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            t0 = _time.perf_counter()
+            # default 2-file LRU decode cache: with more part files than
+            # cache slots the streamed fit genuinely re-reads from disk, so
+            # the peak-RSS delta measures out-of-core residency, not a
+            # hidden whole-dataset cache
+            source = StreamingSource.open(
+                paths, shard_configs, block_rows=ST_BLOCK_ROWS,
+            )
+            open_s = _time.perf_counter() - t0
+            reg = get_registry()
+
+            def _stream_totals():
+                return {
+                    k: reg.counter_value(f"stream.{k}")
+                    for k in ("decode_s", "stall_s", "transfer_s", "blocks")
+                }
+
+            reset_stream_trace_counts()
+            before = _stream_totals()
+            t0 = _time.perf_counter()
+            fit_st = _estimator().fit_streaming(
+                source, prefetch_depth=ST_PREFETCH
+            )
+            stream_fit_s = _time.perf_counter() - t0
+            totals = {
+                k: v - before[k] for k, v in _stream_totals().items()
+            }
+            traces_cold = dict(stream_trace_counts())
+
+            # warm repeat: every stream_* program must already be compiled
+            t0 = _time.perf_counter()
+            fit_warm = _estimator().fit_streaming(
+                source, prefetch_depth=ST_PREFETCH
+            )
+            stream_warm_s = _time.perf_counter() - t0
+            traces_warm = dict(stream_trace_counts())
+            retraces_after_warmup = sum(traces_warm.values()) - sum(
+                traces_cold.values()
+            )
+            rss1_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+            # --- in-memory comparator on the same files
+            t0 = _time.perf_counter()
+            mem_data, _, _ = read_game_data(
+                paths, shard_configs, index_maps=source.index_maps
+            )
+            read_s = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            fit_mem = _estimator().fit(mem_data)
+            mem_fit_s = _time.perf_counter() - t0
+            rss2_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+            # validation read with the TRAINING index maps so scores align
+            val_data, _, _ = read_game_data(
+                [val_path], shard_configs, index_maps=source.index_maps
+            )
+        auc_stream = _auc(
+            np.asarray(fit_st.model.score(val_data)), y_va
+        )
+        auc_mem = _auc(np.asarray(fit_mem.model.score(val_data)), y_va)
+        del fit_warm
+
+        hide_ratio = (
+            max(0.0, (totals["decode_s"] - totals["stall_s"]))
+            / totals["decode_s"]
+            if totals["decode_s"] > 0 else 1.0
+        )
+        block_bytes = source.block_feature_bytes("global")
+        payload = {
+            "metric": "streaming_fit_wall_s",
+            "value": round(stream_fit_s, 6),
+            "unit": "seconds",
+            "inmemory_fit_s": round(mem_fit_s, 6),
+            "inmemory_read_s": round(read_s, 6),
+            "stream_open_s": round(open_s, 6),
+            "stream_fit_warm_s": round(stream_warm_s, 6),
+            "stream_vs_inmemory": round(stream_fit_s / mem_fit_s, 3),
+            "rows": N_ST_ROWS,
+            "dim": D_ST + 1,
+            "num_files": N_ST_FILES,
+            "num_blocks": source.plan.num_blocks,
+            "block_rows": ST_BLOCK_ROWS,
+            "prefetch_depth": ST_PREFETCH,
+            "blocks_streamed": int(totals["blocks"]),
+            "decode_s": round(totals["decode_s"], 6),
+            "stall_s": round(totals["stall_s"], 6),
+            "transfer_s": round(totals["transfer_s"], 6),
+            "prefetch_hide_ratio": round(hide_ratio, 4),
+            "peak_rss_stream_delta_mb": round((rss1_kb - rss0_kb) / 1024, 1),
+            "peak_rss_inmemory_delta_mb": round((rss2_kb - rss1_kb) / 1024, 1),
+            "staging_bound_mb": round(
+                ST_PREFETCH * block_bytes / (1024 * 1024), 1
+            ),
+            "auc_stream": round(auc_stream, 6),
+            "auc_inmemory": round(auc_mem, 6),
+            "auc_delta": round(abs(auc_stream - auc_mem), 6),
+            "retraces_after_warmup": int(retraces_after_warmup),
+            # overlap physics: with decode_workers=0 (single-CPU hosts) the
+            # decode thread and the solver timeshare one core, so the hide
+            # ratio is bounded by compute/decode; readers gate on cpus
+            "cpus": os.cpu_count() or 1,
+            "decode_workers": source.decode_workers,
+            "backend": jax.default_backend(),
+            "telemetry": summarize_telemetry(),
+        }
+        print(json.dumps(payload))
+        if not _SMOKE or _env_flag("BENCH_STREAMING_WRITE"):
+            with open(_STREAMING_PATH, "w") as f:
+                json.dump(payload, f, indent=2)
+        _append_history(payload, "streaming")
+    except Exception as e:  # noqa: BLE001 - one JSON line per exit path
+        print(json.dumps({
+            "metric": "streaming_fit_wall_s",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
+
+
 # --- adaptive random-effect solve bench ------------------------------------
 N_AD_ENT = 64 if _SMOKE else 1024           # entities in the skewed bucket
 N_AD_HARD = 6 if _SMOKE else 64             # slow-converging tail entities
@@ -1953,6 +2181,14 @@ def _main():
              "writes BENCH_RE_ADAPTIVE.json",
     )
     ap.add_argument(
+        "--streaming", action="store_true",
+        help="run the out-of-core streaming benchmark instead of the "
+             "training bench: streamed block-sharded fit vs the in-memory "
+             "fit on the same on-disk Avro dataset; reports wall clock, "
+             "prefetch hide ratio, peak-RSS delta, held-out AUC parity and "
+             "post-warmup retraces, and writes BENCH_STREAMING.json",
+    )
+    ap.add_argument(
         "--cd-scores", action="store_true",
         help="run the CD score-plane benchmark instead of the training "
              "bench: device-resident running-total score plane vs the host "
@@ -1986,6 +2222,9 @@ def _main():
         return
     if args.incremental:
         _incremental_bench()
+        return
+    if args.streaming:
+        _streaming_bench()
         return
     if args.re_adaptive:
         _re_adaptive_bench()

@@ -188,9 +188,34 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="async schedule only: max unreconciled coordinate "
                         "updates a dispatch may ignore (0 = serialize, "
                         "bitwise equal to sync)")
+    p.add_argument("--streaming", action="store_true",
+                   help="out-of-core training: stream the training set from "
+                        "disk in fixed-shape blocks through a double-buffered "
+                        "host->device prefetcher instead of materializing "
+                        "fixed-effect design matrices in memory (validation "
+                        "data is still read in-memory)")
+    p.add_argument("--block-rows", type=int, default=65536,
+                   help="streaming: rows per example block; every block has "
+                        "this exact (padded) shape so nothing retraces "
+                        "(default 65536)")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="streaming: staged blocks the background decode "
+                        "thread may buffer ahead (0 = synchronous decode; "
+                        "default 2 = double buffering). Host staging memory "
+                        "is bounded by prefetch-depth x block bytes")
+    p.add_argument("--stream-mode", default="full",
+                   choices=("full", "stochastic"),
+                   help="streaming solver: 'full' replays every block per "
+                        "optimizer iteration (exact full-batch, default); "
+                        "'stochastic' visits shuffled block groups per epoch "
+                        "-- gate it on held-out metric parity first")
     p.add_argument("--log-file", default=None)
     add_telemetry_args(p)
     args = p.parse_args(argv)
+    if args.block_rows < 1:
+        p.error("--block-rows must be >= 1")
+    if args.prefetch_depth < 0:
+        p.error("--prefetch-depth must be >= 0")
     if args.staleness < 0:
         p.error("--staleness must be >= 0")
     if args.parallel_data < 0 or args.parallel_feat < 1:
@@ -202,6 +227,29 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             "axis sharding)"
         )
     return args
+
+
+def _check_streaming_compatible(args: argparse.Namespace) -> None:
+    """--streaming replaces the in-memory training read; every flag whose
+    implementation needs the materialized training GameData (or a second
+    full-data pass) fails fast here rather than deep in the fit."""
+    conflicts = [
+        (args.parallel_data > 0, "--parallel-data (device-grid layout)"),
+        (args.compute_variance, "--compute-variance (Hessian-diagonal pass)"),
+        (args.check_data, "--check-data (validates in-memory shards)"),
+        (args.auto_tune, "--auto-tune (trial fits need in-memory data)"),
+        (args.hyperparameter_tuning != "NONE", "--hyperparameter-tuning"),
+        (args.normalization_type != "NONE",
+         "--normalization-type (needs a streamed feature-stats pass)"),
+        (bool(args.summarization_output_dir) or args.save_feature_stats,
+         "feature-stats output (summarizes in-memory shards)"),
+    ]
+    bad = [name for flag, name in conflicts if flag]
+    if bad:
+        raise ValueError(
+            "--streaming is incompatible with: " + "; ".join(bad)
+            + ". Drop those flags or train in-memory."
+        )
 
 
 def _sweep_model_configs(sweeps, coordinates):
@@ -462,12 +510,31 @@ def run(args: argparse.Namespace) -> GameFit:
         )
 
         id_tags = id_tags_needed(coordinates)
-        with timer.time("read training data"):
-            data, index_maps, _ = read_game_data(
-                train_dirs, shard_configs, index_maps, id_tags=id_tags,
-                **col_names,
+        source = None
+        if args.streaming:
+            _check_streaming_compatible(args)
+            from photon_ml_tpu.streaming import StreamingSource
+
+            with timer.time("open streaming source"):
+                source = StreamingSource.open(
+                    train_dirs, shard_configs, index_maps=index_maps,
+                    block_rows=args.block_rows, id_tags=id_tags,
+                    **col_names,
+                )
+            index_maps = source.index_maps
+            data = None
+            logger.info(
+                "training rows (streamed): %d in %d blocks of %d",
+                source.plan.total_rows, source.plan.num_blocks,
+                args.block_rows,
             )
-        logger.info("training rows: %d", data.num_rows)
+        else:
+            with timer.time("read training data"):
+                data, index_maps, _ = read_game_data(
+                    train_dirs, shard_configs, index_maps, id_tags=id_tags,
+                    **col_names,
+                )
+            logger.info("training rows: %d", data.num_rows)
 
         def _check_shards(game_data, phase: str) -> None:
             """--check-data gate over every feature shard (reference CHECK_DATA
@@ -674,8 +741,24 @@ def run(args: argparse.Namespace) -> GameFit:
         fit_overrides: Dict[str, object] = {}  # the winning config's map
         all_fits: List[GameFit] = []  # every swept fit, for --model-output-mode ALL
         all_fit_overrides: List[Dict[str, object]] = []  # aligned with all_fits
+        if args.streaming and len(sweep_configs) > 1:
+            raise ValueError(
+                "--streaming does not compose with regularization_weights "
+                "sweeps (each swept fit would re-stream the dataset); pick "
+                "one weight per coordinate or train in-memory"
+            )
         with profile_ctx, timer.time("fit"):
-            if len(sweep_configs) > 1:
+            if args.streaming:
+                fit = estimator.fit_streaming(
+                    source,
+                    validation_data=validation_data,
+                    checkpoint_dir=args.checkpoint_dir,
+                    prefetch_depth=args.prefetch_depth,
+                    mode=args.stream_mode,
+                )
+                all_fits = [fit]
+                all_fit_overrides = [{}]
+            elif len(sweep_configs) > 1:
                 # one fit per swept configuration, best by the validation
                 # evaluator (reference Driver.scala:112 selectBestModel over
                 # getAllModelConfigs)

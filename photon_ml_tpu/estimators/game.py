@@ -35,7 +35,7 @@ from photon_ml_tpu.algorithm.factored_random_effect import (
     FactoredRandomEffectCoordinate,
     MFOptimizationConfiguration,
 )
-from photon_ml_tpu.data.game_data import GameData
+from photon_ml_tpu.data.game_data import FeatureShard, GameData
 from photon_ml_tpu.data.random_effect import (
     RandomEffectDataConfiguration,
     build_random_effect_dataset,
@@ -442,7 +442,14 @@ class GameEstimator:
                 problems.append(f"{cid}: not in current configuration")
                 continue
             if isinstance(model, GeneralizedLinearModel):
-                if not isinstance(coord, FixedEffectCoordinate):
+                from photon_ml_tpu.streaming.coordinate import (
+                    StreamingFixedEffectCoordinate,
+                )
+
+                if not isinstance(
+                    coord,
+                    (FixedEffectCoordinate, StreamingFixedEffectCoordinate),
+                ):
                     problems.append(
                         f"{cid}: checkpoint holds a fixed-effect model but "
                         "the coordinate is now configured as "
@@ -450,8 +457,12 @@ class GameEstimator:
                     )
                     continue
                 # parallel layouts pad the coordinate's feature axis;
-                # checkpoints carry real-dim models
-                want = coord.num_real_cols or coord.data.dim
+                # checkpoints carry real-dim models (streaming coordinates
+                # always speak real dims)
+                if isinstance(coord, StreamingFixedEffectCoordinate):
+                    want = coord.dim
+                else:
+                    want = coord.num_real_cols or coord.data.dim
                 if model.dim != want:
                     problems.append(
                         f"{cid}: checkpoint dim {model.dim} != data dim {want}"
@@ -579,6 +590,99 @@ class GameEstimator:
             cid: self._build_coordinate(cid, cfg, data)
             for cid, cfg in self.coordinate_configs.items()
         }
+        return self._run_fit(
+            coordinates, data, validation_data, checkpoint_dir, initial_models
+        )
+
+    def fit_streaming(
+        self,
+        source,
+        validation_data: Optional[GameData] = None,
+        checkpoint_dir: Optional[str] = None,
+        initial_models: Optional[Dict[str, object]] = None,
+        prefetch_depth: int = 2,
+        mode: str = "full",
+        stochastic_epochs: int = 5,
+        stochastic_chunk_iters: int = 4,
+        blocks_per_update: int = 1,
+        seed: int = 0,
+    ) -> GameFit:
+        """Out-of-core ``fit``: fixed-effect coordinates stream fixed-shape
+        blocks from a :class:`~photon_ml_tpu.streaming.StreamingSource`
+        instead of holding the design matrix in memory.
+
+        One streamed setup pass accumulates the per-row scalar planes
+        (labels/offsets/weights/id tags — O(n) scalars, not features) and
+        the per-entity COO of random-effect shards, so RE coordinates run
+        through the existing cost-sorted bucket packing unchanged. The FE
+        feature payload — the memory-dominant term — never materializes:
+        each CD update/score re-streams it, with host staging bounded by
+        ``prefetch_depth × block bytes``.
+
+        ``mode='full'`` is the exact full-batch streamed solve (same
+        optimum as in-memory, the default); ``mode='stochastic'`` visits
+        shuffled block groups per epoch on the resumable solver seam —
+        gate it on held-out metric parity before trusting it.
+        """
+        from photon_ml_tpu.streaming.coordinate import (
+            StreamingFixedEffectCoordinate,
+        )
+
+        if self.parallel is not None:
+            raise ValueError(
+                "streaming training does not compose with the device-grid "
+                "parallel layout yet (multi-host streaming is roadmap work)"
+            )
+        if self.compute_variance:
+            raise ValueError(
+                "streaming training cannot compute coefficient variances "
+                "(needs a second Hessian-diagonal pass; train in-memory)"
+            )
+        fe_cfgs = {
+            cid: cfg
+            for cid, cfg in self.coordinate_configs.items()
+            if isinstance(cfg, FixedEffectCoordinateConfiguration)
+        }
+        for cid, cfg in fe_cfgs.items():
+            if self.normalization.get(cfg.feature_shard) is not None:
+                raise ValueError(
+                    f"streaming coordinate {cid!r}: normalization requires "
+                    "a streamed feature-stats pass (not implemented); use "
+                    "--normalization-type NONE or train in-memory"
+                )
+        re_shards = sorted({
+            cfg.feature_shard
+            for cid, cfg in self.coordinate_configs.items()
+            if cid not in fe_cfgs
+        })
+        planes = source.row_planes(coo_shards=re_shards)
+        data = GameData(
+            labels=planes.labels,
+            feature_shards={
+                sid: FeatureShard(rows=r, cols=c, vals=v, dim=d)
+                for sid, (r, c, v, d) in planes.shard_coo.items()
+            },
+            id_tags=planes.id_tags,
+            offsets=planes.offsets,
+            weights=planes.weights,
+        )
+        coordinates: Dict[str, Coordinate] = {}
+        for cid, cfg in self.coordinate_configs.items():
+            if cid in fe_cfgs:
+                coordinates[cid] = StreamingFixedEffectCoordinate(
+                    source=source,
+                    shard_id=cfg.feature_shard,
+                    task=self.task,
+                    configuration=cfg.optimizer,
+                    prefetch_depth=prefetch_depth,
+                    mode=mode,
+                    epochs=stochastic_epochs,
+                    chunk_iters=stochastic_chunk_iters,
+                    blocks_per_update=blocks_per_update,
+                    seed=seed,
+                )
+            else:
+                coordinates[cid] = self._build_coordinate(cid, cfg, data)
         return self._run_fit(
             coordinates, data, validation_data, checkpoint_dir, initial_models
         )

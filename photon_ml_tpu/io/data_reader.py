@@ -212,6 +212,67 @@ def read_game_data(
     return data, index_maps, uids
 
 
+def list_data_files(paths: Sequence[str] | str) -> List[str]:
+    """Part files of one or more dataset dirs/files, in read order — the
+    file-granular view `read_game_data` concatenates over."""
+    if isinstance(paths, str):
+        paths = [paths]
+    return _part_files(paths)
+
+
+def file_row_counts(paths: Sequence[str] | str) -> List[tuple]:
+    """``(path, row_count)`` per part file via a container framing scan —
+    no record decode, no decompression. Streaming block planners use this
+    to lay out fixed-size example blocks across file boundaries without
+    materializing the dataset."""
+    from photon_ml_tpu.io.native_reader import container_block_counts
+
+    return [
+        (path, int(sum(container_block_counts(path))))
+        for path in list_data_files(paths)
+    ]
+
+
+def iter_game_data(
+    paths: Sequence[str] | str,
+    shard_configs: Dict[str, FeatureShardConfiguration],
+    index_maps: Dict[str, IndexMap],
+    id_tags: Sequence[str] = (),
+    response_field: str = "label",
+    offset_field: str = "offset",
+    weight_field: str = "weight",
+    uid_field: str = "uid",
+    is_response_required: bool = True,
+):
+    """File-granular variant of :func:`read_game_data`: yields
+    ``(path, GameData, uids)`` one part file at a time instead of
+    concatenating the whole dataset.
+
+    ``index_maps`` must be prebuilt (e.g. :func:`build_index_maps` or a
+    loaded off-heap map): every yielded piece then shares one stable column
+    space, so downstream block shapes are identical across files and
+    nothing retraces. Peak memory is one decoded file, not the dataset.
+    """
+    if index_maps is None:
+        raise ValueError(
+            "iter_game_data requires prebuilt index_maps; build them once "
+            "with build_index_maps() so file pieces share a stable index"
+        )
+    for path in list_data_files(paths):
+        data, _, uids = read_game_data(
+            [path],
+            shard_configs,
+            index_maps=index_maps,
+            id_tags=id_tags,
+            response_field=response_field,
+            offset_field=offset_field,
+            weight_field=weight_field,
+            uid_field=uid_field,
+            is_response_required=is_response_required,
+        )
+        yield path, data, uids
+
+
 def _part_files(paths: Sequence[str]) -> List[str]:
     from photon_ml_tpu.io.avro import list_part_files
 

@@ -236,16 +236,15 @@ def _np_from(ptr, n, dtype):
     return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
 
 
-def read_columnar_file(
-    path: str, plan: ColumnarPlan, data: Optional[bytes] = None
-) -> Optional[ColumnarFile]:
-    """Decode one container file through the native path (None on any
-    mismatch: different schema shape, unsupported codec, decode error).
-    ``data`` passes already-read file bytes (header sniffing shares one
-    read with decoding)."""
-    lib = _load_native()
-    if lib is None:
-        return None
+def _scan_container(
+    path: str, data: Optional[bytes] = None
+) -> Optional[Tuple[List[bytes], List[int], str]]:
+    """Parse the container framing of one Avro file into per-container-block
+    raw payloads and record counts, without decompressing anything.
+
+    Returns ``(payloads, counts, codec)`` where ``payloads[i]`` is the raw
+    (possibly deflate-compressed) bytes of container block *i* holding
+    ``counts[i]`` records, or None when the codec is unsupported."""
     if data is None:
         with open(path, "rb") as f:
             data = f.read()
@@ -257,19 +256,66 @@ def read_columnar_file(
     if codec not in ("null", "deflate"):
         return None
     sync = r.read(SYNC_SIZE)
-
     payloads: List[bytes] = []
-    n_records = 0
+    counts: List[int] = []
     while r.pos < len(r.buf):
         n = r.read_long()
         size = r.read_long()
-        payload = r.read(size)
-        if codec == "deflate":
-            payload = zlib.decompress(payload, -15)
-        payloads.append(payload)
-        n_records += n
+        payloads.append(r.read(size))
+        counts.append(n)
         if r.read(SYNC_SIZE) != sync:
             raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+    return payloads, counts, codec
+
+
+def container_block_counts(
+    path: str, data: Optional[bytes] = None
+) -> List[int]:
+    """Per-container-block record counts of one Avro file (framing scan only,
+    no decompression or record decode). The streaming block planner uses this
+    to size blocks without pulling data through the decoder."""
+    scanned = _scan_container(path, data)
+    if scanned is None:
+        raise ValueError(f"{path}: unsupported avro codec for framing scan")
+    return scanned[1]
+
+
+def read_columnar_file(
+    path: str,
+    plan: ColumnarPlan,
+    data: Optional[bytes] = None,
+    block_start: int = 0,
+    block_count: Optional[int] = None,
+) -> Optional[ColumnarFile]:
+    """Decode one container file through the native path (None on any
+    mismatch: different schema shape, unsupported codec, decode error).
+    ``data`` passes already-read file bytes (header sniffing shares one
+    read with decoding). ``block_start``/``block_count`` restrict decoding
+    to a contiguous range of *container* blocks — the unit of chunked
+    out-of-core reads; only the selected payloads are decompressed, and the
+    resulting columns are bitwise-identical to the matching row range of a
+    whole-file read."""
+    lib = _load_native()
+    if lib is None:
+        return None
+    scanned = _scan_container(path, data)
+    if scanned is None:
+        return None
+    payloads, counts, codec = scanned
+    if block_start < 0 or block_start > len(payloads):
+        raise ValueError(
+            f"{path}: block_start={block_start} out of range "
+            f"[0, {len(payloads)}]"
+        )
+    stop = (
+        len(payloads)
+        if block_count is None
+        else min(block_start + max(block_count, 0), len(payloads))
+    )
+    payloads = payloads[block_start:stop]
+    n_records = sum(counts[block_start:stop])
+    if codec == "deflate":
+        payloads = [zlib.decompress(p, -15) for p in payloads]
 
     blob = b"".join(payloads)
     tag_names = sorted(plan.tags, key=plan.tags.get)

@@ -107,13 +107,15 @@ class EllFeatures:
 FeatureMatrix = Union[DenseFeatures, EllFeatures]
 
 
-def from_scipy_like(rows, cols, vals, shape, max_nnz: int | None = None) -> EllFeatures:
-    """Build EllFeatures from COO triplets (host-side, vectorized numpy).
+def pack_ell_host(rows, cols, vals, shape, max_nnz: int | None = None):
+    """Host-side ELL packing from COO triplets: returns numpy
+    ``(values [n, k], indices [n, k])`` without touching the device.
 
-    Duplicate (row, col) entries are coalesced by summation (scipy COO
-    semantics) so the squared-value map ``rmatvec_sq`` stays consistent with
-    the linear maps. Raises if any row exceeds ``max_nnz`` after coalescing —
-    silent truncation would train a wrong model.
+    This is the staging half of :func:`from_scipy_like` — the streaming
+    prefetcher packs blocks in a background thread and defers the
+    ``device_put`` to the consumer, so packing must not allocate device
+    buffers. Semantics are identical: duplicates coalesced by summation,
+    ``ValueError`` when a row exceeds ``max_nnz``.
     """
     import numpy as np
 
@@ -127,19 +129,30 @@ def from_scipy_like(rows, cols, vals, shape, max_nnz: int | None = None) -> EllF
         if cols.min() < 0 or cols.max() >= d:
             raise ValueError(f"column index out of range [0, {d})")
 
-    # coalesce duplicates: sort by (row, col), segment-sum runs
+    # coalesce duplicates: sort by (row, col), segment-sum runs. Decoder
+    # output is already (row, col)-sorted and duplicate-free, so both the
+    # lexsort and the (slow) np.add.at are skipped on that fast path —
+    # this is the streaming prefetcher's per-block hot loop.
     if rows.size:
-        order = np.lexsort((cols, rows))
-        rows, cols, vals = rows[order], cols[order], vals[order]
+        in_order = bool(
+            np.all(
+                (rows[1:] > rows[:-1])
+                | ((rows[1:] == rows[:-1]) & (cols[1:] >= cols[:-1]))
+            )
+        )
+        if not in_order:
+            order = np.lexsort((cols, rows))
+            rows, cols, vals = rows[order], cols[order], vals[order]
         boundary = np.empty(rows.size, dtype=bool)
         boundary[0] = True
         boundary[1:] = (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])
-        seg_ids = np.cumsum(boundary) - 1
         uniq = int(boundary.sum())
-        summed = np.zeros(uniq, dtype=np.float64)
-        np.add.at(summed, seg_ids, vals)
-        rows, cols = rows[boundary], cols[boundary]
-        vals = summed.astype(np.float32)
+        if uniq != rows.size:
+            seg_ids = np.cumsum(boundary) - 1
+            summed = np.zeros(uniq, dtype=np.float64)
+            np.add.at(summed, seg_ids, vals)
+            rows, cols = rows[boundary], cols[boundary]
+            vals = summed.astype(np.float32)
 
     counts = np.bincount(rows, minlength=n)
     needed = int(counts.max()) if rows.size else 1
@@ -158,4 +171,20 @@ def from_scipy_like(rows, cols, vals, shape, max_nnz: int | None = None) -> EllF
         slots = np.arange(rows.size, dtype=np.int64) - starts[rows]
         values[rows, slots] = vals
         indices[rows, slots] = cols
-    return EllFeatures(values=jnp.asarray(values), indices=jnp.asarray(indices), num_cols=int(d))
+    return values, indices
+
+
+def from_scipy_like(rows, cols, vals, shape, max_nnz: int | None = None) -> EllFeatures:
+    """Build EllFeatures from COO triplets (host-side, vectorized numpy).
+
+    Duplicate (row, col) entries are coalesced by summation (scipy COO
+    semantics) so the squared-value map ``rmatvec_sq`` stays consistent with
+    the linear maps. Raises if any row exceeds ``max_nnz`` after coalescing —
+    silent truncation would train a wrong model.
+    """
+    values, indices = pack_ell_host(rows, cols, vals, shape, max_nnz)
+    return EllFeatures(
+        values=jnp.asarray(values),
+        indices=jnp.asarray(indices),
+        num_cols=int(shape[1]),
+    )

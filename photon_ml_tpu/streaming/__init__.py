@@ -1,0 +1,42 @@
+"""Out-of-core training: disk-resident datasets streamed as fixed-shape
+example blocks through a double-buffered host→device prefetcher into
+block-sharded solvers. See docs/SCALING.md ("Streaming out-of-core").
+"""
+
+from photon_ml_tpu.streaming.blocks import (
+    BlockPlan,
+    HostBlock,
+    RowPlanes,
+    StreamingSource,
+)
+from photon_ml_tpu.streaming.coordinate import StreamingFixedEffectCoordinate
+from photon_ml_tpu.streaming.prefetch import (
+    BlockPrefetcher,
+    DeviceBlock,
+    PrefetchStats,
+)
+from photon_ml_tpu.streaming.solver import (
+    StreamSolveInfo,
+    reset_stream_trace_counts,
+    solve_streaming,
+    solve_streaming_stochastic,
+    stream_trace_counts,
+    streamed_objective_value,
+)
+
+__all__ = [
+    "BlockPlan",
+    "HostBlock",
+    "RowPlanes",
+    "StreamingSource",
+    "StreamingFixedEffectCoordinate",
+    "BlockPrefetcher",
+    "DeviceBlock",
+    "PrefetchStats",
+    "StreamSolveInfo",
+    "reset_stream_trace_counts",
+    "solve_streaming",
+    "solve_streaming_stochastic",
+    "stream_trace_counts",
+    "streamed_objective_value",
+]

@@ -1,0 +1,472 @@
+"""Out-of-core example blocks: fixed-shape slices of a disk-resident dataset.
+
+The in-memory trainers materialize one ``GameData`` for the whole dataset.
+This module instead lays the dataset out as a sequence of ``block_rows``-row
+blocks over the part files (``io/data_reader.py`` provides the file-granular
+iterator), where every block has IDENTICAL shapes:
+
+* row planes (labels / offsets / weights) are padded ``[block_rows]`` arrays
+  with weight 0 in padding rows — an algebraic no-op in every objective term
+  (see ops/data.py), so padded blocks are exact;
+* each feature shard is packed into a padded ELL pair ``[block_rows, k]``
+  where ``k`` is the GLOBAL max nnz/row recorded by the planning pass, so one
+  compiled per-block program serves every block and nothing retraces.
+
+A stable feature index (the off-heap/prebuilt index maps) is mandatory: all
+blocks must live in one column space. The planning pass decodes each part
+file once to record per-shard ELL widths and exact per-file row counts; the
+streaming pass then re-decodes files on demand with a tiny LRU so peak host
+memory is O(decoded files in cache) + O(prefetch_depth × block bytes), never
+O(dataset).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.io.data_reader import (
+    FeatureShardConfiguration,
+    build_index_maps,
+    file_row_counts,
+    read_game_data,
+)
+from photon_ml_tpu.ops.features import pack_ell_host
+from photon_ml_tpu.telemetry import span
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockPlan:
+    """Static layout of a streamed dataset: file boundaries + block shapes.
+
+    Produced once by the planning pass; every block of the run obeys it, so
+    block shapes are a function of the plan alone (the zero-retrace
+    contract)."""
+
+    block_rows: int
+    total_rows: int
+    files: Tuple[str, ...]
+    file_rows: Tuple[int, ...]
+    shard_widths: Dict[str, int]   # shard -> ELL k (global max nnz/row)
+    shard_dims: Dict[str, int]     # shard -> feature dimension d
+
+    @property
+    def num_blocks(self) -> int:
+        return max(1, -(-self.total_rows // self.block_rows))
+
+    @property
+    def padded_rows(self) -> int:
+        """Total rows including final-block padding (num_blocks*block_rows)."""
+        return self.num_blocks * self.block_rows
+
+    def block_bounds(self, index: int) -> Tuple[int, int]:
+        """[start, stop) global row range of real rows in block ``index``."""
+        if not 0 <= index < self.num_blocks:
+            raise IndexError(f"block {index} out of range [0, {self.num_blocks})")
+        start = index * self.block_rows
+        return start, min(start + self.block_rows, self.total_rows)
+
+    def spans(self, index: int) -> List[Tuple[int, int, int]]:
+        """Per-file pieces of block ``index`` as (file_idx, lo, hi) with
+        lo/hi local to that file — a block freely spans file boundaries."""
+        start, stop = self.block_bounds(index)
+        out: List[Tuple[int, int, int]] = []
+        base = 0
+        for fi, rows in enumerate(self.file_rows):
+            file_end = base + rows
+            lo = max(start, base)
+            hi = min(stop, file_end)
+            if lo < hi:
+                out.append((fi, lo - base, hi - base))
+            base = file_end
+            if base >= stop:
+                break
+        return out
+
+
+@dataclasses.dataclass
+class HostBlock:
+    """One decoded, padded, host-staged block (numpy only — built in the
+    prefetcher's background thread; the consumer does the device_put)."""
+
+    index: int
+    start: int        # global row of the first real row
+    num_real: int     # real rows (rest is weight-0 padding)
+    labels: np.ndarray    # [block_rows] f32
+    offsets: np.ndarray   # [block_rows] f32 (base offsets from the files)
+    weights: np.ndarray   # [block_rows] f32, 0.0 in padding rows
+    shards: Dict[str, Tuple[np.ndarray, np.ndarray]]  # sid -> (vals, idx) ELL
+    id_tags: Dict[str, np.ndarray]  # re_type -> [num_real] entity ids
+
+
+@dataclasses.dataclass
+class RowPlanes:
+    """Whole-dataset per-row scalar planes accumulated by one setup pass.
+
+    These are O(n) scalars + id strings (not features); the random-effect
+    coordinates and the CD driver's objective need them resident. The
+    feature payload of the streamed (fixed-effect) shard is what stays
+    out-of-core."""
+
+    labels: np.ndarray
+    offsets: np.ndarray
+    weights: np.ndarray
+    id_tags: Dict[str, np.ndarray]
+    shard_coo: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray, int]]
+
+
+class StreamingSource:
+    """A disk-resident GAME dataset exposed as fixed-shape example blocks.
+
+    Open once per run (the planning pass decodes every part file once to
+    fix ELL widths); then ``iter_blocks`` streams HostBlocks in any block
+    order, re-decoding part files on demand through a small LRU cache.
+    """
+
+    def __init__(
+        self,
+        files: Sequence[str],
+        file_rows: Sequence[int],
+        shard_configs: Dict[str, FeatureShardConfiguration],
+        index_maps,
+        plan: BlockPlan,
+        id_tags: Sequence[str] = (),
+        read_kwargs: Optional[dict] = None,
+        file_cache_size: int = 2,
+        decode_workers: Optional[int] = None,
+    ):
+        self.files = list(files)
+        self.file_rows = list(file_rows)
+        self.shard_configs = shard_configs
+        self.index_maps = index_maps
+        self.plan = plan
+        self.id_tags = tuple(id_tags)
+        self.read_kwargs = dict(read_kwargs or {})
+        self.file_cache_size = max(1, int(file_cache_size))
+        if decode_workers is None:
+            # leave one core for the consumer/solver; on a single-CPU host
+            # parallel decode only adds contention, so default it off
+            decode_workers = min(4, (os.cpu_count() or 1) - 1)
+        self.decode_workers = max(0, int(decode_workers))
+        self._file_cache: Dict[int, object] = {}  # fi -> GameData (LRU)
+        self._cache_limit = self.file_cache_size
+        self._lock = threading.RLock()
+        self._pending: Dict[int, Future] = {}  # fi -> in-flight decode
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._row_planes: Optional[RowPlanes] = None
+        # decode accounting for the planning/setup passes (bench evidence)
+        self.files_decoded = 0
+        self._work_s = 0.0  # host decode+pack seconds, whatever thread
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def open(
+        cls,
+        paths: Sequence[str] | str,
+        shard_configs: Dict[str, FeatureShardConfiguration],
+        index_maps=None,
+        block_rows: int = 4096,
+        id_tags: Sequence[str] = (),
+        file_cache_size: int = 2,
+        decode_workers: Optional[int] = None,
+        **read_kwargs,
+    ) -> "StreamingSource":
+        """Plan a streamed dataset: list part files, fix the feature index,
+        and record global ELL widths with one decode pass per file."""
+        if isinstance(paths, str):
+            paths = [paths]
+        if block_rows < 1:
+            raise ValueError(f"block_rows must be >= 1, got {block_rows}")
+        with span("read stream plan", files=0):
+            counts = file_row_counts(paths)
+        files = [p for p, _ in counts]
+        rows = [n for _, n in counts]
+        if not files or sum(rows) == 0:
+            raise ValueError(f"no rows found under {paths}")
+        if index_maps is None:
+            index_maps = build_index_maps(paths, shard_configs)
+
+        src = cls(
+            files, rows, shard_configs, index_maps,
+            plan=None,  # type: ignore[arg-type]  # set below
+            id_tags=id_tags, read_kwargs=read_kwargs,
+            file_cache_size=file_cache_size,
+            decode_workers=decode_workers,
+        )
+        widths = {sid: 1 for sid in shard_configs}
+        dims = {sid: len(index_maps[sid]) for sid in shard_configs}
+        for fi in range(len(files)):
+            data = src._decode_file(fi, cache=False)
+            if data.num_rows != rows[fi]:
+                raise ValueError(
+                    f"{files[fi]}: framing scan counted {rows[fi]} rows but "
+                    f"decode produced {data.num_rows}"
+                )
+            for sid, shard in data.feature_shards.items():
+                if shard.rows.size:
+                    per_row = np.bincount(shard.rows, minlength=data.num_rows)
+                    widths[sid] = max(widths[sid], int(per_row.max()))
+        src.plan = BlockPlan(
+            block_rows=int(block_rows),
+            total_rows=sum(rows),
+            files=tuple(files),
+            file_rows=tuple(rows),
+            shard_widths=widths,
+            shard_dims=dims,
+        )
+        return src
+
+    # -- file decode + cache ----------------------------------------------
+
+    @property
+    def work_seconds(self) -> float:
+        """Cumulative host decode+pack seconds across all threads. The
+        prefetcher differences this around an iteration to report
+        ``stream.decode_s`` as WORK (not exposed latency), so the hide
+        ratio stays meaningful when decode runs in parallel."""
+        with self._lock:
+            return self._work_s
+
+    def _add_work(self, dt: float) -> None:
+        with self._lock:
+            self._work_s += dt
+
+    def _decode_now(self, fi: int):
+        """The actual (uncached) file read — safe from any thread."""
+        t0 = time.perf_counter()
+        with span("read stream file", file=self.files[fi]):
+            data, _, _ = read_game_data(
+                [self.files[fi]],
+                self.shard_configs,
+                index_maps=self.index_maps,
+                id_tags=self.id_tags,
+                **self.read_kwargs,
+            )
+        # sort each shard's COO by (row, col) once here: block assembly
+        # then slices row ranges by binary search instead of masking the
+        # whole file, and ELL packing skips its per-block lexsort
+        for shard in data.feature_shards.values():
+            r, c = shard.rows, shard.cols
+            if r.size and not bool(np.all(
+                (r[1:] > r[:-1]) | ((r[1:] == r[:-1]) & (c[1:] >= c[:-1]))
+            )):
+                order = np.lexsort((c, r))
+                shard.rows = r[order]
+                shard.cols = c[order]
+                shard.vals = shard.vals[order]
+        with self._lock:
+            self.files_decoded += 1
+            self._work_s += time.perf_counter() - t0
+        return data
+
+    def _cache_insert(self, fi: int, data) -> None:
+        with self._lock:
+            self._file_cache[fi] = data
+            while len(self._file_cache) > self._cache_limit:
+                self._file_cache.pop(next(iter(self._file_cache)))
+
+    def _decode_file(self, fi: int, cache: bool = True):
+        with self._lock:
+            cached = self._file_cache.pop(fi, None)
+            if cached is not None:
+                self._file_cache[fi] = cached  # re-insert: most recently used
+                return cached
+            fut = self._pending.get(fi)
+        if fut is not None:
+            return fut.result()  # the pool job inserts into the cache
+        data = self._decode_now(fi)
+        if cache:
+            self._cache_insert(fi, data)
+        return data
+
+    def prefetch_files(self, fis: Sequence[int]) -> None:
+        """Schedule background decodes of the named part files on the decode
+        pool (no-op when ``decode_workers`` is 0). The readahead window also
+        widens the LRU so a prefetched file is not evicted before its blocks
+        are consumed — decoded-file residency is the time/memory tradeoff of
+        parallel decode."""
+        if self.decode_workers <= 0:
+            return
+        with self._lock:
+            self._cache_limit = max(self.file_cache_size, len(fis) + 1)
+            todo = [
+                fi for fi in fis
+                if fi not in self._file_cache and fi not in self._pending
+            ]
+            if not todo:
+                return
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.decode_workers,
+                    thread_name_prefix="stream-decode",
+                )
+            for fi in todo:
+                self._pending[fi] = self._pool.submit(self._prefetch_job, fi)
+
+    def _prefetch_job(self, fi: int):
+        try:
+            data = self._decode_now(fi)
+            self._cache_insert(fi, data)
+            return data
+        finally:
+            with self._lock:
+                self._pending.pop(fi, None)
+
+    # -- block assembly ----------------------------------------------------
+
+    def build_block(
+        self, index: int, shards: Optional[Sequence[str]] = None
+    ) -> HostBlock:
+        """Assemble one padded HostBlock (host numpy only). ``shards``
+        restricts ELL packing to the named feature shards (the streamed
+        fixed-effect coordinate only needs its own)."""
+        plan = self.plan
+        start, stop = plan.block_bounds(index)
+        num_real = stop - start
+        b = plan.block_rows
+        want = tuple(shards) if shards is not None else tuple(self.shard_configs)
+
+        labels = np.zeros(b, dtype=np.float32)
+        offsets = np.zeros(b, dtype=np.float32)
+        weights = np.zeros(b, dtype=np.float32)  # padding stays weight 0
+        tag_parts: Dict[str, List[np.ndarray]] = {t: [] for t in self.id_tags}
+        coo: Dict[str, List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = {
+            sid: [] for sid in want
+        }
+
+        out_row = 0
+        t_build = 0.0
+        t0 = time.perf_counter()
+        for fi, lo, hi in plan.spans(index):
+            t_build += time.perf_counter() - t0
+            piece = self._decode_file(fi)
+            t0 = time.perf_counter()
+            n_piece = hi - lo
+            sl = slice(lo, hi)
+            labels[out_row:out_row + n_piece] = piece.labels[sl]
+            offsets[out_row:out_row + n_piece] = piece.offsets[sl]
+            weights[out_row:out_row + n_piece] = piece.weights[sl]
+            for t in self.id_tags:
+                tag_parts[t].append(np.asarray(piece.id_tags[t])[sl])
+            for sid in want:
+                shard = piece.feature_shards[sid]
+                r = shard.rows
+                if r.size and bool(np.all(r[1:] >= r[:-1])):
+                    # decoder COO is row-major: slice by binary search
+                    # instead of masking the whole file's triplets
+                    i0, i1 = np.searchsorted(r, (lo, hi))
+                    coo[sid].append((
+                        r[i0:i1] - lo + out_row,
+                        shard.cols[i0:i1],
+                        shard.vals[i0:i1],
+                    ))
+                else:
+                    keep = (r >= lo) & (r < hi)
+                    coo[sid].append((
+                        r[keep] - lo + out_row,
+                        shard.cols[keep],
+                        shard.vals[keep],
+                    ))
+            out_row += n_piece
+
+        packed: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        for sid in want:
+            rows = np.concatenate([p[0] for p in coo[sid]]) if coo[sid] else np.zeros(0, np.int64)
+            cols = np.concatenate([p[1] for p in coo[sid]]) if coo[sid] else np.zeros(0, np.int64)
+            vals = np.concatenate([p[2] for p in coo[sid]]) if coo[sid] else np.zeros(0, np.float32)
+            packed[sid] = pack_ell_host(
+                rows, cols, vals,
+                (b, plan.shard_dims[sid]),
+                max_nnz=plan.shard_widths[sid],
+            )
+        t_build += time.perf_counter() - t0
+        self._add_work(t_build)
+        return HostBlock(
+            index=index,
+            start=start,
+            num_real=num_real,
+            labels=labels,
+            offsets=offsets,
+            weights=weights,
+            shards=packed,
+            id_tags={
+                t: (np.concatenate(v) if v else np.zeros(0, dtype=object))
+                for t, v in tag_parts.items()
+            },
+        )
+
+    def iter_blocks(
+        self,
+        order: Optional[Sequence[int]] = None,
+        shards: Optional[Sequence[str]] = None,
+    ) -> Iterator[HostBlock]:
+        """Yield HostBlocks in ``order`` (default: sequential). Sequential
+        order decodes each part file exactly once thanks to the LRU;
+        shuffled orders may re-decode — that cost is the stochastic mode's
+        tradeoff and is visible in the io phase of the telemetry report."""
+        indices = range(self.plan.num_blocks) if order is None else order
+        for i in indices:
+            with span("read stream block", block=int(i)):
+                yield self.build_block(int(i), shards=shards)
+
+    # -- whole-dataset row planes (setup pass) ----------------------------
+
+    def row_planes(self, coo_shards: Sequence[str] = ()) -> RowPlanes:
+        """One streamed setup pass accumulating the per-row scalar planes
+        (labels/offsets/weights/id tags) and, optionally, the full COO of
+        the named (small, per-entity) shards for random-effect grouping.
+        Cached: a later call asking for shards the cache lacks re-runs the
+        setup pass for the union."""
+        if self._row_planes is not None:
+            missing = set(coo_shards) - set(self._row_planes.shard_coo)
+            if not missing:
+                return self._row_planes
+            coo_shards = sorted(set(coo_shards) | set(self._row_planes.shard_coo))
+            self._row_planes = None
+        labels, offsets, weights = [], [], []
+        tags: Dict[str, List[np.ndarray]] = {t: [] for t in self.id_tags}
+        coo: Dict[str, List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = {
+            sid: [] for sid in coo_shards
+        }
+        base = 0
+        with span("read stream row planes", shards=len(list(coo_shards))):
+            for fi in range(len(self.files)):
+                piece = self._decode_file(fi)
+                labels.append(piece.labels)
+                offsets.append(piece.offsets)
+                weights.append(piece.weights)
+                for t in self.id_tags:
+                    tags[t].append(np.asarray(piece.id_tags[t]))
+                for sid in coo_shards:
+                    shard = piece.feature_shards[sid]
+                    coo[sid].append((shard.rows + base, shard.cols, shard.vals))
+                base += piece.num_rows
+        self._row_planes = RowPlanes(
+            labels=np.concatenate(labels),
+            offsets=np.concatenate(offsets),
+            weights=np.concatenate(weights),
+            id_tags={t: np.concatenate(v) for t, v in tags.items()},
+            shard_coo={
+                sid: (
+                    np.concatenate([p[0] for p in v]) if v else np.zeros(0, np.int64),
+                    np.concatenate([p[1] for p in v]) if v else np.zeros(0, np.int64),
+                    np.concatenate([p[2] for p in v]) if v else np.zeros(0, np.float32),
+                    self.plan.shard_dims[sid],
+                )
+                for sid, v in coo.items()
+            },
+        )
+        return self._row_planes
+
+    def block_feature_bytes(self, shard: str) -> int:
+        """Host bytes of ONE staged block of ``shard`` (f32 values + i32
+        indices) — the unit the prefetch-depth RSS bound multiplies."""
+        k = self.plan.shard_widths[shard]
+        return self.plan.block_rows * k * 8

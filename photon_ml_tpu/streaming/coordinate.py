@@ -1,0 +1,285 @@
+"""Streaming fixed-effect coordinate: out-of-core CD participation.
+
+The in-memory :class:`FixedEffectCoordinate` owns a device-resident
+``LabeledData`` for the whole dataset. This coordinate instead owns a
+:class:`StreamingSource` and re-streams fixed-shape blocks from disk
+through a :class:`BlockPrefetcher` for every solve and every score:
+
+* ``update_model_device`` fuses the CD residual into each block's base
+  offsets with one fixed-shape ``dynamic_slice`` program (the residual is
+  padded once per update to ``num_blocks × block_rows``), then runs the
+  streamed full-batch (or stochastic) solver;
+* ``score_device`` assembles the global ``[num_rows]`` score plane from
+  per-block matvecs via donated ``dynamic_update_slice`` writes.
+
+All jitted programs live in module-level caches keyed by static shapes, so
+the per-(block, update, iteration) trace count is constant — the streaming
+parity gate asserts this via ``stream_trace_counts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.algorithm.coordinate import Coordinate
+from photon_ml_tpu.losses.objective import GlmObjective, make_glm_objective
+from photon_ml_tpu.losses.pointwise import loss_for_task
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.opt.config import GlmOptimizationConfiguration
+from photon_ml_tpu.opt.tracking import (
+    FixedEffectOptimizationTracker,
+    OptimizationStatesTracker,
+)
+from photon_ml_tpu.streaming.blocks import StreamingSource
+from photon_ml_tpu.streaming.prefetch import BlockPrefetcher, PrefetchStats
+from photon_ml_tpu.streaming.solver import (
+    StreamSolveInfo,
+    _note_trace,
+    solve_streaming,
+    solve_streaming_stochastic,
+)
+from photon_ml_tpu.telemetry import span
+from photon_ml_tpu.types import TaskType
+
+
+# make_glm_objective builds fresh closures per call; the streamed-solver
+# program caches key on objective identity, so same-task coordinates must
+# share one instance or every new estimator would retrace the solver suite
+_OBJECTIVE_CACHE: Dict[TaskType, GlmObjective] = {}
+
+
+def _objective_for_task(task: TaskType) -> GlmObjective:
+    obj = _OBJECTIVE_CACHE.get(task)
+    if obj is None:
+        obj = make_glm_objective(loss_for_task(task))
+        _OBJECTIVE_CACHE[task] = obj
+    return obj
+
+
+@partial(jax.jit, static_argnames=("padded",))
+def _pad_residual(residual: jax.Array, padded: int) -> jax.Array:
+    _note_trace("stream_pad_residual")
+    return jnp.pad(residual, (0, padded - residual.shape[0]))
+
+
+@jax.jit
+def _fuse_block_offsets(
+    base: jax.Array, residual_padded: jax.Array, start: jax.Array
+) -> jax.Array:
+    """base offsets + the block's residual slice; ``start`` is traced so one
+    program serves every block."""
+    _note_trace("stream_block_offsets")
+    b = base.shape[0]
+    return base + jax.lax.dynamic_slice(residual_padded, (start,), (b,))
+
+
+@jax.jit
+def _block_matvec(values, indices, w) -> jax.Array:
+    _note_trace("stream_block_matvec")
+    return jnp.sum(values * w[indices], axis=-1)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_scores(out: jax.Array, block_scores: jax.Array, start: jax.Array):
+    _note_trace("stream_scatter_scores")
+    return jax.lax.dynamic_update_slice(out, block_scores, (start,))
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _trim(out: jax.Array, n: int) -> jax.Array:
+    _note_trace("stream_trim_scores")
+    return out[:n]
+
+
+@dataclasses.dataclass
+class StreamingFixedEffectCoordinate(Coordinate):
+    """Fixed-effect GLM trained out-of-core from a StreamingSource.
+
+    Restrictions vs the in-memory coordinate (enforced by the estimator):
+    no normalization context (a streamed-stats pass is future work), no
+    per-coefficient variances, first-order solvers only in full-batch mode.
+    """
+
+    source: StreamingSource
+    shard_id: str
+    task: TaskType
+    configuration: GlmOptimizationConfiguration
+    prefetch_depth: int = 2
+    mode: str = "full"            # "full" (exact) | "stochastic"
+    epochs: int = 5               # stochastic: passes per update
+    chunk_iters: int = 4          # stochastic: solver iters per block group
+    blocks_per_update: int = 1    # stochastic: blocks concatenated per group
+    seed: int = 0
+    last_tracker: Optional[FixedEffectOptimizationTracker] = dataclasses.field(
+        default=None, repr=False
+    )
+    last_solve_info: Optional[StreamSolveInfo] = dataclasses.field(
+        default=None, repr=False
+    )
+    last_prefetch_stats: Optional[PrefetchStats] = dataclasses.field(
+        default=None, repr=False
+    )
+    _objective: Optional[GlmObjective] = dataclasses.field(
+        default=None, repr=False
+    )
+
+    supports_device_plane = True
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("full", "stochastic"):
+            raise ValueError(
+                f"streaming mode must be 'full' or 'stochastic', got {self.mode!r}"
+            )
+        if self.shard_id not in self.source.plan.shard_dims:
+            raise ValueError(
+                f"shard {self.shard_id!r} not in streaming plan "
+                f"{sorted(self.source.plan.shard_dims)}"
+            )
+
+    # -- shapes -----------------------------------------------------------
+
+    @property
+    def dim(self) -> int:
+        return self.source.plan.shard_dims[self.shard_id]
+
+    @property
+    def num_rows(self) -> int:
+        return self.source.plan.total_rows
+
+    def objective(self) -> GlmObjective:
+        if self._objective is None:
+            self._objective = _objective_for_task(self.task)
+        return self._objective
+
+    # -- streamed passes --------------------------------------------------
+
+    def _blocks(self, residual_padded=None, order=None):
+        """One streamed pass of DeviceBlocks for this shard; when a padded
+        residual plane is given, each block's offsets get its slice fused
+        in (fixed-shape program, traced once)."""
+        prefetcher = BlockPrefetcher(
+            self.source,
+            shards=(self.shard_id,),
+            depth=self.prefetch_depth,
+            order=order,
+        )
+        self.last_prefetch_stats = prefetcher.stats
+        for blk in prefetcher:
+            data = blk.data[self.shard_id]
+            if residual_padded is not None:
+                start = jnp.int32(blk.start)
+                data = data.replace(
+                    offsets=_fuse_block_offsets(
+                        data.offsets, residual_padded, start
+                    )
+                )
+                blk.data[self.shard_id] = data
+            yield blk
+
+    # -- Coordinate interface --------------------------------------------
+
+    def update_model_device(
+        self, model: Optional[GeneralizedLinearModel], residual_scores: jax.Array
+    ) -> GeneralizedLinearModel:
+        plan = self.source.plan
+        residual_padded = _pad_residual(residual_scores, plan.padded_rows)
+        w0 = (
+            jnp.zeros((self.dim,), dtype=jnp.float32)
+            if model is None
+            else model.coefficients.means
+        )
+        info = StreamSolveInfo()
+        with span(
+            "fe/solve",
+            device_sync=True,
+            optimizer=self.configuration.optimizer_config.optimizer.name,
+            streaming=self.mode,
+            blocks=plan.num_blocks,
+        ):
+            if self.mode == "full":
+                result = solve_streaming(
+                    self.objective(),
+                    w0,
+                    make_blocks=lambda: (
+                        blk.data[self.shard_id]
+                        for blk in self._blocks(residual_padded)
+                    ),
+                    configuration=self.configuration,
+                    info=info,
+                )
+            else:
+                total_weight = float(np.sum(self.source.row_planes().weights))
+                result = solve_streaming_stochastic(
+                    self.objective(),
+                    w0,
+                    make_blocks_ordered=lambda order: (
+                        _OwnShardBlocks(self, residual_padded, order)
+                    ),
+                    configuration=self.configuration,
+                    num_blocks=plan.num_blocks,
+                    total_weight=total_weight,
+                    epochs=self.epochs,
+                    chunk_iters=self.chunk_iters,
+                    blocks_per_update=self.blocks_per_update,
+                    seed=self.seed,
+                    info=info,
+                )
+            jax.block_until_ready(result.w)
+        self.last_solve_info = info
+        self.last_tracker = FixedEffectOptimizationTracker(
+            states=OptimizationStatesTracker.from_result(result)
+        )
+        return GeneralizedLinearModel(
+            coefficients=Coefficients(means=result.w), task=self.task
+        )
+
+    def update_model(
+        self, model: Optional[GeneralizedLinearModel], residual_scores: np.ndarray
+    ) -> GeneralizedLinearModel:
+        return self.update_model_device(
+            model, jnp.asarray(residual_scores, dtype=jnp.float32)
+        )
+
+    def score_device(self, model: GeneralizedLinearModel) -> jax.Array:
+        plan = self.source.plan
+        w = model.coefficients.means
+        out = jnp.zeros((plan.padded_rows,), dtype=jnp.float32)
+        for blk in self._blocks():
+            feats = blk.data[self.shard_id].features
+            scores = _block_matvec(feats.values, feats.indices, w)
+            out = _scatter_scores(out, scores, jnp.int32(blk.start))
+        return _trim(out, plan.total_rows)
+
+    def score(self, model: GeneralizedLinearModel) -> np.ndarray:
+        return np.asarray(self.score_device(model))
+
+
+class _OwnShardBlocks:
+    """Iterable view of one streamed pass restricted to the coordinate's
+    shard, with residual offsets fused (stochastic mode needs block-level
+    weight sums, so it receives the DeviceBlock-shaped wrapper)."""
+
+    def __init__(self, coord, residual_padded, order):
+        self.coord = coord
+        self.residual_padded = residual_padded
+        self.order = None if order is None else [int(i) for i in order]
+
+    def __iter__(self):
+        for blk in self.coord._blocks(self.residual_padded, order=self.order):
+            yield _ShardBlock(
+                data=blk.data[self.coord.shard_id],
+                weight_sum=blk.weight_sum,
+            )
+
+
+@dataclasses.dataclass
+class _ShardBlock:
+    data: object
+    weight_sum: float

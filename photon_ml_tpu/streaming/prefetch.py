@@ -1,0 +1,218 @@
+"""Double-buffered host→device block prefetcher.
+
+A background thread assembles and ELL-packs HostBlocks into a bounded queue
+of depth ``prefetch_depth`` — the staging buffer. The expensive part-file
+decodes are scheduled ahead of the assembly cursor on the source's decode
+pool (``decode_workers`` threads; Avro inflate and the vectorized columnar
+decode release the GIL), so several files decode concurrently while the
+consumer pops a staged block, issues the (async) ``device_put``, and the
+device solves block *k*. Host memory for staged feature payloads is bounded
+by ``prefetch_depth × block bytes`` by the queue itself, plus the decoded
+readahead files held by the source's LRU.
+
+Telemetry: decode runs under ``read stream block`` spans (io phase in the
+analyzer's bubble accounting), consumer stalls under ``read stream wait``
+(io — a visible input-pipeline bubble), and uploads under
+``stream h2d transfer`` (transfers phase). The registry gains
+``stream.blocks`` / ``stream.decode_s`` / ``stream.stall_s`` /
+``stream.prefetch_hide_ratio`` — the metric deps of the ``stream.*`` knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Dict, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.data import LabeledData
+from photon_ml_tpu.ops.features import EllFeatures
+from photon_ml_tpu.streaming.blocks import HostBlock, StreamingSource
+from photon_ml_tpu.telemetry import get_registry, span
+
+_DONE = object()
+
+
+@dataclasses.dataclass
+class DeviceBlock:
+    """One device-resident block: fixed-shape LabeledData per shard plus
+    the block's place in the global row space."""
+
+    index: int
+    start: int
+    num_real: int
+    data: Dict[str, LabeledData]   # shard -> [block_rows] LabeledData
+    weight_sum: float              # Σ real weights (stochastic l2 scaling)
+
+
+@dataclasses.dataclass
+class PrefetchStats:
+    """Wall-clock accounting of one streamed pass."""
+
+    blocks: int = 0
+    decode_s: float = 0.0    # host decode+pack WORK across all threads
+    stall_s: float = 0.0     # consumer time blocked waiting for a block
+    transfer_s: float = 0.0  # device_put dispatch time
+
+    @property
+    def hide_ratio(self) -> float:
+        """Fraction of decode wall clock hidden behind compute: decode time
+        that did NOT surface as a consumer stall."""
+        if self.decode_s <= 0:
+            return 1.0
+        return max(0.0, (self.decode_s - self.stall_s) / self.decode_s)
+
+
+class BlockPrefetcher:
+    """Iterate a StreamingSource's blocks with background decode.
+
+    ``depth=0`` disables the thread (synchronous decode — the debugging /
+    determinism baseline); ``depth>=1`` double-buffers with a staging queue
+    of that size.
+    """
+
+    def __init__(
+        self,
+        source: StreamingSource,
+        shards: Optional[Sequence[str]] = None,
+        depth: int = 2,
+        order: Optional[Sequence[int]] = None,
+    ):
+        self.source = source
+        self.shards = tuple(shards) if shards is not None else None
+        self.depth = int(depth)
+        self.order = list(order) if order is not None else None
+        self.stats = PrefetchStats()
+        if self.depth < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+
+    # -- host->device -----------------------------------------------------
+
+    def _to_device(self, blk: HostBlock) -> DeviceBlock:
+        t0 = time.perf_counter()
+        with span("stream h2d transfer", block=blk.index):
+            data: Dict[str, LabeledData] = {}
+            labels = jax.device_put(blk.labels)
+            offsets = jax.device_put(blk.offsets)
+            weights = jax.device_put(blk.weights)
+            for sid, (vals, idx) in blk.shards.items():
+                feats = EllFeatures(
+                    values=jax.device_put(vals),
+                    indices=jax.device_put(jnp.asarray(idx, dtype=jnp.int32)),
+                    num_cols=self.source.plan.shard_dims[sid],
+                )
+                data[sid] = LabeledData(
+                    features=feats, labels=labels,
+                    offsets=offsets, weights=weights,
+                )
+        self.stats.transfer_s += time.perf_counter() - t0
+        weight_sum = float(blk.weights.sum())
+        return DeviceBlock(
+            index=blk.index, start=blk.start, num_real=blk.num_real,
+            data=data, weight_sum=weight_sum,
+        )
+
+    # -- iteration --------------------------------------------------------
+
+    def __iter__(self) -> Iterator[DeviceBlock]:
+        work0 = self.source.work_seconds
+        try:
+            if self.depth == 0:
+                yield from self._iter_sync()
+            else:
+                yield from self._iter_threaded()
+        finally:
+            # decode_s is host WORK (decode+pack seconds across all decode
+            # threads), not exposed latency — differencing the source's
+            # counter keeps hide_ratio meaningful under parallel decode
+            self.stats.decode_s += self.source.work_seconds - work0
+        reg = get_registry()
+        reg.count("stream.blocks", self.stats.blocks)
+        reg.count("stream.decode_s", self.stats.decode_s)
+        reg.count("stream.stall_s", self.stats.stall_s)
+        reg.count("stream.transfer_s", self.stats.transfer_s)
+        reg.gauge("stream.prefetch_hide_ratio", self.stats.hide_ratio)
+
+    def _block_order(self):
+        if self.order is not None:
+            return list(self.order)
+        return list(range(self.source.plan.num_blocks))
+
+    def _readahead(self, order, pos) -> None:
+        """Schedule background decode of the files the next few blocks
+        need; window = decode workers + queue depth so the pool stays fed
+        without unbounded decoded-file residency."""
+        window = self.source.decode_workers + max(1, self.depth)
+        fis: list = []
+        for b in order[pos:pos + window]:
+            for fi, _, _ in self.source.plan.spans(b):
+                if fi not in fis:
+                    fis.append(fi)
+        self.source.prefetch_files(fis)
+
+    def _iter_sync(self) -> Iterator[DeviceBlock]:
+        it = self.source.iter_blocks(order=self.order, shards=self.shards)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                blk = next(it)
+            except StopIteration:
+                break
+            dt = time.perf_counter() - t0
+            # synchronous mode: decode time is fully exposed, count it as
+            # a stall so hide_ratio reads 0 honestly
+            self.stats.stall_s += dt
+            self.stats.blocks += 1
+            yield self._to_device(blk)
+
+    def _iter_threaded(self) -> Iterator[DeviceBlock]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        order = self._block_order()
+
+        def worker() -> None:
+            try:
+                for pos, b in enumerate(order):
+                    if stop.is_set():
+                        break
+                    self._readahead(order, pos)
+                    with span("read stream block", block=int(b)):
+                        blk = self.source.build_block(int(b), shards=self.shards)
+                    q.put(blk)
+                q.put(_DONE)
+            except BaseException as e:  # propagate to the consumer
+                q.put(e)
+
+        t = threading.Thread(
+            target=worker, name="stream-prefetch", daemon=True
+        )
+        t.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                if q.empty():
+                    with span("read stream wait"):
+                        item = q.get()
+                    self.stats.stall_s += time.perf_counter() - t0
+                else:
+                    item = q.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                self.stats.blocks += 1
+                yield self._to_device(item)
+        finally:
+            stop.set()
+            # drain so a blocked worker can observe the stop flag and exit
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)

@@ -281,6 +281,51 @@ register_knob(KnobSpec(
 ))
 
 register_knob(KnobSpec(
+    name="stream.block_rows",
+    kind="int",
+    default=65536,
+    applies_to="train",
+    phase="io",
+    metric_deps=(
+        "phase:io",
+        "metric:stream.stall_s",
+        "metric:stream.prefetch_hide_ratio",
+        "metric:stream.decode_s",
+        "jit:stream_vg",
+    ),
+    candidates=(4096, 16384, 65536, 262144),
+    description=(
+        "Rows per streamed example block (train_game --block-rows). Bigger "
+        "blocks amortize per-block dispatch and decode overhead and raise "
+        "the prefetch hide ratio, but cost O(block_rows x max_nnz) host "
+        "staging and device memory per buffered block; every value is one "
+        "fixed compiled shape, so retuning retraces once."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="stream.prefetch_depth",
+    kind="int",
+    default=2,
+    applies_to="train",
+    phase="io",
+    metric_deps=(
+        "metric:stream.stall_s",
+        "metric:stream.prefetch_hide_ratio",
+        "metric:stream.transfer_s",
+        "phase:io",
+    ),
+    candidates=(0, 1, 2, 4),
+    description=(
+        "Staged blocks the background decode thread may run ahead "
+        "(train_game --prefetch-depth). 0 is synchronous decode (every "
+        "decode second surfaces as a stall); deeper staging hides decode "
+        "behind solver compute until decode itself is the bottleneck, at "
+        "prefetch_depth x block bytes of host staging memory."
+    ),
+))
+
+register_knob(KnobSpec(
     name="train.engine",
     kind="str",
     default="auto",

@@ -228,3 +228,159 @@ class TestNativeReader:
             0, plan.tag_col_base,
         )
         assert not h  # null handle, process alive
+
+
+class TestChunkedDecode:
+    """Container-block-granular decode: the unit of out-of-core streaming.
+
+    ``read_columnar_file(block_start, block_count)`` must decompress only
+    the selected container blocks and produce columns bitwise-identical to
+    the matching row range of a whole-file read."""
+
+    def _write_multiblock(self, tmp_path, rng, n=400):
+        """One Avro file with MANY container blocks (tiny sync interval)."""
+        from photon_ml_tpu.io import schemas as _schemas
+        from photon_ml_tpu.io.avro import write_avro_file
+
+        recs = [
+            {
+                "uid": f"r{i}",
+                "label": float(rng.integers(0, 2)),
+                "weight": 1.0 + (i % 3),
+                "features": [
+                    {"name": "f", "term": str(j), "value": float(v)}
+                    for j, v in zip(
+                        rng.choice(30, 3, replace=False),
+                        rng.standard_normal(3),
+                    )
+                ],
+                "metadataMap": {"userId": f"u{i % 5}"},
+            }
+            for i in range(n)
+        ]
+        path = str(tmp_path / "multiblock.avro")
+        write_avro_file(
+            path, _schemas.TRAINING_EXAMPLE, recs, sync_interval=1024
+        )
+        return path, recs
+
+    def _plan(self, path):
+        from photon_ml_tpu.io.avro import AvroSchema, MAGIC, _Reader, _decode
+
+        with open(path, "rb") as f:
+            raw = f.read()
+        r = _Reader(raw)
+        assert r.read(4) == MAGIC
+        meta = _decode(r, {"type": "map", "values": "bytes"})
+        root = AvroSchema(meta["avro.schema"].decode()).root
+        plan = nr.compile_program(
+            root, ["label", "weight", "offset"], ["uid"], ["features"],
+            ["userId"],
+        )
+        assert plan is not None
+        return plan, raw
+
+    def test_container_block_counts_sum_to_rows(self, tmp_path, rng):
+        path, recs = self._write_multiblock(tmp_path, rng)
+        counts = nr.container_block_counts(path)
+        assert len(counts) > 4  # the tiny sync interval made many blocks
+        assert sum(counts) == len(recs)
+        assert all(c > 0 for c in counts)
+
+    def test_chunked_decode_bitwise_identical(self, tmp_path, rng):
+        path, _ = self._write_multiblock(tmp_path, rng)
+        plan, raw = self._plan(path)
+        counts = nr.container_block_counts(path, data=raw)
+        whole = nr.read_columnar_file(path, plan, data=raw)
+        assert whole is not None
+
+        def _bag_rows(cf, lo_row):
+            rec, val, koff, klen = cf.bags["features"]
+            return rec + lo_row, val, koff, klen
+
+        row = 0
+        for start in range(len(counts)):
+            for count in (1, 2):
+                part = nr.read_columnar_file(
+                    path, plan, data=raw,
+                    block_start=start, block_count=count,
+                )
+                assert part is not None
+                lo, hi = row, row + sum(counts[start:start + count])
+                assert part.n_rows == hi - lo
+                for name in ("label", "weight"):
+                    np.testing.assert_array_equal(
+                        part.num[name], whole.num[name][lo:hi]
+                    )
+                    np.testing.assert_array_equal(
+                        part.num_present[name],
+                        whole.num_present[name][lo:hi],
+                    )
+                # bag streams: same per-row features, values bitwise equal
+                prec, pval, pkoff, pklen = part.bags["features"]
+                wrec, wval, wkoff, wklen = whole.bags["features"]
+                sel = (wrec >= lo) & (wrec < hi)
+                np.testing.assert_array_equal(prec + lo, wrec[sel])
+                np.testing.assert_array_equal(pval, wval[sel])
+                # feature KEYS resolve identically through each arena
+                pkeys = [
+                    part.key_arena[o:o + l]
+                    for o, l in zip(pkoff, pklen)
+                ]
+                wkeys = [
+                    whole.key_arena[o:o + l]
+                    for o, l in zip(wkoff[sel], wklen[sel])
+                ]
+                assert pkeys == wkeys
+                # string columns (uid + metadataMap tag)
+                for col_of in ("strs", "tag_strs"):
+                    pcols = getattr(part, col_of)
+                    wcols = getattr(whole, col_of)
+                    for name in pcols:
+                        pa, po, pl = pcols[name]
+                        wa, wo, wl = wcols[name]
+                        got = [
+                            pa[o:o + l] for o, l in zip(po, pl)
+                        ]
+                        want = [
+                            wa[o:o + l]
+                            for o, l in zip(wo[lo:hi], wl[lo:hi])
+                        ]
+                        assert got == want
+            row += counts[start]
+
+    def test_chunked_decode_tail_and_bounds(self, tmp_path, rng):
+        path, recs = self._write_multiblock(tmp_path, rng)
+        plan, raw = self._plan(path)
+        counts = nr.container_block_counts(path, data=raw)
+        # open-ended read from mid-file covers exactly the tail
+        part = nr.read_columnar_file(path, plan, data=raw, block_start=2)
+        assert part.n_rows == sum(counts[2:])
+        # block_count past the end clamps
+        part = nr.read_columnar_file(
+            path, plan, data=raw, block_start=len(counts) - 1,
+            block_count=99,
+        )
+        assert part.n_rows == counts[-1]
+        # out-of-range start raises (not a silent empty read)
+        with pytest.raises(ValueError, match="out of range"):
+            nr.read_columnar_file(
+                path, plan, data=raw, block_start=len(counts) + 1
+            )
+
+    def test_unsupported_codec_counts_raise(self, tmp_path):
+        """container_block_counts must refuse (not mis-count) codecs the
+        framing scan cannot see through."""
+        path = str(tmp_path / "weird.avro")
+        # hand-write a container header claiming an unsupported codec
+        from photon_ml_tpu.io.avro import MAGIC, SYNC_SIZE, _encode
+
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            _encode(
+                f, {"type": "map", "values": "bytes"},
+                {"avro.schema": b'"null"', "avro.codec": b"snappy"},
+            )
+            f.write(b"\x00" * SYNC_SIZE)
+        with pytest.raises(ValueError, match="unsupported avro codec"):
+            nr.container_block_counts(path)
