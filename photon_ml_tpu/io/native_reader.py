@@ -63,7 +63,7 @@ def _load_native():
     try:
         from photon_ml_tpu.utils.nativelib import build_and_load
 
-        lib = build_and_load(_SRC, _LIB)
+        lib = build_and_load(_SRC, _LIB, ldflags=("-lz",))
         if lib is None:
             raise RuntimeError("native avro decoder unavailable")
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -74,6 +74,18 @@ def _load_native():
             u8p, _c_i64, _c_i64, i32p, _c_i32, _c_i32, _c_i32, _c_i32,
             u8p, i32p, _c_i32, _c_i32,
         ]
+        try:
+            # one GIL-released inflate+decode call per file (see .cpp); a
+            # stale .so without the symbol degrades to the per-payload path
+            lib.avro_decode_packed.restype = _c_p
+            lib.avro_decode_packed.argtypes = [
+                u8p, _c_i64, i64p, i64p, i64p, _c_i32, _c_i32,
+                i32p, _c_i32, _c_i32, _c_i32, _c_i32,
+                u8p, i32p, _c_i32, _c_i32,
+            ]
+            lib.has_packed = True
+        except AttributeError:  # pragma: no cover - stale prebuilt .so
+            lib.has_packed = False
         lib.res_n_rows.restype = _c_i64
         lib.res_n_rows.argtypes = [_c_p]
         lib.res_num_col.restype = ctypes.POINTER(ctypes.c_double)
@@ -236,15 +248,18 @@ def _np_from(ptr, n, dtype):
     return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
 
 
-def _scan_container(
+def _scan_container_offsets(
     path: str, data: Optional[bytes] = None
-) -> Optional[Tuple[List[bytes], List[int], str]]:
+) -> Optional[Tuple[bytes, List[int], List[int], List[int], str]]:
     """Parse the container framing of one Avro file into per-container-block
-    raw payloads and record counts, without decompressing anything.
+    payload POSITIONS — no payload bytes are copied and nothing is
+    decompressed (the packed native decode inflates straight out of the
+    file buffer).
 
-    Returns ``(payloads, counts, codec)`` where ``payloads[i]`` is the raw
-    (possibly deflate-compressed) bytes of container block *i* holding
-    ``counts[i]`` records, or None when the codec is unsupported."""
+    Returns ``(data, offsets, lengths, counts, codec)`` where container
+    block *i* holds ``counts[i]`` records in
+    ``data[offsets[i]:offsets[i]+lengths[i]]``, or None when the codec is
+    unsupported."""
     if data is None:
         with open(path, "rb") as f:
             data = f.read()
@@ -256,15 +271,34 @@ def _scan_container(
     if codec not in ("null", "deflate"):
         return None
     sync = r.read(SYNC_SIZE)
-    payloads: List[bytes] = []
+    offsets: List[int] = []
+    lengths: List[int] = []
     counts: List[int] = []
     while r.pos < len(r.buf):
         n = r.read_long()
         size = r.read_long()
-        payloads.append(r.read(size))
+        if size < 0 or r.pos + size > len(r.buf):
+            raise ValueError(f"{path}: container block overruns file")
+        offsets.append(r.pos)
+        lengths.append(size)
         counts.append(n)
+        r.pos += size
         if r.read(SYNC_SIZE) != sync:
             raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+    return data, offsets, lengths, counts, codec
+
+
+def _scan_container(
+    path: str, data: Optional[bytes] = None
+) -> Optional[Tuple[List[bytes], List[int], str]]:
+    """Like :func:`_scan_container_offsets` but materializes the payload
+    byte slices — ``(payloads, counts, codec)`` — for callers that feed the
+    per-payload (Python-inflate) decode path."""
+    scanned = _scan_container_offsets(path, data)
+    if scanned is None:
+        return None
+    data, offsets, lengths, counts, codec = scanned
+    payloads = [data[o:o + l] for o, l in zip(offsets, lengths)]
     return payloads, counts, codec
 
 
@@ -298,26 +332,23 @@ def read_columnar_file(
     lib = _load_native()
     if lib is None:
         return None
-    scanned = _scan_container(path, data)
+    scanned = _scan_container_offsets(path, data)
     if scanned is None:
         return None
-    payloads, counts, codec = scanned
-    if block_start < 0 or block_start > len(payloads):
+    data, offsets, lengths, counts, codec = scanned
+    n_payloads = len(offsets)
+    if block_start < 0 or block_start > n_payloads:
         raise ValueError(
             f"{path}: block_start={block_start} out of range "
-            f"[0, {len(payloads)}]"
+            f"[0, {n_payloads}]"
         )
     stop = (
-        len(payloads)
+        n_payloads
         if block_count is None
-        else min(block_start + max(block_count, 0), len(payloads))
+        else min(block_start + max(block_count, 0), n_payloads)
     )
-    payloads = payloads[block_start:stop]
-    n_records = sum(counts[block_start:stop])
-    if codec == "deflate":
-        payloads = [zlib.decompress(p, -15) for p in payloads]
-
-    blob = b"".join(payloads)
+    sel = slice(block_start, stop)
+    n_records = sum(counts[sel])
     tag_names = sorted(plan.tags, key=plan.tags.get)
     tag_bytes = b"".join(t.encode("utf-8") for t in tag_names)
     tag_lens = np.asarray(
@@ -325,20 +356,56 @@ def read_columnar_file(
     )
     u8p = ctypes.POINTER(ctypes.c_uint8)
     i32p = ctypes.POINTER(_c_i32)
-    handle = lib.avro_decode(
-        ctypes.cast(ctypes.c_char_p(blob), u8p),
-        len(blob),
-        n_records,
-        np.ascontiguousarray(plan.program).ctypes.data_as(i32p),
-        len(plan.program) // 3,
-        len(plan.num_fields),
-        plan.n_str_cols,
-        len(plan.bag_fields),
-        ctypes.cast(ctypes.c_char_p(tag_bytes), u8p),
-        tag_lens.ctypes.data_as(i32p),
-        len(tag_names),
-        plan.tag_col_base,
-    )
+    i64p = ctypes.POINTER(_c_i64)
+    prog = np.ascontiguousarray(plan.program)
+
+    handle = None
+    if getattr(lib, "has_packed", False):
+        # fast path: ONE foreign call does inflate + columnar decode for
+        # the whole selected range, so the GIL stays released for the full
+        # decode window and pool workers on other files run concurrently
+        offs_a = np.asarray(offsets[sel], dtype=np.int64)
+        lens_a = np.asarray(lengths[sel], dtype=np.int64)
+        cnts_a = np.asarray(counts[sel], dtype=np.int64)
+        handle = lib.avro_decode_packed(
+            ctypes.cast(ctypes.c_char_p(data), u8p),
+            len(data),
+            offs_a.ctypes.data_as(i64p),
+            lens_a.ctypes.data_as(i64p),
+            cnts_a.ctypes.data_as(i64p),
+            stop - block_start,
+            1 if codec == "deflate" else 0,
+            prog.ctypes.data_as(i32p),
+            len(plan.program) // 3,
+            len(plan.num_fields),
+            plan.n_str_cols,
+            len(plan.bag_fields),
+            ctypes.cast(ctypes.c_char_p(tag_bytes), u8p),
+            tag_lens.ctypes.data_as(i32p),
+            len(tag_names),
+            plan.tag_col_base,
+        )
+    if not handle:
+        # per-payload path: Python-side inflate + join, then one decode call
+        payloads = [data[o:o + l] for o, l in
+                    zip(offsets[sel], lengths[sel])]
+        if codec == "deflate":
+            payloads = [zlib.decompress(p, -15) for p in payloads]
+        blob = b"".join(payloads)
+        handle = lib.avro_decode(
+            ctypes.cast(ctypes.c_char_p(blob), u8p),
+            len(blob),
+            n_records,
+            prog.ctypes.data_as(i32p),
+            len(plan.program) // 3,
+            len(plan.num_fields),
+            plan.n_str_cols,
+            len(plan.bag_fields),
+            ctypes.cast(ctypes.c_char_p(tag_bytes), u8p),
+            tag_lens.ctypes.data_as(i32p),
+            len(tag_names),
+            plan.tag_col_base,
+        )
     if not handle:
         logger.warning("%s: native decode failed; python fallback", path)
         return None

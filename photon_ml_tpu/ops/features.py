@@ -107,33 +107,22 @@ class EllFeatures:
 FeatureMatrix = Union[DenseFeatures, EllFeatures]
 
 
-def pack_ell_host(rows, cols, vals, shape, max_nnz: int | None = None):
-    """Host-side ELL packing from COO triplets: returns numpy
-    ``(values [n, k], indices [n, k])`` without touching the device.
-
-    This is the staging half of :func:`from_scipy_like` — the streaming
-    prefetcher packs blocks in a background thread and defers the
-    ``device_put`` to the consumer, so packing must not allocate device
-    buffers. Semantics are identical: duplicates coalesced by summation,
-    ``ValueError`` when a row exceeds ``max_nnz``.
-    """
+def _coalesce_coo(rows, cols, vals, n, d):
+    """Validate + duplicate-coalesce COO triplets; returns the (possibly
+    re-sorted) triplets and the per-row counts. Decoder output is already
+    (row, col)-sorted and duplicate-free, so both the lexsort and the
+    (slow) np.add.at are skipped on that fast path — this is the streaming
+    prefetcher's per-block hot loop."""
     import numpy as np
 
-    n, d = shape
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     vals = np.asarray(vals, dtype=np.float32)
     if rows.size:
         if rows.min() < 0 or rows.max() >= n:
             raise ValueError(f"row index out of range [0, {n})")
-        if cols.min() < 0 or cols.max() >= d:
+        if d is not None and (cols.min() < 0 or cols.max() >= d):
             raise ValueError(f"column index out of range [0, {d})")
-
-    # coalesce duplicates: sort by (row, col), segment-sum runs. Decoder
-    # output is already (row, col)-sorted and duplicate-free, so both the
-    # lexsort and the (slow) np.add.at are skipped on that fast path —
-    # this is the streaming prefetcher's per-block hot loop.
-    if rows.size:
         in_order = bool(
             np.all(
                 (rows[1:] > rows[:-1])
@@ -153,8 +142,39 @@ def pack_ell_host(rows, cols, vals, shape, max_nnz: int | None = None):
             np.add.at(summed, seg_ids, vals)
             rows, cols = rows[boundary], cols[boundary]
             vals = summed.astype(np.float32)
-
     counts = np.bincount(rows, minlength=n)
+    return rows, cols, vals, counts
+
+
+def _scatter_ell(rows, cols, vals, counts, values, indices) -> None:
+    """Scatter coalesced, (row, col)-sorted triplets into ELL arrays."""
+    import numpy as np
+
+    if not rows.size:
+        return
+    n = values.shape[0]
+    # slot index within each row: position minus that row's start offset
+    starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    slots = np.arange(rows.size, dtype=np.int64) - starts[rows]
+    values[rows, slots] = vals
+    indices[rows, slots] = cols
+
+
+def pack_ell_host(rows, cols, vals, shape, max_nnz: int | None = None):
+    """Host-side ELL packing from COO triplets: returns numpy
+    ``(values [n, k], indices [n, k])`` without touching the device.
+
+    This is the staging half of :func:`from_scipy_like` — the streaming
+    prefetcher packs blocks in a background thread and defers the
+    ``device_put`` to the consumer, so packing must not allocate device
+    buffers. Semantics are identical: duplicates coalesced by summation,
+    ``ValueError`` when a row exceeds ``max_nnz``.
+    """
+    import numpy as np
+
+    n, d = shape
+    rows, cols, vals, counts = _coalesce_coo(rows, cols, vals, n, d)
     needed = int(counts.max()) if rows.size else 1
     k = max(int(max_nnz) if max_nnz is not None else needed, 1)
     if needed > k:
@@ -164,14 +184,32 @@ def pack_ell_host(rows, cols, vals, shape, max_nnz: int | None = None):
         )
     values = np.zeros((n, k), dtype=np.float32)
     indices = np.zeros((n, k), dtype=np.int32)
-    if rows.size:
-        # slot index within each row: position minus that row's start offset
-        starts = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(counts, out=starts[1:])
-        slots = np.arange(rows.size, dtype=np.int64) - starts[rows]
-        values[rows, slots] = vals
-        indices[rows, slots] = cols
+    _scatter_ell(rows, cols, vals, counts, values, indices)
     return values, indices
+
+
+def pack_ell_into(
+    rows, cols, vals, values_out, indices_out, num_cols: int | None = None
+) -> None:
+    """In-place :func:`pack_ell_host`: scatter COO triplets directly into
+    caller-owned, zero-initialized ``[n, k]`` staging arrays.
+
+    The streaming block assembler packs each file piece of a block into
+    the block's staging buffers as it arrives — pieces are row-disjoint,
+    so piecewise packing is exactly equivalent to packing the whole block
+    at once, and the intermediate per-file COO concatenation (one full
+    copy of every triplet per block) disappears. Rows previously written
+    by another call must not be revisited.
+    """
+    n, k = values_out.shape
+    rows, cols, vals, counts = _coalesce_coo(rows, cols, vals, n, num_cols)
+    needed = int(counts.max()) if rows.size else 0
+    if needed > k:
+        raise ValueError(
+            f"row with {needed} nonzeros exceeds max_nnz={k}; raise max_nnz or "
+            "pre-select features"
+        )
+    _scatter_ell(rows, cols, vals, counts, values_out, indices_out)
 
 
 def from_scipy_like(rows, cols, vals, shape, max_nnz: int | None = None) -> EllFeatures:

@@ -3,11 +3,17 @@ example blocks through a double-buffered host→device prefetcher into
 block-sharded solvers. See docs/SCALING.md ("Streaming out-of-core").
 """
 
+from photon_ml_tpu.streaming.blockcache import (
+    BlockCache,
+    CacheStats,
+    plan_fingerprint,
+)
 from photon_ml_tpu.streaming.blocks import (
     BlockPlan,
     HostBlock,
     RowPlanes,
     StreamingSource,
+    auto_decode_workers,
 )
 from photon_ml_tpu.streaming.coordinate import StreamingFixedEffectCoordinate
 from photon_ml_tpu.streaming.prefetch import (
@@ -25,6 +31,10 @@ from photon_ml_tpu.streaming.solver import (
 )
 
 __all__ = [
+    "BlockCache",
+    "CacheStats",
+    "plan_fingerprint",
+    "auto_decode_workers",
     "BlockPlan",
     "HostBlock",
     "RowPlanes",

@@ -51,17 +51,28 @@ class DeviceBlock:
 
 @dataclasses.dataclass
 class PrefetchStats:
-    """Wall-clock accounting of one streamed pass."""
+    """Wall-clock accounting of one streamed pass.
+
+    ``decode_s`` is WALL time with at least one decode in flight;
+    ``decode_work_s`` is the per-thread SUM — with N parallel workers the
+    sum can be ~N× the wall, which is why the hide ratio is defined over
+    wall (the PR 10 ratio divided stall by summed work and was distorted
+    whenever the pool overlapped)."""
 
     blocks: int = 0
-    decode_s: float = 0.0    # host decode+pack WORK across all threads
-    stall_s: float = 0.0     # consumer time blocked waiting for a block
-    transfer_s: float = 0.0  # device_put dispatch time
+    decode_s: float = 0.0        # decode wall clock (>=1 decode in flight)
+    decode_work_s: float = 0.0   # summed per-thread decode+pack seconds
+    stall_s: float = 0.0         # consumer time blocked waiting for a block
+    transfer_s: float = 0.0      # device_put dispatch time (all uploads)
+    upload_hidden_s: float = 0.0  # uploads dispatched while solve in flight
+    cache_hit_blocks: int = 0    # blocks served from the block cache
+    cache_load_s: float = 0.0    # wall seconds mapping+validating entries
 
     @property
     def hide_ratio(self) -> float:
-        """Fraction of decode wall clock hidden behind compute: decode time
-        that did NOT surface as a consumer stall."""
+        """WALL-based: fraction of decode wall clock that did NOT surface
+        as a consumer stall. A fully cached pass has decode_s == 0 — all
+        data movement hidden — and reads 1.0."""
         if self.decode_s <= 0:
             return 1.0
         return max(0.0, (self.decode_s - self.stall_s) / self.decode_s)
@@ -109,7 +120,14 @@ class BlockPrefetcher:
                     features=feats, labels=labels,
                     offsets=offsets, weights=weights,
                 )
-        self.stats.transfer_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats.transfer_s += dt
+        if self.stats.blocks > 1:
+            # device_put is async-dispatched and acc_vg returns futures, so
+            # every upload after the pass's first is issued while the
+            # PREVIOUS block's solve is still in flight — that's the
+            # H2D/compute overlap through the donated accumulator seam
+            self.stats.upload_hidden_s += dt
         weight_sum = float(blk.weights.sum())
         return DeviceBlock(
             index=blk.index, start=blk.start, num_real=blk.num_real,
@@ -120,21 +138,32 @@ class BlockPrefetcher:
 
     def __iter__(self) -> Iterator[DeviceBlock]:
         work0 = self.source.work_seconds
+        wall0 = self.source.decode_wall_seconds
+        cache = self.source.cache
+        hits0 = cache.stats.hits if cache is not None else 0
+        load0 = cache.stats.load_s if cache is not None else 0.0
         try:
             if self.depth == 0:
                 yield from self._iter_sync()
             else:
                 yield from self._iter_threaded()
         finally:
-            # decode_s is host WORK (decode+pack seconds across all decode
-            # threads), not exposed latency — differencing the source's
-            # counter keeps hide_ratio meaningful under parallel decode
-            self.stats.decode_s += self.source.work_seconds - work0
+            # differencing the source's counters attributes exactly this
+            # pass's decode, whichever thread ran it
+            self.stats.decode_s += self.source.decode_wall_seconds - wall0
+            self.stats.decode_work_s += self.source.work_seconds - work0
+            if cache is not None:
+                self.stats.cache_hit_blocks += cache.stats.hits - hits0
+                self.stats.cache_load_s += cache.stats.load_s - load0
         reg = get_registry()
         reg.count("stream.blocks", self.stats.blocks)
         reg.count("stream.decode_s", self.stats.decode_s)
+        reg.count("stream.decode_work_s", self.stats.decode_work_s)
         reg.count("stream.stall_s", self.stats.stall_s)
         reg.count("stream.transfer_s", self.stats.transfer_s)
+        reg.count("stream.upload_hidden_s", self.stats.upload_hidden_s)
+        reg.count("stream.cache_hit_blocks", self.stats.cache_hit_blocks)
+        reg.count("stream.cache_load_s", self.stats.cache_load_s)
         reg.gauge("stream.prefetch_hide_ratio", self.stats.hide_ratio)
 
     def _block_order(self):
@@ -145,14 +174,10 @@ class BlockPrefetcher:
     def _readahead(self, order, pos) -> None:
         """Schedule background decode of the files the next few blocks
         need; window = decode workers + queue depth so the pool stays fed
-        without unbounded decoded-file residency."""
+        without unbounded decoded-file residency. Cache-aware: blocks the
+        block cache already holds schedule nothing."""
         window = self.source.decode_workers + max(1, self.depth)
-        fis: list = []
-        for b in order[pos:pos + window]:
-            for fi, _, _ in self.source.plan.spans(b):
-                if fi not in fis:
-                    fis.append(fi)
-        self.source.prefetch_files(fis)
+        self.source.prefetch_blocks(order[pos:pos + window], shards=self.shards)
 
     def _iter_sync(self) -> Iterator[DeviceBlock]:
         it = self.source.iter_blocks(order=self.order, shards=self.shards)

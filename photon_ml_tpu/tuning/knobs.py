@@ -32,7 +32,7 @@ class KnobSpec:
     """
 
     name: str
-    kind: str  # "int" | "float" | "str" | "csv_ints"
+    kind: str  # "int" | "float" | "str" | "bool" | "csv_ints"
     default: Any
     applies_to: str  # "train" | "serve" | "both"
     phase: str  # RunReport phase bucket this knob chiefly moves
@@ -43,6 +43,10 @@ class KnobSpec:
     def parse(self, value: Any) -> Any:
         if self.kind == "int":
             return int(value)
+        if self.kind == "bool":
+            if isinstance(value, str):
+                return value.strip().lower() in ("1", "true", "yes", "on")
+            return bool(value)
         if self.kind == "float":
             return float(value)
         if self.kind == "csv_ints":
@@ -322,6 +326,54 @@ register_knob(KnobSpec(
         "decode second surfaces as a stall); deeper staging hides decode "
         "behind solver compute until decode itself is the bottleneck, at "
         "prefetch_depth x block bytes of host staging memory."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="stream.decode_workers",
+    kind="int",
+    default=-1,
+    applies_to="train",
+    phase="io",
+    metric_deps=(
+        "metric:stream.stall_s",
+        "metric:stream.decode_s",
+        "metric:stream.decode_work_s",
+        "metric:stream.prefetch_hide_ratio",
+        "phase:io",
+    ),
+    candidates=(-1, 0, 1, 2, 4, 8),
+    description=(
+        "Decode pool threads (train_game --decode-workers). -1 = auto "
+        "(cpu_count-1 capped at 16; 0 on a single-core host). Each worker "
+        "decodes one part file per GIL-released native call, so workers "
+        "genuinely overlap; more workers shorten decode wall-clock "
+        "(stream.decode_s) while stream.decode_work_s stays constant — "
+        "their ratio is the pool's achieved parallelism."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="stream.block_cache",
+    kind="bool",
+    default=True,
+    applies_to="train",
+    phase="io",
+    metric_deps=(
+        "metric:stream.stall_s",
+        "metric:stream.decode_s",
+        "metric:stream.cache_hit_blocks",
+        "metric:stream.prefetch_hide_ratio",
+        "phase:io",
+    ),
+    candidates=(False, True),
+    description=(
+        "Spill decoded blocks to the mmap-backed on-disk cache "
+        "(train_game --block-cache-dir / --no-block-cache). Epoch 1 pays "
+        "decode once and writes entries; every later block visit reloads "
+        "zero-copy at page-cache speed with zero Avro work, so "
+        "stream.decode_s collapses on warm epochs. Costs one padded-block "
+        "footprint of disk per (block, shard-subset)."
     ),
 ))
 

@@ -24,9 +24,16 @@ _DEFAULT_FLAGS = ("-O3", "-std=c++17", "-shared", "-fPIC", "-pthread")
 
 
 def build_and_load(
-    src: Path, lib_path: Path, flags: Sequence[str] = _DEFAULT_FLAGS
+    src: Path,
+    lib_path: Path,
+    flags: Sequence[str] = _DEFAULT_FLAGS,
+    ldflags: Sequence[str] = (),
 ) -> Optional[ctypes.CDLL]:
     """Compile ``src`` to ``lib_path`` (if missing/stale) and CDLL it.
+
+    ``ldflags`` (e.g. ``("-lz",)``) are placed AFTER the source on the
+    command line — with ``--as-needed`` linkers a library named before the
+    objects that use it is silently dropped.
 
     Returns None when the toolchain is unavailable or the build fails —
     callers keep a pure-Python fallback. Never leaves a half-written .so
@@ -41,7 +48,7 @@ def build_and_load(
             os.close(fd)
             try:
                 subprocess.run(
-                    ["g++", *flags, "-o", tmp, str(src)],
+                    ["g++", *flags, "-o", tmp, str(src), *ldflags],
                     check=True,
                     capture_output=True,
                 )

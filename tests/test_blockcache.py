@@ -1,0 +1,270 @@
+"""Decoded block cache: robustness, invalidation, and the warm-epoch
+zero-decode contract.
+
+The cache is a correctness-critical fast path — a wrong cache silently
+trains a wrong model — so the gate here is bitwise equality between a
+cached reload and a fresh decode, plus fallback-to-decode on every way an
+entry can be bad (truncated, corrupted, stale fingerprint), plus exactly
+one valid entry surviving concurrent writers.
+"""
+
+import glob
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.data_reader import (
+    FeatureShardConfiguration,
+    build_index_maps,
+    write_training_examples,
+)
+from photon_ml_tpu.streaming import BlockCache, StreamingSource, plan_fingerprint
+
+FILE_ROWS = (96, 80)
+N_ROWS = sum(FILE_ROWS)
+D = 6
+BLOCK_ROWS = 48  # 176 rows -> 4 blocks, final one ragged (32 real rows)
+
+SHARDS = {
+    "global": FeatureShardConfiguration(
+        feature_bags=("features",), add_intercept=True
+    ),
+}
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    root = tmp_path_factory.mktemp("blkcache")
+    X = rng.normal(size=(N_ROWS, D)).astype(np.float32)
+    y = (rng.random(N_ROWS) > 0.5).astype(np.float32)
+    paths = []
+    row = 0
+    for fi, n in enumerate(FILE_ROWS):
+        recs = []
+        for i in range(row, row + n):
+            recs.append({
+                "uid": f"r{i}",
+                "label": float(y[i]),
+                "weight": 1.0,
+                "features": [("g", str(j), float(X[i, j])) for j in range(D)],
+                "metadataMap": {"userId": f"u{i % 5}"},
+            })
+        p = str(root / f"part-{fi:05d}.avro")
+        write_training_examples(p, recs)
+        paths.append(p)
+        row += n
+    index_maps = build_index_maps(paths, SHARDS)
+    return {"paths": paths, "index_maps": index_maps}
+
+
+def _open_source(dataset, cache_dir=None):
+    return StreamingSource.open(
+        dataset["paths"], SHARDS, index_maps=dataset["index_maps"],
+        block_rows=BLOCK_ROWS, id_tags=("userId",), cache_dir=cache_dir,
+    )
+
+
+def _assert_blocks_equal(a, b):
+    assert a.index == b.index
+    assert a.start == b.start
+    assert a.num_real == b.num_real
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
+    np.testing.assert_array_equal(np.asarray(a.offsets), np.asarray(b.offsets))
+    np.testing.assert_array_equal(np.asarray(a.weights), np.asarray(b.weights))
+    assert set(a.shards) == set(b.shards)
+    for sid in a.shards:
+        np.testing.assert_array_equal(
+            np.asarray(a.shards[sid][0]), np.asarray(b.shards[sid][0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.shards[sid][1]), np.asarray(b.shards[sid][1])
+        )
+        assert np.asarray(a.shards[sid][0]).dtype == np.asarray(b.shards[sid][0]).dtype
+        assert np.asarray(a.shards[sid][1]).dtype == np.asarray(b.shards[sid][1]).dtype
+    assert set(a.id_tags) == set(b.id_tags)
+    for t in a.id_tags:
+        assert list(a.id_tags[t]) == list(b.id_tags[t])
+
+
+def _entry_files(cache):
+    return sorted(glob.glob(os.path.join(cache.dir, "block-*.blk")))
+
+
+class TestCachedBitwiseEquality:
+    def test_cached_block_bitwise_equal_to_decoded(self, dataset, tmp_path):
+        src_plain = _open_source(dataset)
+        src_cached = _open_source(dataset, cache_dir=str(tmp_path / "c"))
+        for i in range(src_plain.plan.num_blocks):
+            decoded = src_plain.build_block(i)
+            first = src_cached.build_block(i)   # decode + spill
+            cached = src_cached.build_block(i)  # cache hit (memmap views)
+            _assert_blocks_equal(decoded, first)
+            _assert_blocks_equal(decoded, cached)
+        assert src_cached.cache.stats.hits == src_plain.plan.num_blocks
+        assert src_cached.cache.stats.writes == src_plain.plan.num_blocks
+
+    def test_shard_subset_keyed_separately(self, dataset, tmp_path):
+        # two shard configs over the same bag: a subset build must not
+        # collide with the full build in the cache
+        shards2 = dict(SHARDS)
+        shards2["alt"] = FeatureShardConfiguration(
+            feature_bags=("features",), add_intercept=False
+        )
+        src = StreamingSource.open(
+            dataset["paths"], shards2,
+            index_maps=build_index_maps(dataset["paths"], shards2),
+            block_rows=BLOCK_ROWS, id_tags=("userId",),
+            cache_dir=str(tmp_path / "c"),
+        )
+        full = src.build_block(0)
+        sub = src.build_block(0, shards=("global",))
+        assert set(full.shards) == {"global", "alt"}
+        assert set(sub.shards) == {"global"}
+        np.testing.assert_array_equal(
+            np.asarray(full.shards["global"][0]),
+            np.asarray(sub.shards["global"][0]),
+        )
+        assert len(_entry_files(src.cache)) == 2
+        # each keyed entry hits independently
+        assert src.build_block(0).shards.keys() == full.shards.keys()
+        assert src.build_block(0, shards=("global",)).shards.keys() == {"global"}
+        assert src.cache.stats.hits == 2
+
+
+class TestRobustness:
+    def test_truncated_entry_falls_back_and_rewrites(self, dataset, tmp_path):
+        src = _open_source(dataset, cache_dir=str(tmp_path / "c"))
+        good = src.build_block(1)
+        path = src.cache.entry_path(1, tuple(SHARDS))
+        with open(path, "rb") as f:
+            blob = f.read()
+        with open(path, "wb") as f:
+            f.write(blob[: len(blob) // 2])
+        src.cache._validated.discard(path)  # fresh process would re-validate
+        work0 = src.work_seconds
+        blk = src.build_block(1)  # must fall back to decode
+        assert src.work_seconds > work0
+        assert src.cache.stats.invalid == 1
+        _assert_blocks_equal(good, blk)
+        # the fallback rewrote a valid entry: next visit hits with no work
+        hits0 = src.cache.stats.hits
+        work1 = src.work_seconds
+        again = src.build_block(1)
+        assert src.cache.stats.hits == hits0 + 1
+        assert src.work_seconds == work1
+        _assert_blocks_equal(good, again)
+
+    def test_corrupted_payload_fails_checksum(self, dataset, tmp_path):
+        src = _open_source(dataset, cache_dir=str(tmp_path / "c"))
+        good = src.build_block(0)
+        path = src.cache.entry_path(0, tuple(SHARDS))
+        with open(path, "r+b") as f:
+            f.seek(-4, os.SEEK_END)  # flip bytes inside the last array
+            f.write(b"\xde\xad\xbe\xef")
+        src.cache._validated.discard(path)
+        blk = src.build_block(0)
+        assert src.cache.stats.invalid == 1
+        _assert_blocks_equal(good, blk)
+
+    def test_garbage_file_is_a_miss(self, dataset, tmp_path):
+        src = _open_source(dataset, cache_dir=str(tmp_path / "c"))
+        os.makedirs(src.cache.dir, exist_ok=True)
+        path = src.cache.entry_path(2, tuple(SHARDS))
+        with open(path, "wb") as f:
+            f.write(b"not a block cache entry at all")
+        blk = src.build_block(2)
+        assert blk.num_real == BLOCK_ROWS
+        assert src.cache.stats.invalid == 1
+
+    def test_stale_fingerprint_invalidates(self, dataset, tmp_path):
+        cache_dir = str(tmp_path / "c")
+        src = _open_source(dataset, cache_dir=cache_dir)
+        src.build_block(0)
+        old_dir = src.cache.dir
+        assert _entry_files(src.cache)
+        # touching a part file changes mtime_ns -> new fingerprint, even
+        # with identical bytes (a rewritten input must never hit stale)
+        st = os.stat(dataset["paths"][0])
+        os.utime(dataset["paths"][0], ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+        fp2 = plan_fingerprint(
+            BLOCK_ROWS, src.plan.files, src.plan.shard_widths,
+            src.plan.shard_dims, id_tags=src.id_tags,
+        )
+        assert fp2 != src.cache.fingerprint
+        src2 = _open_source(dataset, cache_dir=cache_dir)
+        assert src2.cache.dir != old_dir
+        # attach_cache swept the stale sibling directory
+        assert not os.path.isdir(old_dir)
+        work0 = src2.work_seconds
+        src2.build_block(0)
+        assert src2.work_seconds > work0  # re-decoded, no stale hit
+
+    def test_concurrent_writers_one_valid_entry(self, dataset, tmp_path):
+        src = _open_source(dataset)
+        fp = plan_fingerprint(
+            BLOCK_ROWS, src.plan.files, src.plan.shard_widths,
+            src.plan.shard_dims, id_tags=src.id_tags,
+        )
+        block = src.build_block(3)
+        caches = [BlockCache(str(tmp_path / "c"), fp) for _ in range(4)]
+        barrier = threading.Barrier(4)
+
+        def writer(c):
+            barrier.wait()
+            assert c.store(block, tuple(SHARDS))
+
+        threads = [threading.Thread(target=writer, args=(c,)) for c in caches]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # last rename wins: exactly one entry file, no leftover temp files
+        entries = _entry_files(caches[0])
+        assert len(entries) == 1
+        assert not glob.glob(os.path.join(caches[0].dir, ".tmp-*"))
+        reader = BlockCache(str(tmp_path / "c"), fp)
+        loaded = reader.load(3, tuple(SHARDS))
+        assert loaded is not None
+        _assert_blocks_equal(block, loaded)
+
+
+class TestWarmEpochZeroWork:
+    def test_warm_iteration_does_zero_decode_work(self, dataset, tmp_path):
+        """The headline contract: iterating a fully cached plan costs zero
+        Avro decode/pack seconds and schedules nothing on the decode pool."""
+        src = _open_source(dataset, cache_dir=str(tmp_path / "c"))
+        for _ in src.iter_blocks():  # cold epoch: decode + spill
+            pass
+        assert src.work_seconds > 0
+        work0 = src.work_seconds
+        wall0 = src.decode_wall_seconds
+        decoded0 = src.files_decoded
+        blocks = list(src.iter_blocks())  # warm epoch
+        assert len(blocks) == src.plan.num_blocks
+        assert src.work_seconds - work0 == 0.0
+        assert src.decode_wall_seconds - wall0 == 0.0
+        assert src.files_decoded == decoded0
+        assert src.cache.stats.hits == src.plan.num_blocks
+
+    def test_warm_prefetch_blocks_schedules_nothing(self, dataset, tmp_path):
+        src = _open_source(dataset, cache_dir=str(tmp_path / "c"))
+        for _ in src.iter_blocks():
+            pass
+        src.prefetch_blocks(range(src.plan.num_blocks))
+        assert not src._pending  # cache consulted before the decode pool
+
+    def test_warm_prefetcher_hide_ratio_is_one(self, dataset, tmp_path):
+        from photon_ml_tpu.streaming import BlockPrefetcher
+
+        src = _open_source(dataset, cache_dir=str(tmp_path / "c"))
+        cold = BlockPrefetcher(src, depth=1)
+        assert sum(1 for _ in cold) == src.plan.num_blocks
+        warm = BlockPrefetcher(src, depth=1)
+        assert sum(1 for _ in warm) == src.plan.num_blocks
+        assert warm.stats.decode_s == 0.0
+        assert warm.stats.decode_work_s == 0.0
+        assert warm.stats.cache_hit_blocks == src.plan.num_blocks
+        assert warm.stats.hide_ratio == 1.0

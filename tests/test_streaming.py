@@ -541,8 +541,12 @@ class TestGoldenFixtureStreaming:
     def test_streamed_parity_and_zero_retraces(self, tmp_path):
         fit_mem = self._run(tmp_path, "mem", [])
         reset_stream_trace_counts()
+        # explicit cache dir: the default would land next to the committed
+        # fixture files; run 2 over identical inputs must hit it warm
+        cache = ["--block-cache-dir", str(tmp_path / "blkcache")]
         fit_st = self._run(tmp_path, "st", [
             "--streaming", "--block-rows", "512", "--prefetch-depth", "2",
+            *cache,
         ])
         traces1 = dict(stream_trace_counts())
         assert abs(fit_mem.validation_metric - fit_st.validation_metric) < 1e-3, (
@@ -550,9 +554,11 @@ class TestGoldenFixtureStreaming:
         )
         # every streamed program compiled exactly once over all blocks
         assert traces1 and all(v == 1 for v in traces1.values()), traces1
-        # a second streamed run over the same shapes compiles nothing new
+        # a second streamed run over the same shapes compiles nothing new,
+        # and a cache-warm run lands on the identical metric
         fit_st2 = self._run(tmp_path, "st2", [
             "--streaming", "--block-rows", "512", "--prefetch-depth", "2",
+            *cache,
         ])
         assert dict(stream_trace_counts()) == traces1
         assert fit_st2.validation_metric == pytest.approx(
