@@ -729,6 +729,7 @@ SRV_SHARDS = 4                              # device shards per RE table
 # CLI and the unit tests, not the throughput bench)
 SRV_SCORERS = 1
 SRV_BUDGET = 256 if _SMOKE else 16_384      # device-resident rows per coord
+SRV_CACHE = 256 if _SMOKE else 4096         # scorer entity-cache capacity
 SRV_ADMIT = 64                              # rows per async admission step
 SRV_ADMIT_INTERVAL_S = 0.02                 # admission cadence (see below)
 SRV_BUCKETS = (1, 4, 16, 64, 256, 512)

@@ -211,8 +211,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "identical inputs) reload blocks zero-copy via mmap "
                         "with zero decode work. Entries are keyed by a "
                         "fingerprint of the input files (path, size, "
-                        "mtime_ns), block-rows and shard geometry, so any "
-                        "input or config change invalidates automatically")
+                        "mtime_ns), block-rows, shard geometry and the "
+                        "feature index maps (incl. --offheap-indexmap-dir "
+                        "contents), so any input, index-map or config "
+                        "change invalidates automatically")
     p.add_argument("--no-block-cache", action="store_true",
                    help="streaming: disable the decoded block cache and "
                         "re-decode Avro every epoch")

@@ -13,6 +13,7 @@ convention (Constants.scala): a feature is identified by a single string key.
 from __future__ import annotations
 
 import abc
+import hashlib
 from itertools import repeat
 from typing import Dict, Iterable, List, Optional, Sequence
 
@@ -52,6 +53,19 @@ class IndexMap(abc.ABC):
     def __contains__(self, name: str) -> bool:
         return self.get_index(name) >= 0
 
+    def content_digest(self) -> str:
+        """Hex digest committing to the full name->index assignment.
+
+        Decoded feature columns are a function of this mapping, so anything
+        caching decoded data (the streaming block cache) must include it in
+        its fingerprint — two same-size maps with permuted assignments
+        otherwise collide. The generic implementation walks the dense index
+        space; subclasses override with cheaper equivalents."""
+        h = hashlib.sha256()
+        for i in range(len(self)):
+            h.update(f"{self.get_feature_name(i)}\x00{i}\x01".encode("utf-8"))
+        return h.hexdigest()
+
 
 class DefaultIndexMap(IndexMap):
     """In-heap dict map (reference DefaultIndexMap.scala:27)."""
@@ -90,6 +104,13 @@ class DefaultIndexMap(IndexMap):
 
     def __len__(self) -> int:
         return len(self._forward)
+
+    def content_digest(self) -> str:
+        # index order, matching the base implementation byte-for-byte
+        h = hashlib.sha256()
+        for name, idx in sorted(self._forward.items(), key=lambda kv: kv[1]):
+            h.update(f"{name}\x00{idx}\x01".encode("utf-8"))
+        return h.hexdigest()
 
     def items(self):
         return self._forward.items()

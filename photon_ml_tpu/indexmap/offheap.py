@@ -19,6 +19,7 @@ owning partition.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import json
 import mmap
 import os
@@ -368,6 +369,7 @@ class OffHeapIndexMap(IndexMap):
         meta = json.loads((pathlib.Path(directory) / METADATA_FILE).read_text())
         if meta.get("format") != "PHIX":
             raise ValueError(f"{directory} is not a PHIX index map directory")
+        self._dir = str(directory)
         self._num_partitions = int(meta["num_partitions"])
         self._num_entries = int(meta["num_entries"])
         self._offsets = np.asarray(meta["partition_offsets"], dtype=np.int64)
@@ -413,6 +415,21 @@ class OffHeapIndexMap(IndexMap):
 
     def __len__(self) -> int:
         return self._num_entries
+
+    def content_digest(self) -> str:
+        """Digest of the store directory's file identities — (name, size,
+        mtime_ns) of metadata + every partition — instead of the base
+        class's O(entries) reverse scan. PHIX stores are immutable once
+        built, so file identity IS content identity; a rebuilt store (even
+        with identical entries) digests differently, which can only cause
+        a spurious cache miss, never a stale hit."""
+        h = hashlib.sha256()
+        for name in sorted(os.listdir(self._dir)):
+            st = os.stat(os.path.join(self._dir, name))
+            h.update(
+                f"{name}\x00{st.st_size}\x00{st.st_mtime_ns}\x01".encode("utf-8")
+            )
+        return h.hexdigest()
 
     def close(self) -> None:
         for p in self._parts:

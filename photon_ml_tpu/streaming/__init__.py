@@ -14,6 +14,7 @@ from photon_ml_tpu.streaming.blocks import (
     RowPlanes,
     StreamingSource,
     auto_decode_workers,
+    readahead_file_budget,
 )
 from photon_ml_tpu.streaming.coordinate import StreamingFixedEffectCoordinate
 from photon_ml_tpu.streaming.prefetch import (
@@ -35,6 +36,7 @@ __all__ = [
     "CacheStats",
     "plan_fingerprint",
     "auto_decode_workers",
+    "readahead_file_budget",
     "BlockPlan",
     "HostBlock",
     "RowPlanes",

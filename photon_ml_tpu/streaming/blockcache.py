@@ -28,9 +28,12 @@ hierarchy:
 
 The plan fingerprint commits to the cache version, ``block_rows``, the
 ordered part-file list with each file's size and mtime_ns, the feature
-shard layout (ELL widths and dims), the id tags and the reader column
-options — so editing an input file, re-sharding features or changing the
-block size all invalidate cleanly (see docs/SCALING.md).
+shard layout (ELL widths and dims), a content digest of each shard's
+feature index map (decoded column ids depend on the name->index
+assignment, so an externally loaded map with a different same-size
+assignment must miss), the id tags and the reader column options — so
+editing an input file, swapping the index maps, re-sharding features or
+changing the block size all invalidate cleanly (see docs/SCALING.md).
 """
 
 from __future__ import annotations
@@ -54,6 +57,18 @@ CACHE_VERSION = 1
 _ALIGN = 64
 
 
+def _index_map_digest(im) -> str:
+    """Digest of one shard's feature name->index assignment."""
+    fn = getattr(im, "content_digest", None)
+    if callable(fn):
+        return str(fn())
+    # foreign map object: walk the dense index space (IndexMap contract)
+    h = hashlib.sha256()
+    for i in range(len(im)):
+        h.update(f"{im.get_feature_name(i)}\x00{i}\x01".encode("utf-8"))
+    return h.hexdigest()
+
+
 def plan_fingerprint(
     block_rows: int,
     files: Sequence[str],
@@ -61,12 +76,19 @@ def plan_fingerprint(
     shard_dims: Dict[str, int],
     id_tags: Sequence[str] = (),
     read_kwargs: Optional[dict] = None,
+    index_maps: Optional[Dict[str, object]] = None,
 ) -> str:
     """Digest of everything the bytes of a decoded block depend on.
 
     File identity is (path, size, mtime_ns): touching or rewriting any
     part file changes the fingerprint and orphans the old entries (they
     are swept lazily by :meth:`BlockCache.sweep_stale`).
+
+    ``index_maps`` (shard -> IndexMap) MUST be passed whenever the maps
+    are loaded externally (--offheap-indexmap-dir): decoded column ids
+    are a function of the name->index assignment, and two same-size maps
+    with permuted assignments would otherwise produce identical
+    fingerprints and silently serve blocks with wrong column indices.
     """
     stats = []
     for path in files:
@@ -82,6 +104,10 @@ def plan_fingerprint(
         "read_kwargs": sorted(
             (str(k), str(v)) for k, v in (read_kwargs or {}).items()
         ),
+        "index_maps": {
+            str(sid): _index_map_digest(im)
+            for sid, im in sorted((index_maps or {}).items())
+        },
     }
     blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()
@@ -213,7 +239,9 @@ class BlockCache:
                 self.stats.writes += 1
                 self._validated.add(path)  # we just wrote + checksummed it
             return True
-        except OSError as e:
+        except Exception as e:
+            # not just OSError: an odd id-tag dtype, a MemoryError on
+            # tobytes() of a huge shard — none of it may abort training
             logger.warning("block cache store failed (%s); continuing", e)
             return False
         finally:
@@ -235,7 +263,15 @@ class BlockCache:
         t0 = _time.perf_counter()
         path = self.entry_path(index, shards)
         try:
-            mm = np.memmap(path, dtype=np.uint8, mode="r")
+            # map via an explicit fd so fstat pins the identity of the file
+            # actually mapped: the invalidation unlink below must not delete
+            # a FRESH entry a concurrent writer just os.replace'd over this
+            # path after we opened the stale one
+            with open(path, "rb") as f:
+                st_mapped = os.fstat(f.fileno())
+                mm = np.memmap(f, dtype=np.uint8, mode="r")
+            mapped_key = (st_mapped.st_ino, st_mapped.st_size,
+                          st_mapped.st_mtime_ns)
         except (OSError, ValueError):
             with self._lock:
                 self.stats.misses += 1
@@ -276,9 +312,11 @@ class BlockCache:
                 )
             id_tags = {}
             for tag, dt in header.get("tag_dtypes", {}).items():
-                id_tags[tag] = _decode_strings(
+                arr = _decode_strings(
                     views[f"tag:{tag}:arena"], views[f"tag:{tag}:off"], dt
                 )
+                arr.flags.writeable = False  # HostBlock read-only contract
+                id_tags[tag] = arr
             with self._lock:
                 self.stats.hits += 1
                 self._validated.add(path)
@@ -300,7 +338,15 @@ class BlockCache:
                            os.path.basename(path), e)
             del mm
             try:
-                os.unlink(path)
+                # unlink only while the path still holds the exact file that
+                # failed validation — a concurrent writer may have replaced
+                # it with a fresh valid entry since we mapped it (a remaining
+                # inode-reuse window is theoretical and costs one re-decode,
+                # never correctness)
+                st_now = os.stat(path)
+                if (st_now.st_ino, st_now.st_size,
+                        st_now.st_mtime_ns) == mapped_key:
+                    os.unlink(path)
             except OSError:
                 pass
             with self._lock:

@@ -62,6 +62,27 @@ def auto_decode_workers() -> int:
     return max(0, min((os.cpu_count() or 1) - 1, 16))
 
 
+def readahead_file_budget() -> int:
+    """Max decoded part files the readahead may hold AHEAD of the consumer.
+
+    Decoded-file residency is the peak-RSS term of streaming, and it must
+    be bounded independently of the pool width: with the worker cap at 16,
+    scheduling ``workers + depth`` files ahead would let a many-core host
+    keep ~17 decoded files resident — the out-of-core bound the bench
+    guarantees assumes a handful. The default (4) matches the residency of
+    the original ``min(4, cpus-1)`` pool; override with
+    ``PHOTON_STREAM_READAHEAD_FILES`` when files are small relative to
+    RAM and deeper readahead measurably helps the hide ratio.
+    """
+    env = os.environ.get("PHOTON_STREAM_READAHEAD_FILES")
+    if env is not None:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return 4
+
+
 @dataclasses.dataclass(frozen=True)
 class BlockPlan:
     """Static layout of a streamed dataset: file boundaries + block shapes.
@@ -114,7 +135,13 @@ class BlockPlan:
 @dataclasses.dataclass
 class HostBlock:
     """One decoded, padded, host-staged block (numpy only — built in the
-    prefetcher's background thread; the consumer does the device_put)."""
+    prefetcher's background thread; the consumer does the device_put).
+
+    ALL arrays are read-only by contract: cache hits are views over a
+    ``mode='r'`` memmap, and the decode path freezes its arrays to match,
+    so an in-place mutation fails uniformly on cold and warm epochs
+    instead of only once the cache warms. Consumers copy if they must
+    write (none currently do — blocks are device_put and dropped)."""
 
     index: int
     start: int        # global row of the first real row
@@ -256,9 +283,11 @@ class StreamingSource:
     def attach_cache(self, cache_dir: str, sweep: bool = True) -> BlockCache:
         """Attach a decoded block cache rooted at ``cache_dir``. The cache
         key (plan fingerprint) commits to block_rows, the part files'
-        (path, size, mtime_ns), the shard layout, id tags and reader
-        options — any change misses cleanly and ``sweep`` reclaims the
-        orphaned entries of older plans."""
+        (path, size, mtime_ns), the shard layout, a content digest of each
+        feature index map (externally loaded maps change column ids without
+        changing the input files), id tags and reader options — any change
+        misses cleanly and ``sweep`` reclaims the orphaned entries of older
+        plans."""
         fp = plan_fingerprint(
             self.plan.block_rows,
             self.plan.files,
@@ -266,6 +295,7 @@ class StreamingSource:
             self.plan.shard_dims,
             id_tags=self.id_tags,
             read_kwargs=self.read_kwargs,
+            index_maps=self.index_maps,
         )
         self.cache = BlockCache(cache_dir, fp)
         if sweep:
@@ -406,8 +436,13 @@ class StreamingSource:
         """Cache-aware readahead: schedule file decodes for the named
         blocks, skipping any block the block cache already holds — the
         cache is consulted BEFORE the Avro decode pool, so a fully warm
-        epoch never schedules a decode."""
+        epoch never schedules a decode. The scheduled file list is capped
+        at :func:`readahead_file_budget` + 1 regardless of how many blocks
+        the caller names (blocks spanning many small files must not blow
+        the decoded-file residency bound); dropped files simply decode on
+        demand when their block is built."""
         want = tuple(shards) if shards is not None else tuple(self.shard_configs)
+        budget = readahead_file_budget() + 1  # +1: the file being consumed
         fis: List[int] = []
         for b in indices:
             if self.cache is not None and self.cache.has(int(b), want):
@@ -415,8 +450,10 @@ class StreamingSource:
             for fi, _, _ in self.plan.spans(int(b)):
                 if fi not in fis:
                     fis.append(fi)
+            if len(fis) >= budget:
+                break
         if fis:
-            self.prefetch_files(fis)
+            self.prefetch_files(fis[:budget])
 
     # -- block assembly ----------------------------------------------------
 
@@ -501,6 +538,17 @@ class StreamingSource:
         finally:
             self._wall_exit()
         self._add_work(t_build)
+        id_tags = {
+            t: (np.concatenate(v) if v else np.zeros(0, dtype=object))
+            for t, v in tag_parts.items()
+        }
+        # freeze: cache hits are read-only memmap views, so the decode path
+        # must fail in-place writes identically (HostBlock contract)
+        for arr in (labels, offsets, weights, *id_tags.values()):
+            arr.flags.writeable = False
+        for vals, idx in packed.values():
+            vals.flags.writeable = False
+            idx.flags.writeable = False
         return HostBlock(
             index=index,
             start=start,
@@ -509,10 +557,7 @@ class StreamingSource:
             offsets=offsets,
             weights=weights,
             shards=packed,
-            id_tags={
-                t: (np.concatenate(v) if v else np.zeros(0, dtype=object))
-                for t, v in tag_parts.items()
-            },
+            id_tags=id_tags,
         )
 
     def iter_blocks(

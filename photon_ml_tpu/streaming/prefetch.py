@@ -31,7 +31,11 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.ops.data import LabeledData
 from photon_ml_tpu.ops.features import EllFeatures
-from photon_ml_tpu.streaming.blocks import HostBlock, StreamingSource
+from photon_ml_tpu.streaming.blocks import (
+    HostBlock,
+    StreamingSource,
+    readahead_file_budget,
+)
 from photon_ml_tpu.telemetry import get_registry, span
 
 _DONE = object()
@@ -173,10 +177,16 @@ class BlockPrefetcher:
 
     def _readahead(self, order, pos) -> None:
         """Schedule background decode of the files the next few blocks
-        need; window = decode workers + queue depth so the pool stays fed
-        without unbounded decoded-file residency. Cache-aware: blocks the
-        block cache already holds schedule nothing."""
-        window = self.source.decode_workers + max(1, self.depth)
+        need; window = min(decode workers, readahead file budget) + queue
+        depth, so the pool stays fed but decoded-file residency — the
+        streaming peak-RSS term — stays bounded by the budget even on a
+        many-core host where the pool is 16 wide (blocks.py enforces the
+        same budget on the scheduled file list itself). Cache-aware:
+        blocks the block cache already holds schedule nothing."""
+        window = (
+            min(self.source.decode_workers, readahead_file_budget())
+            + max(1, self.depth)
+        )
         self.source.prefetch_blocks(order[pos:pos + window], shards=self.shards)
 
     def _iter_sync(self) -> Iterator[DeviceBlock]:

@@ -192,6 +192,7 @@ class TestRobustness:
         fp2 = plan_fingerprint(
             BLOCK_ROWS, src.plan.files, src.plan.shard_widths,
             src.plan.shard_dims, id_tags=src.id_tags,
+            index_maps=dataset["index_maps"],
         )
         assert fp2 != src.cache.fingerprint
         src2 = _open_source(dataset, cache_dir=cache_dir)
@@ -202,11 +203,54 @@ class TestRobustness:
         src2.build_block(0)
         assert src2.work_seconds > work0  # re-decoded, no stale hit
 
+    def test_index_map_permutation_invalidates(self, dataset, tmp_path):
+        """Same files, same sizes, different name->index assignment MUST
+        change the fingerprint — a stale hit here would silently train on
+        wrong column ids (the --offheap-indexmap-dir hazard)."""
+        from photon_ml_tpu.indexmap import DefaultIndexMap
+
+        cache_dir = str(tmp_path / "c")
+        src = _open_source(dataset, cache_dir=cache_dir)
+        src.build_block(0)
+        old_dir = src.cache.dir
+
+        forward = dict(dataset["index_maps"]["global"].items())
+        (a, ia), (b, ib) = sorted(forward.items())[:2]
+        forward[a], forward[b] = ib, ia  # same size, permuted assignment
+        permuted = {"global": DefaultIndexMap(forward)}
+
+        src2 = StreamingSource.open(
+            dataset["paths"], SHARDS, index_maps=permuted,
+            block_rows=BLOCK_ROWS, id_tags=("userId",), cache_dir=cache_dir,
+        )
+        assert src2.cache.fingerprint != src.cache.fingerprint
+        assert src2.cache.dir != old_dir
+        work0 = src2.work_seconds
+        src2.build_block(0)
+        assert src2.work_seconds > work0  # re-decoded under the new map
+
+    def test_blocks_read_only_on_both_paths(self, dataset, tmp_path):
+        """Cold (decode) and warm (memmap) blocks must BOTH reject in-place
+        writes — a consumer mutating blocks must fail on epoch 1, not only
+        once the cache warms."""
+        src = _open_source(dataset, cache_dir=str(tmp_path / "c"))
+        cold = src.build_block(0)
+        warm = src.build_block(0)
+        assert src.cache.stats.hits == 1
+        for blk in (cold, warm):
+            for arr in (blk.labels, blk.offsets, blk.weights,
+                        *(a for pair in blk.shards.values() for a in pair),
+                        *blk.id_tags.values()):
+                assert not arr.flags.writeable
+            with pytest.raises(ValueError):
+                blk.labels[0] = 99.0
+
     def test_concurrent_writers_one_valid_entry(self, dataset, tmp_path):
         src = _open_source(dataset)
         fp = plan_fingerprint(
             BLOCK_ROWS, src.plan.files, src.plan.shard_widths,
             src.plan.shard_dims, id_tags=src.id_tags,
+            index_maps=dataset["index_maps"],
         )
         block = src.build_block(3)
         caches = [BlockCache(str(tmp_path / "c"), fp) for _ in range(4)]
@@ -229,6 +273,32 @@ class TestRobustness:
         loaded = reader.load(3, tuple(SHARDS))
         assert loaded is not None
         _assert_blocks_equal(block, loaded)
+
+
+class TestReadaheadBudget:
+    def test_env_override_and_floor(self, monkeypatch):
+        from photon_ml_tpu.streaming import readahead_file_budget
+
+        monkeypatch.delenv("PHOTON_STREAM_READAHEAD_FILES", raising=False)
+        assert readahead_file_budget() == 4
+        monkeypatch.setenv("PHOTON_STREAM_READAHEAD_FILES", "7")
+        assert readahead_file_budget() == 7
+        monkeypatch.setenv("PHOTON_STREAM_READAHEAD_FILES", "0")
+        assert readahead_file_budget() == 1  # floor: always one file ahead
+        monkeypatch.setenv("PHOTON_STREAM_READAHEAD_FILES", "junk")
+        assert readahead_file_budget() == 4
+
+    def test_prefetch_blocks_caps_scheduled_files(self, dataset, monkeypatch):
+        """Decoded-file residency must stay bounded by the budget no matter
+        how many blocks the caller names or how wide the pool is."""
+        monkeypatch.setenv("PHOTON_STREAM_READAHEAD_FILES", "1")
+        src = _open_source(dataset)
+        scheduled = []
+        monkeypatch.setattr(
+            src, "prefetch_files", lambda fis: scheduled.append(list(fis))
+        )
+        src.prefetch_blocks(range(src.plan.num_blocks))
+        assert scheduled and len(scheduled[0]) <= 2  # budget + in-use file
 
 
 class TestWarmEpochZeroWork:

@@ -49,6 +49,20 @@ class TestDefaultIndexMap:
         with pytest.raises(ValueError, match="duplicate"):
             DefaultIndexMap({"a": 0, "b": 0})
 
+    def test_content_digest_commits_to_assignment(self):
+        """Same names, permuted indices -> different digest (the block
+        cache relies on this to never serve blocks with wrong column
+        ids); equal mappings digest equally, and the fast dict-backed
+        override matches the generic dense-index walk byte-for-byte."""
+        from photon_ml_tpu.indexmap import IndexMap
+
+        m1 = DefaultIndexMap({"a": 0, "b": 1, "c": 2})
+        m2 = DefaultIndexMap({"a": 0, "b": 1, "c": 2})
+        perm = DefaultIndexMap({"a": 1, "b": 0, "c": 2})
+        assert m1.content_digest() == m2.content_digest()
+        assert m1.content_digest() != perm.content_digest()
+        assert m1.content_digest() == IndexMap.content_digest(m1)
+
 
 def _names(n=5000, seed=0):
     rng = np.random.default_rng(seed)
@@ -109,6 +123,25 @@ class TestOffHeapIndexMap:
     def test_native_is_available_in_this_image(self):
         # the toolchain is baked into the image; catch silent fallback
         assert native_available()
+
+    def test_content_digest_tracks_store_identity(self, tmp_path):
+        """Off-heap digest is file-stat based (stores are immutable once
+        built): stable across reopens of one store, different for a
+        rebuilt store — spurious miss is the safe direction."""
+        import os
+
+        names = _names(300, seed=3)
+        m = build_offheap_index_map(names, str(tmp_path / "im"), 2)
+        d1 = m.content_digest()
+        m.close()
+        with OffHeapIndexMap(str(tmp_path / "im")) as m2:
+            assert m2.content_digest() == d1
+        # a rebuilt/touched store (even with identical bytes) digests anew
+        part = str(tmp_path / "im" / offheap.PARTITION_FILE.format(i=0))
+        st = os.stat(part)
+        os.utime(part, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+        with OffHeapIndexMap(str(tmp_path / "im")) as m3:
+            assert m3.content_digest() != d1
 
     def test_duplicate_keys_rejected(self, tmp_path):
         with pytest.raises((ValueError, OSError)):
