@@ -117,6 +117,7 @@ class CoordinateDescent:
         score_plane: str = "device",
         schedule: str = "sync",
         staleness: int = 1,
+        progress: Optional[object] = None,
     ) -> None:
         if not coordinates:
             raise ValueError("need at least one coordinate")
@@ -155,6 +156,11 @@ class CoordinateDescent:
         # async over a host plane falls back to the sync loop at run time
         self.schedule = schedule
         self.staleness = int(staleness)
+        # optional telemetry.progress.ConvergenceTracker: per-update
+        # objective/grad/delta records plus the divergence watchdog (its
+        # record_coordinate may raise DivergenceError, aborting the run).
+        # None (the default) touches nothing — bitwise-identical training.
+        self.progress = progress
         # transfer accounting of the most recent (or in-flight) run
         self.transfer_stats = TransferStats(
             score_plane=score_plane, num_rows=num_rows
@@ -214,6 +220,63 @@ class CoordinateDescent:
                     - int(prev["device_plane_updates"])
                 ),
             )
+        )
+
+    def _record_progress(
+        self,
+        outer: int,
+        cid: str,
+        coord: Coordinate,
+        prev_model,
+        model,
+        objective: float,
+        loss: Optional[float],
+        regularization: Optional[float],
+    ) -> None:
+        """Fold one coordinate update into the convergence tracker: the
+        objective point, solver telemetry joined from the coordinate's
+        last_tracker/last_solve_info, the coefficient-delta norm, and any
+        streamed per-block stats. May raise DivergenceError (watchdog)."""
+        tracker = self.progress
+        if tracker is None:
+            return
+        solver_iterations = None
+        convergence_reason = None
+        grad_norm = None
+        states = getattr(getattr(coord, "last_tracker", None), "states", None)
+        if states is not None:
+            solver_iterations = int(states.iterations)
+            reason = states.convergence_reason
+            convergence_reason = getattr(reason, "name", str(reason))
+            grad_norm = getattr(states, "grad_norm", None)
+        info = getattr(coord, "last_solve_info", None)
+        line_search_trials = (
+            int(info.line_search_trials) if info is not None else None
+        )
+        coef_delta_norm = None
+        new_means = getattr(getattr(model, "coefficients", None), "means", None)
+        if new_means is not None:
+            old_means = getattr(
+                getattr(prev_model, "coefficients", None), "means", None
+            )
+            delta = (
+                new_means if old_means is None else new_means - old_means
+            )
+            coef_delta_norm = float(jnp.linalg.norm(delta))
+        block_stats = getattr(coord, "last_block_stats", None)
+        if block_stats:
+            tracker.record_blocks(outer, cid, block_stats)
+        tracker.record_coordinate(
+            outer,
+            cid,
+            objective,
+            loss=loss,
+            regularization=regularization,
+            grad_norm=grad_norm,
+            coef_delta_norm=coef_delta_norm,
+            solver_iterations=solver_iterations,
+            line_search_trials=line_search_trials,
+            convergence_reason=convergence_reason,
         )
 
     def run(
@@ -310,6 +373,7 @@ class CoordinateDescent:
                 for cid in self.update_order:
                     coord = self.coordinates[cid]
                     stats.coordinate_updates += 1
+                    prev_model = models.get(cid)
                     # partialScore = fullScore - ownScore (reference
                     # CoordinateDescent.scala:183)
                     with span(
@@ -385,16 +449,25 @@ class CoordinateDescent:
                                     outer, cid, loss_val, reg, obj,
                                 )
                             else:
+                                reg, obj = None, loss_val
                                 objective_history.append((cid, loss_val))
                                 logger.info(
                                     "CD iter %d coordinate %s: training "
                                     "objective %.6f",
                                     outer, cid, loss_val,
                                 )
+                        self._record_progress(
+                            outer, cid, coord, prev_model, models[cid],
+                            obj, loss_val, reg,
+                        )
                     if self.validate is not None:
                         with span("cd/validate", coordinate=cid, outer=outer):
                             metric = float(self.validate(models))
                             validation_history.append((cid, metric))
+                            if self.progress is not None:
+                                self.progress.record_validation(
+                                    outer, cid, metric
+                                )
                             logger.info(
                                 "CD iter %d coordinate %s: validation %.6f",
                                 outer, cid, metric,
@@ -518,6 +591,7 @@ class CoordinateDescent:
             nonlocal total, best_metric, best_models
             cid, old_own, work = pending.pop(0)
             coord = self.coordinates[cid]
+            prev_model = models.get(cid)
             with span(
                 "cd/reconcile", device_sync=True, coordinate=cid, outer=outer
             ):
@@ -543,16 +617,23 @@ class CoordinateDescent:
                             outer, cid, loss_val, reg, obj,
                         )
                     else:
+                        reg, obj = None, loss_val
                         objective_history.append((cid, loss_val))
                         logger.info(
                             "CD iter %d coordinate %s: training "
                             "objective %.6f",
                             outer, cid, loss_val,
                         )
+                self._record_progress(
+                    outer, cid, coord, prev_model, models[cid],
+                    obj, loss_val, reg,
+                )
             if self.validate is not None:
                 with span("cd/validate", coordinate=cid, outer=outer):
                     metric = float(self.validate(models))
                     validation_history.append((cid, metric))
+                    if self.progress is not None:
+                        self.progress.record_validation(outer, cid, metric)
                     logger.info(
                         "CD iter %d coordinate %s: validation %.6f",
                         outer, cid, metric,

@@ -65,6 +65,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         help="Write the tuner proposal as JSON to PATH (implies --propose).",
     )
     parser.add_argument(
+        "--progress",
+        action="store_true",
+        help=(
+            "Render the convergence report (iterations-to-tolerance per "
+            "coordinate, objective shares, per-block gap estimates, "
+            "anomalies) from the ledger's progress records; exits nonzero "
+            "when the ledger carries none."
+        ),
+    )
+    parser.add_argument(
         "--quiet",
         action="store_true",
         help="Suppress the human-readable report (JSON outputs still written).",
@@ -74,6 +84,18 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 def run(args: argparse.Namespace) -> int:
     report = analyze_ledger(args.ledger)
+    if args.progress:
+        from photon_ml_tpu.telemetry.progress import format_progress_report
+
+        if not report.progress:
+            print(
+                "analyze_run: ledger carries no progress records (train with "
+                "--progress-out to record the convergence plane)",
+                file=sys.stderr,
+            )
+            return 1
+        if not args.quiet:
+            print(format_progress_report(report.progress))
     if not args.quiet:
         print(format_report(report))
     if args.json:

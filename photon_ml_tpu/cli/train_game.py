@@ -230,9 +230,35 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "optimizer iteration (exact full-batch, default); "
                         "'stochastic' visits shuffled block groups per epoch "
                         "-- gate it on held-out metric parity first")
+    p.add_argument("--progress-out", default=None, metavar="PROGRESS.jsonl",
+                   help="write the convergence-plane ledger here: one JSONL "
+                        "record per coordinate update (objective, grad norm, "
+                        "coefficient delta, solver iterations), per held-out "
+                        "evaluation, and — under --streaming — per block "
+                        "(partial loss / grad norm / duality-gap estimate). "
+                        "Replay with analyze_run --progress. Also arms the "
+                        "divergence watchdog: NaN/Inf or increasing "
+                        "objectives abort the run instead of saving garbage")
+    p.add_argument("--introspect-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve live training introspection on "
+                        "127.0.0.1:PORT (0 = ephemeral): /progress (JSON "
+                        "convergence trace), /metrics (Prometheus), /healthz "
+                        "(503 once the divergence watchdog trips), /varz. "
+                        "Implies the convergence tracker even without "
+                        "--progress-out")
+    p.add_argument("--introspect-port-file", default=None,
+                   help="write the bound introspection port here (for "
+                        "--introspect-port 0)")
+    p.add_argument("--introspect-hold", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="keep the introspection server up for at most this "
+                        "long after training, until /quitquitquit")
     p.add_argument("--log-file", default=None)
     add_telemetry_args(p)
     args = p.parse_args(argv)
+    if args.introspect_port is not None and args.introspect_port < 0:
+        p.error("--introspect-port must be >= 0 (0 = ephemeral)")
     if args.block_rows < 1:
         p.error("--block-rows must be >= 1")
     if args.prefetch_depth < 0:
@@ -517,7 +543,33 @@ def run(args: argparse.Namespace) -> GameFit:
     telemetry = start_telemetry(args, "train_game", emitter=emitter)
     emitter.send_event(PhotonSetupEvent(params=vars(args)))
     t_start = time.perf_counter()
+    progress = None
+    introspect = None
     try:
+        if args.progress_out or args.introspect_port is not None:
+            from photon_ml_tpu.telemetry import ConvergenceTracker
+
+            progress = ConvergenceTracker(
+                ledger_path=args.progress_out,
+                emitter=emitter,
+                label="train_game",
+            )
+        if args.introspect_port is not None:
+            from photon_ml_tpu.serving.introspect import IntrospectionServer
+
+            introspect = IntrospectionServer(
+                varz=lambda: vars(args),
+                health=progress.health,
+                port=args.introspect_port,
+                extra_json={"/progress": progress.progress_json},
+            ).start()
+            logger.info(
+                "introspection on http://%s:%d (/progress /metrics /healthz)",
+                introspect.host, introspect.port,
+            )
+            if args.introspect_port_file:
+                with open(args.introspect_port_file, "w") as f:
+                    f.write(str(introspect.port))
         shard_configs, coordinates, update_order, raw_config = load_game_config(
             args.coordinate_config
         )
@@ -792,6 +844,11 @@ def run(args: argparse.Namespace) -> GameFit:
                 "sweeps (each swept fit would re-stream the dataset); pick "
                 "one weight per coordinate or train in-memory"
             )
+        if progress is not None and len(sweep_configs) > 1:
+            raise ValueError(
+                "--progress-out/--introspect-port track ONE fit's trajectory; "
+                "they do not compose with regularization_weights sweeps"
+            )
         with profile_ctx, timer.time("fit"):
             if args.streaming:
                 fit = estimator.fit_streaming(
@@ -800,6 +857,7 @@ def run(args: argparse.Namespace) -> GameFit:
                     checkpoint_dir=args.checkpoint_dir,
                     prefetch_depth=args.prefetch_depth,
                     mode=args.stream_mode,
+                    progress=progress,
                 )
                 all_fits = [fit]
                 all_fit_overrides = [{}]
@@ -835,6 +893,7 @@ def run(args: argparse.Namespace) -> GameFit:
                     data,
                     validation_data=validation_data,
                     checkpoint_dir=args.checkpoint_dir,
+                    progress=progress,
                 )
                 all_fits = [fit]
                 all_fit_overrides = [{}]
@@ -948,6 +1007,15 @@ def run(args: argparse.Namespace) -> GameFit:
             logger.info("timing %-28s %.3fs", name, seconds)
         return best
     finally:
+        # the introspection hold runs first, so an operator can still read
+        # /healthz (503 after a divergence abort) and /progress before the
+        # plane tears down
+        if introspect is not None:
+            if args.introspect_hold > 0:
+                introspect.wait_quit(args.introspect_hold)
+            introspect.stop()
+        if progress is not None:
+            progress.finish()
         # listeners must flush/close even when the run fails; telemetry
         # finishes after them so every bridged event is in the ledger
         emitter.clear_listeners()
@@ -956,11 +1024,18 @@ def run(args: argparse.Namespace) -> GameFit:
 
 def main(argv: Optional[List[str]] = None) -> int:
     from photon_ml_tpu.parallel.multihost import initialize_from_args
+    from photon_ml_tpu.telemetry import DivergenceError
 
     args = parse_args(argv)
     # cluster join (or single-process no-op) must precede any jax device use
     initialize_from_args(args)
-    run(args)
+    try:
+        run(args)
+    except DivergenceError as e:
+        # the watchdog already wrote the anomaly record and flipped
+        # /healthz; abort without a model artifact rather than save garbage
+        print(f"training aborted by divergence watchdog: {e}", file=sys.stderr)
+        return 2
     return 0
 
 

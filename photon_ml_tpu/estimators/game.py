@@ -578,6 +578,7 @@ class GameEstimator:
         validation_data: Optional[GameData] = None,
         checkpoint_dir: Optional[str] = None,
         initial_models: Optional[Dict[str, object]] = None,
+        progress: Optional[object] = None,
     ) -> GameFit:
         """With ``checkpoint_dir``, training state is written atomically
         after every outer CD iteration and an existing checkpoint there is
@@ -585,13 +586,16 @@ class GameEstimator:
         photon_ml_tpu.checkpoint. ``initial_models`` warm-starts coordinates
         (reference warmStartModels across tuning trials,
         cli/game/training/Driver.scala:484-501); a resumed checkpoint takes
-        precedence."""
+        precedence. ``progress`` is an optional
+        :class:`~photon_ml_tpu.telemetry.progress.ConvergenceTracker`; None
+        (the default) leaves training bitwise-identical."""
         coordinates = {
             cid: self._build_coordinate(cid, cfg, data)
             for cid, cfg in self.coordinate_configs.items()
         }
         return self._run_fit(
-            coordinates, data, validation_data, checkpoint_dir, initial_models
+            coordinates, data, validation_data, checkpoint_dir, initial_models,
+            progress=progress,
         )
 
     def fit_streaming(
@@ -606,6 +610,7 @@ class GameEstimator:
         stochastic_chunk_iters: int = 4,
         blocks_per_update: int = 1,
         seed: int = 0,
+        progress: Optional[object] = None,
     ) -> GameFit:
         """Out-of-core ``fit``: fixed-effect coordinates stream fixed-shape
         blocks from a :class:`~photon_ml_tpu.streaming.StreamingSource`
@@ -680,11 +685,15 @@ class GameEstimator:
                     chunk_iters=stochastic_chunk_iters,
                     blocks_per_update=blocks_per_update,
                     seed=seed,
+                    # convergence plane: per-block loss/grad/gap probes run
+                    # only when a tracker is attached (bitwise contract)
+                    collect_block_stats=progress is not None,
                 )
             else:
                 coordinates[cid] = self._build_coordinate(cid, cfg, data)
         return self._run_fit(
-            coordinates, data, validation_data, checkpoint_dir, initial_models
+            coordinates, data, validation_data, checkpoint_dir, initial_models,
+            progress=progress,
         )
 
     def fit_multiple(
@@ -797,6 +806,7 @@ class GameEstimator:
         validation_data: Optional[GameData],
         checkpoint_dir: Optional[str],
         initial_models: Optional[Dict[str, object]],
+        progress: Optional[object] = None,
     ) -> GameFit:
         meta = self._meta()
 
@@ -881,6 +891,7 @@ class GameEstimator:
             score_plane=self._effective_score_plane(),
             schedule=schedule,
             staleness=self.staleness,
+            progress=progress,
         )
 
         start_iteration = 0

@@ -145,6 +145,22 @@ class TransferStatsEvent(Event):
     device_plane_updates: int
 
 
+@dataclasses.dataclass(frozen=True)
+class AnomalyEvent(Event):
+    """The divergence watchdog tripped during training: a non-finite
+    objective, an objective increase beyond tolerance, or repeated
+    line-search failure while the gradient is still large. ``kind`` names
+    the trigger; ``detail`` carries the offending values. Listeners see it
+    before the driver aborts (the /healthz endpoint flips unhealthy on the
+    same signal)."""
+
+    kind: str
+    coordinate_id: Optional[str]
+    outer_iteration: int
+    objective_value: float
+    detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
 class EventListener:
     """Receives every event from an emitter (EventListener.scala)."""
 

@@ -30,6 +30,9 @@ class OptimizationStatesTracker:
     iterations: int
     convergence_reason: ConvergenceReason
     elapsed_seconds: Optional[float] = None
+    # final-iterate gradient norm (the convergence plane's stationarity
+    # signal; None for trackers built before the solve finished)
+    grad_norm: Optional[float] = None
 
     @classmethod
     def from_result(
@@ -42,6 +45,7 @@ class OptimizationStatesTracker:
             iterations=iters,
             convergence_reason=result.reason_enum(),
             elapsed_seconds=elapsed_seconds,
+            grad_norm=float(result.grad_norm),
         )
 
     @property

@@ -104,6 +104,7 @@ class IntrospectionServer:
         health: Optional[Callable[[], Dict[str, Any]]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        extra_json: Optional[Dict[str, Callable[[], Any]]] = None,
     ):
         if registry is None:
             from photon_ml_tpu.telemetry.metrics import get_registry
@@ -112,6 +113,12 @@ class IntrospectionServer:
         self.registry = registry
         self._varz = varz or (lambda: {})
         self._health = health or (lambda: {})
+        # extra JSON endpoints (path -> zero-arg callable returning a
+        # JSON-able value), re-evaluated per request like varz/health; the
+        # training plane mounts /progress here
+        self._extra = {
+            "/" + p.strip("/"): fn for p, fn in (extra_json or {}).items()
+        }
         self._quit = threading.Event()
         outer = self
 
@@ -156,6 +163,17 @@ class IntrospectionServer:
                     elif path == "/quitquitquit":
                         outer._quit.set()
                         self._reply(200, "bye\n", "text/plain")
+                    elif path in outer._extra:
+                        self._reply(
+                            200,
+                            json.dumps(
+                                outer._extra[path](),
+                                indent=2,
+                                sort_keys=True,
+                                default=str,
+                            ),
+                            "application/json",
+                        )
                     else:
                         self._reply(404, "not found\n", "text/plain")
                 except Exception as e:  # endpoint bugs must not kill serving

@@ -23,6 +23,7 @@ from photon_ml_tpu.streaming.prefetch import (
     PrefetchStats,
 )
 from photon_ml_tpu.streaming.solver import (
+    BlockStatsProbe,
     StreamSolveInfo,
     reset_stream_trace_counts,
     solve_streaming,
@@ -45,6 +46,7 @@ __all__ = [
     "BlockPrefetcher",
     "DeviceBlock",
     "PrefetchStats",
+    "BlockStatsProbe",
     "StreamSolveInfo",
     "reset_stream_trace_counts",
     "solve_streaming",

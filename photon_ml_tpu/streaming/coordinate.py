@@ -40,6 +40,7 @@ from photon_ml_tpu.opt.tracking import (
 from photon_ml_tpu.streaming.blocks import StreamingSource
 from photon_ml_tpu.streaming.prefetch import BlockPrefetcher, PrefetchStats
 from photon_ml_tpu.streaming.solver import (
+    BlockStatsProbe,
     StreamSolveInfo,
     _note_trace,
     solve_streaming,
@@ -126,6 +127,15 @@ class StreamingFixedEffectCoordinate(Coordinate):
     last_prefetch_stats: Optional[PrefetchStats] = dataclasses.field(
         default=None, repr=False
     )
+    # convergence plane: when True, full-batch solves run the probe variant
+    # of the accumulation program and leave each pass's per-block partial
+    # loss / grad norm / gap estimate in ``last_block_stats`` (and on the
+    # pass's PrefetchStats.block_gaps — the DuHL scheduler seam). Off by
+    # default: the original programs run untouched (bitwise contract).
+    collect_block_stats: bool = False
+    last_block_stats: Optional[list] = dataclasses.field(
+        default=None, repr=False
+    )
     _objective: Optional[GlmObjective] = dataclasses.field(
         default=None, repr=False
     )
@@ -196,6 +206,11 @@ class StreamingFixedEffectCoordinate(Coordinate):
             else model.coefficients.means
         )
         info = StreamSolveInfo()
+        probe = (
+            BlockStatsProbe()
+            if self.collect_block_stats and self.mode == "full"
+            else None
+        )
         with span(
             "fe/solve",
             device_sync=True,
@@ -213,6 +228,7 @@ class StreamingFixedEffectCoordinate(Coordinate):
                     ),
                     configuration=self.configuration,
                     info=info,
+                    probe=probe,
                 )
             else:
                 total_weight = float(np.sum(self.source.row_planes().weights))
@@ -236,6 +252,12 @@ class StreamingFixedEffectCoordinate(Coordinate):
         self.last_tracker = FixedEffectOptimizationTracker(
             states=OptimizationStatesTracker.from_result(result)
         )
+        if probe is not None:
+            self.last_block_stats = probe.last_pass
+            if self.last_prefetch_stats is not None:
+                self.last_prefetch_stats.block_gaps = {
+                    s["block"]: s["gap_estimate"] for s in probe.last_pass
+                }
         return GeneralizedLinearModel(
             coefficients=Coefficients(means=result.w), task=self.task
         )

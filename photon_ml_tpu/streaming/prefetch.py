@@ -71,6 +71,11 @@ class PrefetchStats:
     upload_hidden_s: float = 0.0  # uploads dispatched while solve in flight
     cache_hit_blocks: int = 0    # blocks served from the block cache
     cache_load_s: float = 0.0    # wall seconds mapping+validating entries
+    # per-block duality-gap estimates of the most recent streamed solve's
+    # final pass (block index -> gap), written by the streaming coordinate
+    # when the convergence plane is on; the seam a DuHL-style gap-guided
+    # block scheduler (ROADMAP item 3) will read
+    block_gaps: Optional[Dict[int, float]] = None
 
     @property
     def hide_ratio(self) -> float:
@@ -80,6 +85,15 @@ class PrefetchStats:
         if self.decode_s <= 0:
             return 1.0
         return max(0.0, (self.decode_s - self.stall_s) / self.decode_s)
+
+    @property
+    def decode_parallelism(self) -> float:
+        """Achieved decode-pool parallelism: summed per-thread decode work
+        over decode wall clock. 1.0 means fully serial; ~N means N workers
+        genuinely overlapped. 0.0 when no decode ran (fully cached pass)."""
+        if self.decode_s <= 0:
+            return 0.0
+        return self.decode_work_s / self.decode_s
 
 
 class BlockPrefetcher:
@@ -169,6 +183,8 @@ class BlockPrefetcher:
         reg.count("stream.cache_hit_blocks", self.stats.cache_hit_blocks)
         reg.count("stream.cache_load_s", self.stats.cache_load_s)
         reg.gauge("stream.prefetch_hide_ratio", self.stats.hide_ratio)
+        if self.stats.decode_s > 0:
+            reg.gauge("stream.decode_parallelism", self.stats.decode_parallelism)
 
     def _block_order(self):
         if self.order is not None:

@@ -79,6 +79,55 @@ def reset_stream_trace_counts() -> None:
 BlockFn = Callable[[], Iterable]
 
 
+class BlockStatsProbe:
+    """Per-block convergence-plane collector for one streamed solve.
+
+    When a probe is passed to ``solve_streaming`` the accumulation pass runs
+    ``acc_vg_probe`` instead of ``acc_vg``: same donated-accumulator math
+    plus three extra scalar reductions per block — the block's partial loss,
+    partial gradient norm, and a first-order Fenchel duality-gap surrogate
+    ``f_k + <w, g_k>`` (the DuHL-style block importance score of
+    arxiv 1702.07005, with dual variables implicitly refreshed at the
+    current iterate). ``last_pass`` holds the scalars of the most recent
+    completed pass — for a converged solve that is the final streamed
+    epoch. With no probe the original programs run untouched, so the
+    disabled path stays bitwise identical.
+    """
+
+    def __init__(self) -> None:
+        self._pending: List[tuple] = []
+        self._futures: List[tuple] = []
+        self._resolved: Optional[List[dict]] = None
+
+    def begin_pass(self) -> None:
+        self._pending = []
+
+    def on_block(self, partial_loss, partial_grad_norm, gap_estimate) -> None:
+        self._pending.append((partial_loss, partial_grad_norm, gap_estimate))
+
+    def end_pass(self) -> None:
+        # keep the futures; only the final completed pass is ever read, so
+        # host resolution is deferred to the last_pass property — no D2H
+        # sync on the intermediate line-search passes
+        self._futures = self._pending
+        self._pending = []
+        self._resolved = None
+
+    @property
+    def last_pass(self) -> List[dict]:
+        if self._resolved is None:
+            self._resolved = [
+                {
+                    "block": i,
+                    "partial_loss": float(f),
+                    "partial_grad_norm": float(g),
+                    "gap_estimate": float(gap),
+                }
+                for i, (f, g, gap) in enumerate(self._futures)
+            ]
+        return self._resolved
+
+
 class StreamPrograms:
     """The jitted per-block programs of one streamed solve. Built once per
     objective (``for_objective`` memoizes) and reused across every block,
@@ -135,7 +184,18 @@ class StreamPrograms:
             y = (g_new - g_old).astype(y_hist.dtype)
             return update_history(s_hist, y_hist, rho, count, s, y)
 
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def acc_vg_probe(w, data, f_acc, g_acc):
+            _note_trace("stream_vg_probe")
+            f, g = objective.value_and_grad(w, data, jnp.zeros((), w.dtype))
+            # convergence-plane extras: a few scalar reductions per block
+            # (see BlockStatsProbe); compiled only when probing is on, so
+            # the default path keeps the original acc_vg program
+            gap = f + jnp.dot(w, g)
+            return f_acc + f, g_acc + g, f, jnp.linalg.norm(g), gap
+
         self.acc_vg = acc_vg
+        self.acc_vg_probe = acc_vg_probe
         self.finalize = finalize
         self.direction = direction
         self.step = step
@@ -153,14 +213,23 @@ class StreamSolveInfo:
 
 
 def _full_pass(
-    programs: StreamPrograms, w, make_blocks: BlockFn, dim: int, l2, info
+    programs: StreamPrograms, w, make_blocks: BlockFn, dim: int, l2, info,
+    probe: Optional[BlockStatsProbe] = None,
 ):
     """One streamed accumulation of the EXACT full-batch (value, grad)."""
     f = jnp.zeros((), dtype=w.dtype)
     g = jnp.zeros((dim,), dtype=w.dtype)
-    for data in make_blocks():
-        f, g = programs.acc_vg(w, data, f, g)
-        info.blocks += 1
+    if probe is None:
+        for data in make_blocks():
+            f, g = programs.acc_vg(w, data, f, g)
+            info.blocks += 1
+    else:
+        probe.begin_pass()
+        for data in make_blocks():
+            f, g, bf, bg, bgap = programs.acc_vg_probe(w, data, f, g)
+            probe.on_block(bf, bg, bgap)
+            info.blocks += 1
+        probe.end_pass()
     info.passes += 1
     return programs.finalize(f, g, w, l2)
 
@@ -172,6 +241,7 @@ def solve_streaming(
     configuration: GlmOptimizationConfiguration,
     l2_weight: Optional[float] = None,
     info: Optional[StreamSolveInfo] = None,
+    probe: Optional[BlockStatsProbe] = None,
 ) -> SolveResult:
     """Exact full-batch L-BFGS with the dataset streamed per pass.
 
@@ -201,7 +271,7 @@ def solve_streaming(
     )
     programs = StreamPrograms.for_objective(objective)
 
-    f, g, g_norm = _full_pass(programs, w, make_blocks, dim, l2, info)
+    f, g, g_norm = _full_pass(programs, w, make_blocks, dim, l2, info, probe)
     abs_f_tol, abs_g_tol = absolute_tolerances(f, g_norm, cfg.tolerance)
     abs_f_tol = float(abs_f_tol)
     abs_g_tol = float(abs_g_tol)
@@ -231,7 +301,7 @@ def solve_streaming(
             info.line_search_trials += 1
             w_try = programs.step(w, d, jnp.asarray(t, dtype=w.dtype))
             f_try, g_try, g_try_norm = _full_pass(
-                programs, w_try, make_blocks, dim, l2, info
+                programs, w_try, make_blocks, dim, l2, info, probe
             )
             if float(f_try) <= f_host + 1e-4 * t * dphi0_f:
                 accepted = (w_try, f_try, g_try, g_try_norm)

@@ -40,6 +40,14 @@ from photon_ml_tpu.telemetry.sinks import (
     span_tree_summary,
     write_chrome_trace,
 )
+from photon_ml_tpu.telemetry.progress import (
+    ConvergenceTracker,
+    DivergenceError,
+    convergence_report,
+    extract_progress_records,
+    format_progress_report,
+    iterations_to_target_metric,
+)
 from photon_ml_tpu.telemetry.session import TelemetryRun, start_run
 from photon_ml_tpu.telemetry.validate import (
     TruncatedLedgerWarning,
@@ -73,6 +81,12 @@ __all__ = [
     "format_summary_table",
     "span_tree_summary",
     "write_chrome_trace",
+    "ConvergenceTracker",
+    "DivergenceError",
+    "convergence_report",
+    "extract_progress_records",
+    "format_progress_report",
+    "iterations_to_target_metric",
     "TelemetryRun",
     "start_run",
     "TruncatedLedgerWarning",

@@ -33,6 +33,7 @@ import dataclasses
 import json
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from photon_ml_tpu.telemetry.progress import convergence_report
 from photon_ml_tpu.telemetry.validate import validate_ledger
 
 __all__ = [
@@ -123,6 +124,9 @@ class RunReport:
     metrics: Dict[str, Any]
     warnings: List[str] = dataclasses.field(default_factory=list)
     overlap_s: float = 0.0
+    # convergence-plane reconstruction (telemetry.progress.convergence_report)
+    # when the ledger carries "progress" records; None for perf-only ledgers
+    progress: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -241,6 +245,7 @@ def analyze_records(
     metas = [r for r in records if r.get("type") == "meta"]
     events = [r for r in records if r.get("type") == "event"]
     metric_recs = [r for r in records if r.get("type") == "metrics"]
+    progress_recs = [r for r in records if r.get("type") == "progress"]
 
     label = next(
         (m.get("label", "run") for m in metas if m.get("phase") == "start"),
@@ -464,6 +469,9 @@ def analyze_records(
         metrics=snapshot,
         warnings=warnings,
         overlap_s=round(overlap_total, 6),
+        progress=(
+            convergence_report(progress_recs) if progress_recs else None
+        ),
     )
 
 
@@ -539,6 +547,16 @@ def format_report(report: RunReport) -> str:
         lines.append("  jit traces: " + ", ".join(
             f"{k}={v}" for k, v in sorted(report.jit_traces.items())
         ))
+    if report.progress:
+        prog = report.progress
+        anomalies = prog.get("anomalies") or []
+        lines.append(
+            f"  convergence plane: {prog.get('num_updates', 0)} coordinate "
+            f"update(s) over {len(prog.get('coordinates') or {})} "
+            "coordinate(s)"
+            + (f", {len(anomalies)} ANOMALY record(s)" if anomalies else "")
+            + " — full report via analyze_run --progress"
+        )
     if report.warnings:
         lines.append("")
         for w in report.warnings:

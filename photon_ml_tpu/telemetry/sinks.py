@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional
 
 from photon_ml_tpu.event import (
+    AnomalyEvent,
     Event,
     EventListener,
     ModelSwapEvent,
@@ -164,6 +165,9 @@ class TelemetryEventListener(EventListener):
                 reg.count("serving.swap_rollbacks")
             else:
                 reg.count("serving.swaps")
+        elif isinstance(event, AnomalyEvent):
+            reg.count("progress.anomaly_events")
+            reg.count(f"progress.anomaly.{event.kind}")
 
     def close(self) -> None:
         if self.ledger is not None:

@@ -26,6 +26,18 @@ _LEDGER_SCHEMAS: Dict[str, tuple] = {
     "event": ("event", "fields"),
     "span": ("name", "path", "span_id", "duration_s", "failed"),
     "metrics": ("snapshot",),
+    # convergence plane (telemetry/progress.py): one record per coordinate
+    # update / validation probe / streamed block / watchdog anomaly
+    "progress": ("kind",),
+}
+
+# progress record kind -> required extra fields beyond "kind"
+_PROGRESS_SCHEMAS: Dict[str, tuple] = {
+    "coordinate": ("outer", "coordinate", "objective"),
+    "validation": ("outer", "coordinate", "metric"),
+    "block": ("outer", "coordinate", "block", "partial_loss",
+              "partial_grad_norm", "gap_estimate"),
+    "anomaly": ("anomaly_kind", "objective"),
 }
 
 
@@ -112,6 +124,19 @@ def validate_ledger(
                 raise ValueError(
                     f"{path}:{lineno}: {rec_type} record missing {field!r}"
                 )
+        if rec_type == "progress":
+            kind = rec.get("kind")
+            if kind not in _PROGRESS_SCHEMAS:
+                raise ValueError(
+                    f"{path}:{lineno}: unknown progress kind {kind!r} "
+                    f"(expected one of {sorted(_PROGRESS_SCHEMAS)})"
+                )
+            for field in _PROGRESS_SCHEMAS[kind]:
+                if field not in rec:
+                    raise ValueError(
+                        f"{path}:{lineno}: progress/{kind} record missing "
+                        f"{field!r}"
+                    )
         records.append(rec)
     if not records:
         raise ValueError(f"{path}: ledger is empty")

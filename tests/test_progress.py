@@ -1,0 +1,774 @@
+"""Convergence observability plane tests: the ConvergenceTracker (records,
+registry joins, divergence watchdog), progress.jsonl ledger schema round
+trips, convergence-report reconstruction, the /progress //healthz live
+introspection path, the analyze_run --progress CLI, the convergence
+sentinel (dev-scripts/check_convergence_trajectory.py), and the driver-
+level contracts: divergence injection must abort the CLI with no model
+artifact, and the disabled-by-default path must stay bitwise identical."""
+
+import importlib.util
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.event import AnomalyEvent, EventEmitter
+from photon_ml_tpu.telemetry import (
+    ConvergenceTracker,
+    DivergenceError,
+    MetricsRegistry,
+    TruncatedLedgerWarning,
+    convergence_report,
+    extract_progress_records,
+    format_progress_report,
+    iterations_to_target_metric,
+    validate_ledger,
+)
+
+SENTINEL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "dev-scripts", "check_convergence_trajectory.py",
+)
+
+
+def _load_sentinel():
+    spec = importlib.util.spec_from_file_location(
+        "check_convergence_trajectory", SENTINEL
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tracker(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return ConvergenceTracker(**kw)
+
+
+class TestConvergenceTracker:
+    def test_coordinate_records_and_registry(self):
+        reg = MetricsRegistry()
+        t = _tracker(registry=reg)
+        t.record_coordinate(
+            0, "fixed", 100.0, loss=90.0, regularization=10.0,
+            grad_norm=5.0, coef_delta_norm=2.0, solver_iterations=12,
+            line_search_trials=3, convergence_reason="MAX_ITERATIONS",
+        )
+        t.record_coordinate(0, "per_user", 80.0)
+        (rec, rec2) = t.records
+        assert rec["kind"] == "coordinate" and rec["objective"] == 100.0
+        assert rec["solver_iterations"] == 12
+        assert rec["convergence_reason"] == "MAX_ITERATIONS"
+        # optional fields stay absent when the solver has no scalar tracker
+        assert "grad_norm" not in rec2 and "solver_iterations" not in rec2
+        snap = reg.snapshot()
+        assert snap["counters"]["progress.coordinate_updates"] == 2
+        assert snap["counters"]["progress.solver_iterations"] == 12
+        assert snap["gauges"]["progress.objective"]["last"] == 80.0
+        assert snap["gauges"]["progress.fixed.grad_norm"]["last"] == 5.0
+        assert t.healthy and t.anomaly is None
+
+    def test_validation_and_block_records(self):
+        reg = MetricsRegistry()
+        t = _tracker(registry=reg)
+        t.record_validation(0, "fixed", 0.75)
+        t.record_blocks(0, "fixed", [
+            {"block": 0, "partial_loss": 10.0, "partial_grad_norm": 1.0,
+             "gap_estimate": 4.0},
+            {"block": 1, "partial_loss": 12.0, "partial_grad_norm": 2.0,
+             "gap_estimate": 6.0},
+        ])
+        kinds = [r["kind"] for r in t.records]
+        assert kinds == ["validation", "block", "block"]
+        snap = reg.snapshot()
+        assert snap["gauges"]["progress.validation_metric"]["last"] == 0.75
+        # the DuHL scheduler seam: per-block gap gauges + aggregates
+        assert snap["gauges"]["stream.block_gap.0"]["last"] == 4.0
+        assert snap["gauges"]["stream.block_gap.1"]["last"] == 6.0
+        assert snap["gauges"]["stream.block_gap_max"]["last"] == 6.0
+        assert snap["gauges"]["stream.block_gap_sum"]["last"] == 10.0
+
+    def test_non_finite_objective_trips(self):
+        emitter = EventEmitter()
+        from tests._listeners import CollectingListener
+
+        CollectingListener.received = []
+        emitter.register_listener_class("tests._listeners.CollectingListener")
+        t = _tracker(emitter=emitter)
+        t.record_coordinate(0, "fixed", 50.0)
+        with pytest.raises(DivergenceError) as err:
+            t.record_coordinate(1, "fixed", float("nan"))
+        assert err.value.anomaly["anomaly_kind"] == "non_finite_objective"
+        assert not t.healthy
+        assert t.records[-1]["kind"] == "anomaly"
+        events = [e for e in CollectingListener.received
+                  if isinstance(e, AnomalyEvent)]
+        assert len(events) == 1
+        assert events[0].kind == "non_finite_objective"
+        assert events[0].coordinate_id == "fixed"
+
+    def test_objective_increase_trips_beyond_tolerance(self):
+        t = _tracker(divergence_tolerance=1e-3)
+        t.record_coordinate(0, "fixed", 100.0)
+        # within tolerance: allowed drift, no trip
+        t.record_coordinate(0, "per_user", 100.05)
+        with pytest.raises(DivergenceError) as err:
+            t.record_coordinate(1, "fixed", 102.0)
+        anomaly = err.value.anomaly
+        assert anomaly["anomaly_kind"] == "objective_increase"
+        assert anomaly["detail"]["previous_objective"] == 100.05
+        assert anomaly["detail"]["allowed_objective"] == pytest.approx(
+            100.05 + 1e-3 * 100.05
+        )
+
+    def test_line_search_stall_requires_large_grad(self):
+        # "line search failed" with a TINY gradient is what convergence
+        # looks like — must never trip
+        t = _tracker(max_line_search_failures=3)
+        for outer in range(6):
+            t.record_coordinate(
+                outer, "fixed", 50.0, grad_norm=1e-4,
+                convergence_reason="OBJECTIVE_NOT_IMPROVING",
+            )
+        assert t.healthy
+        # same reason with a still-large gradient: stall after 3 in a row
+        t2 = _tracker(max_line_search_failures=3)
+        t2.record_coordinate(
+            0, "fixed", 50.0, grad_norm=9.0,
+            convergence_reason="OBJECTIVE_NOT_IMPROVING",
+        )
+        t2.record_coordinate(
+            1, "fixed", 50.0, grad_norm=9.0,
+            convergence_reason="OBJECTIVE_NOT_IMPROVING",
+        )
+        with pytest.raises(DivergenceError) as err:
+            t2.record_coordinate(
+                2, "fixed", 50.0, grad_norm=9.0,
+                convergence_reason="OBJECTIVE_NOT_IMPROVING",
+            )
+        assert err.value.anomaly["anomaly_kind"] == "line_search_stall"
+        assert err.value.anomaly["detail"]["consecutive_failures"] == 3
+        # a healthy update in between resets the streak
+        t3 = _tracker(max_line_search_failures=3)
+        for outer in range(2):
+            t3.record_coordinate(
+                outer, "fixed", 50.0 - outer, grad_norm=9.0,
+                convergence_reason="OBJECTIVE_NOT_IMPROVING",
+            )
+        t3.record_coordinate(2, "fixed", 47.0, grad_norm=9.0,
+                             convergence_reason="CONVERGED")
+        t3.record_coordinate(3, "fixed", 46.0, grad_norm=9.0,
+                             convergence_reason="OBJECTIVE_NOT_IMPROVING")
+        assert t3.healthy
+
+    def test_no_abort_mode_records_without_raising(self):
+        t = _tracker(abort_on_divergence=False)
+        t.record_coordinate(0, "fixed", 10.0)
+        t.record_coordinate(1, "fixed", float("inf"))  # no raise
+        assert not t.healthy
+        assert t.anomaly["anomaly_kind"] == "non_finite_objective"
+        health = t.health()
+        assert health["healthy"] is False
+        assert health["phase"] == "diverged"
+        assert health["anomaly"]["anomaly_kind"] == "non_finite_objective"
+
+    def test_health_and_progress_json(self):
+        t = _tracker()
+        t.record_coordinate(2, "per_user", 33.0)
+        health = t.health()
+        assert health == {
+            "healthy": True, "phase": "training", "outer": 2,
+            "coordinate": "per_user", "objective": 33.0,
+        }
+        doc = t.progress_json()
+        assert doc["num_records"] == 1 and doc["anomaly"] is None
+        json.dumps(doc)  # endpoint payload must be plain JSON
+        t.finish()
+        assert t.health()["phase"] == "finished"
+        t.finish()  # idempotent
+
+
+class TestLedgerRoundTrip:
+    def _write_run(self, path, diverge=False):
+        t = ConvergenceTracker(
+            ledger_path=str(path), registry=MetricsRegistry(),
+            abort_on_divergence=False,
+        )
+        t.record_coordinate(0, "fixed", 120.0, grad_norm=3.0,
+                            solver_iterations=8)
+        t.record_blocks(0, "fixed", [
+            {"block": 0, "partial_loss": 60.0, "partial_grad_norm": 1.5,
+             "gap_estimate": 2.5},
+        ])
+        t.record_validation(0, "fixed", 0.71)
+        t.record_coordinate(0, "per_user", 100.0)
+        if diverge:
+            t.record_coordinate(1, "fixed", float("nan"))
+        t.finish()
+        return t
+
+    def test_progress_ledger_schema_round_trip(self, tmp_path):
+        path = tmp_path / "progress.jsonl"
+        self._write_run(path)
+        records = validate_ledger(str(path))
+        assert records[0]["type"] == "meta"
+        assert records[0]["phase"] == "start"
+        assert records[-1]["type"] == "meta"
+        assert records[-1]["phase"] == "finish"
+        assert records[-1]["healthy"] is True
+        progress = extract_progress_records(records)
+        assert [r["kind"] for r in progress] == [
+            "coordinate", "block", "validation", "coordinate"
+        ]
+        assert all("ts" in r for r in progress)
+
+    def test_anomaly_and_nan_round_trip(self, tmp_path):
+        path = tmp_path / "progress.jsonl"
+        self._write_run(path, diverge=True)
+        records = validate_ledger(str(path))
+        assert records[-1]["healthy"] is False
+        anomaly = [r for r in extract_progress_records(records)
+                   if r["kind"] == "anomaly"]
+        assert len(anomaly) == 1
+        assert anomaly[0]["anomaly_kind"] == "non_finite_objective"
+        # the NaN objective survives the JSONL round trip
+        assert math.isnan(anomaly[0]["objective"])
+
+    def test_truncated_tail_tolerated(self, tmp_path):
+        path = tmp_path / "progress.jsonl"
+        self._write_run(path)
+        with open(path, "a") as f:
+            f.write('{"type": "progress", "kind": "coordina')  # crash cut
+        with pytest.warns(TruncatedLedgerWarning, match="partial record"):
+            records = validate_ledger(str(path))
+        assert len(extract_progress_records(records)) == 4
+
+    def test_validator_rejects_malformed_progress(self, tmp_path):
+        path = tmp_path / "bad.jsonl"
+        path.write_text(
+            '{"type": "progress", "ts": 1.0, "kind": "coordinate", '
+            '"outer": 0}\n'  # missing coordinate + objective
+        )
+        with pytest.raises(ValueError, match="progress"):
+            validate_ledger(str(path))
+        path.write_text('{"type": "progress", "ts": 1.0}\n')  # no kind
+        with pytest.raises(ValueError, match="progress"):
+            validate_ledger(str(path))
+        path.write_text(
+            '{"type": "progress", "ts": 1.0, "kind": "block", "outer": 0, '
+            '"coordinate": "fixed", "block": 0, "partial_loss": 1.0}\n'
+        )  # block record missing grad norm + gap
+        with pytest.raises(ValueError, match="progress"):
+            validate_ledger(str(path))
+
+
+def _synthetic_progress():
+    """Two coordinates over four outer iterations, converging, with a
+    streamed fixed coordinate reporting block stats on every outer."""
+    recs = []
+    objectives = {
+        0: [("fixed", 300.0), ("per_user", 200.0)],
+        1: [("fixed", 110.0), ("per_user", 100.05)],
+        2: [("fixed", 100.04), ("per_user", 100.02)],
+        3: [("fixed", 100.01), ("per_user", 100.0)],
+    }
+    for outer, pairs in objectives.items():
+        for cid, obj in pairs:
+            if cid == "fixed":
+                for b in range(2):
+                    recs.append({
+                        "kind": "block", "outer": outer, "coordinate": cid,
+                        "block": b, "partial_loss": obj / 2 + b,
+                        "partial_grad_norm": 1.0 / (outer + 1),
+                        "gap_estimate": 10.0 / (outer + 1) + b,
+                    })
+            recs.append({
+                "kind": "coordinate", "outer": outer, "coordinate": cid,
+                "objective": obj, "solver_iterations": 5,
+            })
+        recs.append({
+            "kind": "validation", "outer": outer, "coordinate": "per_user",
+            "metric": 0.9 - 0.1 * outer,
+        })
+    return recs
+
+
+class TestConvergenceReport:
+    def test_reconstruction(self):
+        report = convergence_report(_synthetic_progress(), tolerance=1e-3)
+        assert report["num_updates"] == 8
+        assert report["first_objective"] == 300.0
+        assert report["final_objective"] == 100.0
+        assert report["objective_drop"] == 200.0
+        # objective settles within 0.1% of 100.0 at the 4th update (100.05)
+        assert report["iterations_to_tolerance"] == 4
+        assert report["final_validation_metric"] == pytest.approx(0.6)
+        coords = report["coordinates"]
+        assert set(coords) == {"fixed", "per_user"}
+        assert coords["fixed"]["updates"] == 4
+        assert coords["fixed"]["solver_iterations"] == 20
+        # the consecutive drops partition the whole 300 -> 100 descent, so
+        # the attributed shares sum to 1
+        share_sum = sum(c["objective_share"] for c in coords.values())
+        assert share_sum == pytest.approx(1.0)
+        assert coords["per_user"]["stalled"]  # last two deltas ~0
+        assert not coords["fixed"]["stalled"]  # still dropping 9.96 at n-2
+        blocks = report["blocks"]["fixed"]["final_pass"]
+        # final_pass keeps the LAST outer's stats per block
+        assert set(blocks) == {0, 1}
+        assert blocks[1]["gap_estimate"] == pytest.approx(10.0 / 4 + 1)
+        assert report["blocks"]["fixed"]["gap_max"] == pytest.approx(
+            10.0 / 4 + 1
+        )
+
+    def test_iterations_to_target_metric(self):
+        progress = _synthetic_progress()
+        assert iterations_to_target_metric(
+            progress, 0.75, higher_is_better=False
+        ) == 3  # validation hits 0.7 at outer 2 (0-based) -> 3rd outer
+        assert iterations_to_target_metric(
+            progress, 0.95, higher_is_better=False
+        ) == 1
+        assert iterations_to_target_metric(
+            progress, 0.5, higher_is_better=False
+        ) is None
+
+    def test_empty_and_format(self):
+        empty = convergence_report([])
+        assert empty["num_updates"] == 0
+        assert "first_objective" not in empty
+        text = format_progress_report(convergence_report(
+            _synthetic_progress()
+        ))
+        assert "== convergence report ==" in text
+        assert "iters-to-tolerance : 4" in text
+        assert "fixed" in text and "per_user" in text
+        assert "streamed blocks [fixed]: 2 blocks" in text
+        # anomalies render loudly
+        bad = convergence_report(_synthetic_progress() + [{
+            "kind": "anomaly", "anomaly_kind": "objective_increase",
+            "outer": 3, "coordinate": "fixed", "objective": 500.0,
+            "detail": {},
+        }])
+        assert "ANOMALY: objective_increase" in format_progress_report(bad)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestLiveIntrospection:
+    def test_progress_endpoint_and_healthz_503(self):
+        from photon_ml_tpu.serving import IntrospectionServer
+
+        reg = MetricsRegistry()
+        t = _tracker(registry=reg, abort_on_divergence=False)
+        srv = IntrospectionServer(
+            registry=reg,
+            health=t.health,
+            extra_json={"/progress": t.progress_json},
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            t.record_coordinate(0, "fixed", 42.0)
+            status, body = _get(f"{base}/progress")
+            doc = json.loads(body)
+            assert status == 200 and doc["healthy"] is True
+            assert doc["records"][0]["objective"] == 42.0
+            status, body = _get(f"{base}/healthz")
+            assert status == 200
+            assert json.loads(body)["coordinate"] == "fixed"
+            # watchdog trips -> /healthz flips 503, /progress still serves
+            t.record_coordinate(1, "fixed", float("nan"))
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(f"{base}/healthz")
+            assert err.value.code == 503
+            doc = json.loads(err.value.read().decode())
+            assert doc["healthy"] is False
+            assert doc["anomaly"]["anomaly_kind"] == "non_finite_objective"
+            status, body = _get(f"{base}/progress")
+            assert status == 200
+            assert json.loads(body)["anomaly"] is not None
+            # the registry-backed /metrics sees the progress counters too
+            status, body = _get(f"{base}/metrics")
+            assert "photon_progress_coordinate_updates 2" in body
+            assert "photon_progress_anomalies 1" in body
+        finally:
+            srv.stop()
+
+
+class TestAnalyzeRunProgress:
+    def test_renders_report_from_ledger(self, tmp_path, capsys):
+        from photon_ml_tpu.cli.analyze_run import main
+
+        path = tmp_path / "progress.jsonl"
+        t = ConvergenceTracker(
+            ledger_path=str(path), registry=MetricsRegistry()
+        )
+        for outer, obj in enumerate([250.0, 120.0, 119.9]):
+            t.record_coordinate(outer, "fixed", obj, solver_iterations=4)
+        t.finish()
+        assert main([str(path), "--progress"]) == 0
+        out = capsys.readouterr().out
+        assert "== convergence report ==" in out
+        assert "250 -> 119.9" in out
+
+    def test_missing_progress_exits_nonzero(self, tmp_path, capsys):
+        from photon_ml_tpu.cli.analyze_run import main
+        from photon_ml_tpu.telemetry import RunLedger
+
+        path = tmp_path / "plain.jsonl"
+        ledger = RunLedger(str(path))
+        ledger.write("meta", phase="start", label="t")
+        ledger.write("meta", phase="finish", label="t")
+        ledger.close()
+        assert main([str(path), "--progress"]) == 1
+        assert "no progress records" in capsys.readouterr().err
+
+    def test_progress_records_are_known_types(self, tmp_path):
+        """analyze_ledger must count progress records as known record
+        types (no unknown-type warnings) and attach the report."""
+        from photon_ml_tpu.telemetry.analyze import analyze_ledger
+
+        path = tmp_path / "progress.jsonl"
+        t = ConvergenceTracker(
+            ledger_path=str(path), registry=MetricsRegistry()
+        )
+        t.record_coordinate(0, "fixed", 10.0)
+        t.finish()
+        report = analyze_ledger(str(path))
+        assert report.progress is not None
+        assert report.progress["num_updates"] == 1
+        # round trip through the structured report dict stays stable
+        assert report.to_dict()["progress"]["num_updates"] == 1
+
+
+class TestConvergenceSentinel:
+    def _ledger(self, tmp_path, objectives, metrics=(), anomaly=False):
+        path = tmp_path / "fresh.jsonl"
+        t = ConvergenceTracker(
+            ledger_path=str(path), registry=MetricsRegistry(),
+            abort_on_divergence=False, divergence_tolerance=float("inf"),
+        )
+        for outer, obj in enumerate(objectives):
+            t.record_coordinate(outer, "fixed", obj)
+        for outer, m in enumerate(metrics):
+            t.record_validation(outer, "fixed", m)
+        if anomaly:
+            t.record_coordinate(len(objectives), "fixed", float("nan"))
+        t.finish()
+        return str(path)
+
+    def _history(self, tmp_path, final_obj=100.0, iters=3, target=None):
+        path = tmp_path / "history.jsonl"
+        recs = [
+            {"ts": 1.0, "mode": "convergence",
+             "metric": "golden_fixture_final_objective",
+             "value": final_obj, "unit": "objective", "host": "x"},
+            {"ts": 1.0, "mode": "convergence",
+             "metric": "golden_fixture_iterations_to_tol",
+             "value": iters, "unit": "updates", "host": "x"},
+        ]
+        if target is not None:
+            recs.append(
+                {"ts": 1.0, "mode": "convergence",
+                 "metric": "golden_fixture_iterations_to_target",
+                 "value": target, "unit": "updates", "host": "x"})
+        path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        return str(path)
+
+    def test_matching_trajectory_passes(self, tmp_path):
+        mod = _load_sentinel()
+        ledger = self._ledger(tmp_path, [300.0, 150.0, 100.0, 100.01])
+        history = self._history(tmp_path, final_obj=100.0, iters=3)
+        assert mod.main([ledger, "--history", history]) == 0
+
+    def test_degraded_final_objective_fails(self, tmp_path):
+        mod = _load_sentinel()
+        # converges just as fast, but to a 50% worse objective
+        ledger = self._ledger(tmp_path, [300.0, 160.0, 150.0, 150.0])
+        history = self._history(tmp_path, final_obj=100.0, iters=3)
+        assert mod.main([ledger, "--history", history]) == 1
+
+    def test_slower_convergence_fails(self, tmp_path):
+        mod = _load_sentinel()
+        # same final objective, but the trajectory needs 6 updates
+        # (golden 3 + slack 1 allows 4)
+        ledger = self._ledger(
+            tmp_path, [300.0, 250.0, 200.0, 150.0, 120.0, 100.0]
+        )
+        history = self._history(tmp_path, final_obj=100.0, iters=3)
+        assert mod.main([ledger, "--history", history]) == 1
+
+    def test_recorded_anomaly_fails(self, tmp_path):
+        mod = _load_sentinel()
+        ledger = self._ledger(tmp_path, [300.0, 100.0], anomaly=True)
+        history = self._history(tmp_path)
+        assert mod.main([ledger, "--history", history]) == 1
+
+    def test_target_metric_gate(self, tmp_path):
+        mod = _load_sentinel()
+        ledger = self._ledger(
+            tmp_path, [300.0, 150.0, 100.0, 100.0],
+            metrics=[0.9, 0.7, 0.6, 0.6],
+        )
+        history = self._history(tmp_path, final_obj=100.0, iters=3, target=2)
+        assert mod.main([
+            ledger, "--history", history,
+            "--target-metric", "0.75", "--lower-is-better",
+        ]) == 0
+        # golden says the metric should be reached by update 1: fail
+        history_tight = self._history(
+            tmp_path, final_obj=100.0, iters=3, target=1
+        )
+        assert mod.main([
+            ledger, "--history", history_tight,
+            "--target-metric", "0.65", "--lower-is-better",
+        ]) == 1
+
+    def test_infra_problems_report_and_pass(self, tmp_path):
+        mod = _load_sentinel()
+        # no golden baseline records at all: report-and-pass
+        ledger = self._ledger(tmp_path, [300.0, 100.0])
+        empty_hist = tmp_path / "none.jsonl"
+        empty_hist.write_text("")
+        assert mod.main([ledger, "--history", str(empty_hist)]) == 0
+        assert mod.main([
+            ledger, "--history", str(tmp_path / "missing.jsonl")
+        ]) == 0
+        # ledger with no coordinate records: nothing to gate
+        bare = tmp_path / "bare.jsonl"
+        bare.write_text('{"type": "meta", "ts": 1.0, "phase": "start"}\n')
+        assert mod.main([
+            str(bare), "--history", self._history(tmp_path)
+        ]) == 0
+        # crash-truncated tail: the readable prefix is still gated
+        trunc = self._ledger(tmp_path, [300.0, 150.0, 100.0, 100.0])
+        with open(trunc, "a") as f:
+            f.write('{"type": "progress", "kind"')
+        assert mod.main([trunc, "--history", self._history(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Driver-level contracts on a tiny GLMix fit (slow lane).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_glmix(tmp_path_factory):
+    """Tiny logistic GLMix fixture (fixed + per_user) for driver runs."""
+    from photon_ml_tpu.io.data_reader import write_training_examples
+
+    root = tmp_path_factory.mktemp("progress_glmix")
+    rng = np.random.default_rng(7)
+    n_users, rows, dg, du = 6, 10, 4, 2
+    wg = rng.normal(size=dg)
+    wu = {f"user{i}": rng.normal(size=du) for i in range(n_users)}
+
+    def make(n_rows, seed):
+        r = np.random.default_rng(seed)
+        records = []
+        for i in range(n_rows):
+            user = f"user{i % n_users}"
+            xg = r.normal(size=dg)
+            xu = r.normal(size=du)
+            z = xg @ wg + xu @ wu[user]
+            y = 1.0 if 1 / (1 + np.exp(-z)) > r.random() else 0.0
+            records.append({
+                "uid": f"r{i}",
+                "label": y,
+                "features": [("g", str(j), xg[j]) for j in range(dg)],
+                "userFeatures": [("u", str(j), xu[j]) for j in range(du)],
+                "metadataMap": {"userId": user},
+            })
+        return records
+
+    train_dir = root / "train"
+    test_dir = root / "test"
+    train_dir.mkdir()
+    test_dir.mkdir()
+    write_training_examples(
+        str(train_dir / "part-00000.avro"), make(n_users * rows, 1)
+    )
+    write_training_examples(
+        str(test_dir / "part-00000.avro"), make(n_users * 4, 2)
+    )
+    config = {
+        "feature_shards": {
+            "global": {"feature_bags": ["features"], "add_intercept": True},
+            "per_user": {
+                "feature_bags": ["userFeatures"], "add_intercept": False,
+            },
+        },
+        "coordinates": {
+            "fixed": {
+                "type": "fixed",
+                "feature_shard": "global",
+                "optimizer": {
+                    "optimizer": "LBFGS",
+                    "regularization": "L2",
+                    "regularization_weight": 0.1,
+                },
+            },
+            "per_user": {
+                "type": "random",
+                "feature_shard": "per_user",
+                "random_effect_type": "userId",
+                "optimizer": {
+                    "optimizer": "LBFGS",
+                    "regularization": "L2",
+                    "regularization_weight": 1.0,
+                },
+            },
+        },
+        "update_order": ["fixed", "per_user"],
+    }
+    cfg_path = root / "game.json"
+    cfg_path.write_text(json.dumps(config))
+    return {"train": train_dir, "test": test_dir, "config": cfg_path}
+
+
+def _train_argv(tiny_glmix, out, extra=()):
+    return [
+        "--train-data-dirs", str(tiny_glmix["train"]),
+        "--validation-data-dirs", str(tiny_glmix["test"]),
+        "--coordinate-config", str(tiny_glmix["config"]),
+        "--task", "LOGISTIC_REGRESSION",
+        "--output-dir", str(out),
+        "--evaluator", "AUC",
+        "--num-outer-iterations", "2",
+        *extra,
+    ]
+
+
+@pytest.mark.slow
+class TestDriverProgressContracts:
+    def test_progress_out_end_to_end(self, tiny_glmix, tmp_path):
+        """A --progress-out run writes a schema-valid ledger whose records
+        reconstruct into a convergence report, and the introspection port
+        file carries the bound ephemeral port."""
+        from photon_ml_tpu.cli.train_game import main
+
+        ledger_path = tmp_path / "progress.jsonl"
+        port_file = tmp_path / "port"
+        rc = main(_train_argv(tiny_glmix, tmp_path / "out", extra=(
+            "--progress-out", str(ledger_path),
+            "--introspect-port", "0",
+            "--introspect-port-file", str(port_file),
+        )))
+        assert rc == 0
+        assert int(port_file.read_text()) > 0
+        records = validate_ledger(str(ledger_path))
+        assert records[-1]["phase"] == "finish"
+        assert records[-1]["healthy"] is True
+        progress = extract_progress_records(records)
+        coords = [r for r in progress if r["kind"] == "coordinate"]
+        # 2 outers x 2 coordinates, all finite, with solver joins on the
+        # fixed coordinate
+        assert len(coords) == 4
+        assert all(math.isfinite(r["objective"]) for r in coords)
+        fixed = [r for r in coords if r["coordinate"] == "fixed"]
+        assert all("solver_iterations" in r for r in fixed)
+        assert all("coef_delta_norm" in r for r in fixed)
+        vals = [r for r in progress if r["kind"] == "validation"]
+        assert len(vals) == 4
+        report = convergence_report(progress)
+        assert report["num_updates"] == 4
+        assert report["final_objective"] <= report["first_objective"]
+
+    def test_divergence_injection_aborts_without_artifact(
+        self, tiny_glmix, tmp_path, monkeypatch
+    ):
+        """An Inf objective mid-fit must emit AnomalyEvent, exit nonzero,
+        record the anomaly in the ledger, and save NO model artifact."""
+        from tests._listeners import CollectingListener
+
+        from photon_ml_tpu.algorithm.coordinate_descent import (
+            CoordinateDescent,
+        )
+        from photon_ml_tpu.cli.train_game import main
+
+        orig = CoordinateDescent._record_progress
+        calls = {"n": 0}
+
+        def poisoned(self, outer, cid, coord, prev_model, model, objective,
+                     loss, regularization):
+            calls["n"] += 1
+            if calls["n"] >= 2:  # second coordinate update blows up
+                objective = float("inf")
+            orig(self, outer, cid, coord, prev_model, model, objective,
+                 loss, regularization)
+
+        monkeypatch.setattr(
+            CoordinateDescent, "_record_progress", poisoned
+        )
+        CollectingListener.received = []
+        out = tmp_path / "out"
+        ledger_path = tmp_path / "progress.jsonl"
+        rc = main(_train_argv(tiny_glmix, out, extra=(
+            "--progress-out", str(ledger_path),
+            "--event-listeners", "tests._listeners.CollectingListener",
+        )))
+        assert rc == 2
+        assert calls["n"] == 2  # aborted at the poisoned update
+        assert not (out / "best").exists()  # no garbage artifact
+        anomalies = [e for e in CollectingListener.received
+                     if isinstance(e, AnomalyEvent)]
+        assert len(anomalies) == 1
+        assert anomalies[0].kind == "non_finite_objective"
+        records = validate_ledger(str(ledger_path))
+        assert records[-1]["phase"] == "finish"
+        assert records[-1]["healthy"] is False
+        kinds = [r.get("kind") for r in extract_progress_records(records)]
+        assert "anomaly" in kinds
+
+    def test_disabled_default_bitwise_identical(self, tiny_glmix, tmp_path):
+        """The convergence plane must not perturb training: the same tiny
+        fit with and without --progress-out produces bitwise-identical
+        coefficients."""
+        from photon_ml_tpu.cli.train_game import parse_args, run
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        def train(tag, progress):
+            out = tmp_path / tag
+            extra = (
+                ("--progress-out", str(out / "progress.jsonl"))
+                if progress else ()
+            )
+            run(parse_args(_train_argv(tiny_glmix, out, extra=extra)))
+            model, _ = load_game_model(str(out / "best"))
+            return model
+
+        plain = train("plain", progress=False)
+        tracked = train("tracked", progress=True)
+        fixed_p = np.asarray(plain.models["fixed"].coefficients.means)
+        fixed_t = np.asarray(tracked.models["fixed"].coefficients.means)
+        np.testing.assert_array_equal(fixed_p, fixed_t)
+        re_p = dict(plain.models["per_user"].items())
+        re_t = dict(tracked.models["per_user"].items())
+        assert re_p == re_t
+
+    def test_progress_rejects_sweep_configs(self, tiny_glmix, tmp_path):
+        """--progress-out tracks ONE fit; a regularization sweep must fail
+        fast instead of interleaving trajectories."""
+        import copy
+
+        from photon_ml_tpu.cli.train_game import parse_args, run
+
+        cfg = json.loads(tiny_glmix["config"].read_text())
+        sweep = copy.deepcopy(cfg)
+        opt = sweep["coordinates"]["fixed"]["optimizer"]
+        opt.pop("regularization_weight")
+        opt["regularization_weights"] = [0.1, 10.0]
+        cfg_path = tmp_path / "sweep.json"
+        cfg_path.write_text(json.dumps(sweep))
+        argv = _train_argv(tiny_glmix, tmp_path / "out")
+        argv[argv.index(str(tiny_glmix["config"]))] = str(cfg_path)
+        with pytest.raises(ValueError, match="ONE fit"):
+            run(parse_args(argv + ["--progress-out",
+                                   str(tmp_path / "p.jsonl")]))
