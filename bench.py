@@ -739,6 +739,18 @@ SRV_DEADLINE_S = 0.002                      # continuous-batching deadline
 # measure the admitted steady state; the best pass is the headline (the
 # shared host is noisy run-to-run) and every pass's numbers are recorded
 SRV_REPLAY_REPS = 1 if _SMOKE else 5
+# eviction-policy A/B: a tight device budget + entity ids permuted away
+# from the packed row order (an UNSORTED artifact — popularity no longer
+# aligned with the pinned base prefix), so most of the Zipf mass flows
+# through admission headroom and the victim rule decides who stays. The
+# admit batch must be well under the headroom (0.25 × budget): waves
+# larger than the headroom evict their own cohort and no policy can win
+# full scale: ~20k Zipf(1.3) draws touch only a few thousand distinct
+# entities, so the budget must sit well under that (headroom well under
+# the distinct deferred set) or neither policy ever has to evict
+EV_BUDGET = 192 if _SMOKE else 2048
+EV_ADMIT = 8 if _SMOKE else 64              # rows per fixed-shape admit step
+EV_CHUNK = 128                              # synchronous replay batch rows
 _SERVING_PATH = os.path.join(_REPO, "BENCH_SERVING.json")
 
 
@@ -873,6 +885,77 @@ def _serving_bench():
             gc.enable()
             admission.stop()
         snapshot = max(reps, key=lambda s: s.get("replay_requests_per_s", 0.0))
+
+        # --- eviction-policy A/B: oldest (FIFO) vs importance (freq × norm)
+        # victim selection at an admission-bound budget. The replay is
+        # synchronous (score chunk → admission steps) so both arms see an
+        # IDENTICAL request/admission interleaving; the only degree of
+        # freedom is who gets evicted. Headline: post-warmup
+        # device_resident_rate at equal device_budget_rows.
+        perm = np.random.default_rng(SEED + 3).permutation(N_SRV_ENT)
+        ab_requests = [
+            ScoreRequest(
+                request_id=f"e{i}",
+                features=requests[i].features,
+                entity_ids={"userId": f"u{perm[ent[i]]}"},
+            )
+            for i in range(N_SRV_REQ)
+        ]
+
+        def _eviction_arm(policy):
+            s = ShardedGameScorer(
+                artifact,
+                max_nnz={"global": K_SRV_FE, "per_user": D_SRV_RE},
+                num_shards=SRV_SHARDS,
+                device_budget_rows=EV_BUDGET,
+                eviction_policy=policy,
+            )
+            adm = AdmissionController([s], admit_batch=EV_ADMIT)
+            s.attach_admission(adm)
+            adm.warmup()
+            routing = s.routing["per_user"]
+
+            def _pass():
+                for lo in range(0, len(ab_requests), EV_CHUNK):
+                    s.score_batch(
+                        ab_requests[lo:lo + EV_CHUNK], bucket_size=EV_CHUNK
+                    )
+                    # a couple of fixed-shape admit steps per chunk: the
+                    # cadence the async thread sustains, made deterministic
+                    adm.step()
+                    adm.step()
+
+            _pass()  # warmup: residency + the frequency plane fill in
+            warm_c = s.compile_count
+            routing.reset_counters()
+            _pass()  # measured
+            st = routing.stats()
+            total = max(1, int(st["total_lookups"]))
+            arm = {
+                "device_resident_rate": round(
+                    st["resident_lookups"] / total, 4
+                ),
+                "deferred_rate": round(st["deferred_lookups"] / total, 4),
+                "evicted_total": int(st["evicted_total"]),
+                "admitted_total": int(st["admitted_total"]),
+                "post_warmup_compiles": s.compile_count - warm_c,
+            }
+            if policy == "importance":
+                arm["importance_mean"] = round(st["importance_mean"], 4)
+                arm["importance_max"] = round(st["importance_max"], 4)
+            return arm
+
+        eviction_ab = {
+            "device_budget_rows": EV_BUDGET,
+            "chunk_rows": EV_CHUNK,
+            "oldest": _eviction_arm("oldest"),
+            "importance": _eviction_arm("importance"),
+        }
+        eviction_ab["resident_rate_gain"] = round(
+            eviction_ab["importance"]["device_resident_rate"]
+            - eviction_ab["oldest"]["device_resident_rate"], 4
+        )
+
         payload = {
             "metric": "serving_p99_latency_s",
             "value": snapshot.get("latency_p99_s", 0.0),
@@ -901,6 +984,7 @@ def _serving_bench():
             "post_warmup_compiles": (
                 max(s.compile_count for s in scorers) - warm_compiles
             ),
+            "eviction_ab": eviction_ab,
             "backend": jax.default_backend(),
             **{
                 k: snapshot[k]
@@ -919,6 +1003,14 @@ def _serving_bench():
             with open(_SERVING_PATH, "w") as f:
                 json.dump(payload, f, indent=2)
         _append_history(payload, "serving")
+        _append_history(
+            {
+                "metric": "eviction_resident_rate_gain",
+                "value": eviction_ab["resident_rate_gain"],
+                "unit": "importance_minus_oldest_resident_rate",
+            },
+            "serving_eviction",
+        )
     except Exception as e:  # noqa: BLE001 - one JSON line per exit path
         print(json.dumps({
             "metric": "serving_p99_latency_s",
@@ -1102,6 +1194,232 @@ N_ST_FILES = 3 if _SMOKE else 12            # Avro part files
 ST_BLOCK_ROWS = 128 if _SMOKE else 8192     # rows per streamed block
 ST_PREFETCH = 2
 _STREAMING_PATH = os.path.join(_REPO, "BENCH_STREAMING.json")
+
+# gap-guided scheduling A/B (DuHL): a skewed dataset where only every
+# GS_HARD_EVERY-th block carries the real logistic signal; the rest are
+# "easy" blocks (near-zero features, constant label) the model fits in one
+# bootstrap visit, after which their duality gap collapses. The shuffled
+# baseline keeps re-visiting them anyway; the gap scheduler should not.
+# Hard blocks are deliberately ill-conditioned — anisotropic feature
+# scales with the signal concentrated in the SMALL-scale coordinates — so
+# each one-iteration visit makes bounded progress and the trajectory keeps
+# rising for many epochs instead of saturating inside the bootstrap pass.
+# Per-block shapes REUSE the main streaming fixture (same block_rows, same
+# feature dim), and the A/B drives the solver seam directly — never the
+# coordinate's row-plane programs, whose static padded-rows argument would
+# retrace at this dataset size — so the A/B compiles ZERO new programs
+# beyond the stochastic solver family and the all-traces-once contract
+# covers both fits and the A/B together.
+GS_HARD_EVERY = 4
+GS_NUM_BLOCKS = 12 if _SMOKE else 16        # total blocks (1 in 4 hard)
+GS_EPOCH_CAP = 10 if _SMOKE else 16         # epochs per arm, both arms
+GS_TARGET_FRACTION = 0.95                   # of the shuffle arm's AUC lift
+GS_VISIT_FRACTION = 0.25                    # gap arm's scheduled working set
+GS_EXPLORE = 0.05                           # stalest-block exploration floor
+GS_CHUNK_ITERS = 1                          # solver iters per block visit
+N_GS_VAL = 512 if _SMOKE else 8192          # held-out rows (hard distribution)
+
+
+def _gap_schedule_ab(tmp):
+    """Stochastic-mode A/B: gap-guided block scheduling vs the blind
+    per-epoch shuffle, measured in BLOCK VISITS to a fixed held-out AUC
+    target (DuHL's currency: decode + H2D + solve work all scale with
+    visits). Returns the fields merged into the --streaming payload."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.io.data_reader import (
+        FeatureShardConfiguration,
+        read_game_data,
+        write_training_examples,
+    )
+    from photon_ml_tpu.opt import (
+        GlmOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.streaming import GapScheduler, StreamingSource
+    from photon_ml_tpu.streaming.coordinate import (
+        StreamingFixedEffectCoordinate,
+        _OwnShardBlocks,
+    )
+    from photon_ml_tpu.streaming.solver import (
+        StreamSolveInfo,
+        solve_streaming_stochastic,
+    )
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    rng = np.random.default_rng(SEED + 7)
+    # anisotropic scales; signal ∝ 1/scale so small-scale coordinates carry
+    # equal AUC weight but converge ~(1/scale)^2 slower under first-order
+    # one-iteration visits (fresh solver state per visit — no curvature
+    # memory), keeping the trajectory rising across many epochs
+    scales = np.logspace(-1.0, 0.0, D_ST).astype(np.float32)
+    w_gs = (
+        rng.normal(size=D_ST) / scales * (2.0 / np.sqrt(D_ST))
+    ).astype(np.float32)
+    n_rows = GS_NUM_BLOCKS * ST_BLOCK_ROWS
+    num_blocks = GS_NUM_BLOCKS
+    # easy blocks: features ~0, label constant — one intercept fit
+    X = (rng.normal(size=(n_rows, D_ST)) * 0.01).astype(np.float32)
+    y = np.ones(n_rows, dtype=np.float32)
+    hard_blocks = []
+    for b in range(0, num_blocks, GS_HARD_EVERY):
+        hard_blocks.append(b)
+        lo = b * ST_BLOCK_ROWS
+        hi = min(lo + ST_BLOCK_ROWS, n_rows)
+        Xb = (rng.normal(size=(hi - lo, D_ST)) * scales).astype(np.float32)
+        X[lo:hi] = Xb
+        p = 1.0 / (1.0 + np.exp(-(Xb @ w_gs)))
+        y[lo:hi] = (p > rng.random(hi - lo)).astype(np.float32)
+    X_va = (rng.normal(size=(N_GS_VAL, D_ST)) * scales).astype(np.float32)
+    y_va = (
+        1.0 / (1.0 + np.exp(-(X_va @ w_gs))) > rng.random(N_GS_VAL)
+    ).astype(np.float32)
+
+    def _records(Xm, ym):
+        for i in range(Xm.shape[0]):
+            yield {
+                "label": float(ym[i]),
+                "features": [
+                    ("f", str(j), float(Xm[i, j])) for j in range(D_ST)
+                ],
+            }
+
+    shard_configs = {
+        "global": FeatureShardConfiguration(
+            feature_bags=("features",), add_intercept=True
+        ),
+    }
+    root = os.path.join(tmp, "gap_ab")
+    os.makedirs(root, exist_ok=True)
+    # file boundaries on block boundaries (last file takes the remainder)
+    # so part-file grouping can deliver its one-decode-per-file guarantee
+    blocks_per_file = GS_HARD_EVERY
+    paths = []
+    fi = 0
+    for lo in range(0, n_rows, blocks_per_file * ST_BLOCK_ROWS):
+        hi = min(lo + blocks_per_file * ST_BLOCK_ROWS, n_rows)
+        p = os.path.join(root, f"part-{fi:05d}.avro")
+        write_training_examples(p, _records(X[lo:hi], y[lo:hi]))
+        paths.append(p)
+        fi += 1
+    val_path = os.path.join(root, "val.avro")
+    write_training_examples(val_path, _records(X_va, y_va))
+
+    source = StreamingSource.open(
+        paths, shard_configs, block_rows=ST_BLOCK_ROWS
+    )
+    val_data, _, _ = read_game_data(
+        [val_path], shard_configs, index_maps=source.index_maps
+    )
+    sh = val_data.feature_shards["global"]
+    v_rows = np.asarray(sh.rows)
+    v_cols = np.asarray(sh.cols)
+    v_vals = np.asarray(sh.vals)
+
+    def _val_auc(w):
+        s = np.zeros(N_GS_VAL, dtype=np.float64)
+        np.add.at(s, v_rows, v_vals * w[v_cols])
+        return _auc(s, y_va)
+
+    l2 = GlmOptimizationConfiguration(
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1e-3,
+    )
+    # the block provider: one coordinate shared by both arms, used ONLY
+    # for its shard-restricted streamed pass (no residual fusion — the
+    # padded row plane's static shape would retrace at this dataset size)
+    coord = StreamingFixedEffectCoordinate(
+        source=source,
+        shard_id="global",
+        task=TaskType.LOGISTIC_REGRESSION,
+        configuration=l2,
+        prefetch_depth=ST_PREFETCH,
+        mode="stochastic",
+        epochs=1,
+        chunk_iters=GS_CHUNK_ITERS,
+        blocks_per_update=1,
+        seed=SEED,
+    )
+    plan = source.plan
+    total_weight = float(np.sum(source.row_planes().weights))
+
+    def _arm(gap: bool):
+        sched = (
+            GapScheduler(
+                plan.num_blocks,
+                plan=plan,
+                visit_fraction=GS_VISIT_FRACTION,
+                explore=GS_EXPLORE,
+                seed=SEED,
+            )
+            if gap
+            else None
+        )
+        w = jnp.zeros((coord.dim,), dtype=jnp.float32)
+        info = StreamSolveInfo()
+        traj = []
+        for epoch in range(GS_EPOCH_CAP):
+            result = solve_streaming_stochastic(
+                coord.objective(),
+                w,
+                make_blocks_ordered=lambda order: _OwnShardBlocks(
+                    coord, None, order
+                ),
+                configuration=l2,
+                num_blocks=plan.num_blocks,
+                total_weight=total_weight,
+                epochs=1,               # one epoch per call: visit accounting
+                chunk_iters=GS_CHUNK_ITERS,
+                blocks_per_update=1,
+                seed=SEED + epoch,      # fresh shuffle stream every epoch
+                info=info,
+                scheduler=sched,
+            )
+            w = result.w
+            traj.append(
+                (
+                    int(info.blocks),
+                    round(_val_auc(np.asarray(w, dtype=np.float64)), 6),
+                )
+            )
+        return traj
+
+    shuffle_traj = _arm(False)
+    gap_traj = _arm(True)
+    best = max(a for _, a in shuffle_traj)
+    target = 0.5 + GS_TARGET_FRACTION * (best - 0.5)
+
+    def _to_target(traj):
+        # sustained crossing: two consecutive points at/above target (the
+        # final point alone qualifies) so a noise-lucky epoch doesn't win
+        for i, (v, a) in enumerate(traj):
+            if a < target:
+                continue
+            if i + 1 == len(traj) or traj[i + 1][1] >= target:
+                return v, True
+        return traj[-1][0], False
+
+    shuffle_visits, shuffle_hit = _to_target(shuffle_traj)
+    gap_visits, gap_hit = _to_target(gap_traj)
+    return {
+        "gap_visits_to_target": gap_visits,
+        "shuffle_visits_to_target": shuffle_visits,
+        "gap_vs_shuffle_visits": round(
+            shuffle_visits / max(gap_visits, 1), 3
+        ),
+        "gap_schedule_ab": {
+            "num_blocks": source.plan.num_blocks,
+            "hard_blocks": hard_blocks,
+            "target_auc": round(target, 6),
+            "target_reached": {"gap": gap_hit, "shuffle": shuffle_hit},
+            "visit_fraction": GS_VISIT_FRACTION,
+            "explore": GS_EXPLORE,
+            "epoch_cap": GS_EPOCH_CAP,
+            "chunk_iters": GS_CHUNK_ITERS,
+            "shuffle_trajectory": shuffle_traj,
+            "gap_trajectory": gap_traj,
+        },
+    }
 
 
 def _streaming_bench():
@@ -1309,6 +1627,10 @@ def _streaming_bench():
             val_data, _, _ = read_game_data(
                 [val_path], shard_configs, index_maps=source.index_maps
             )
+
+            # --- DuHL gap-scheduling A/B (same shapes: zero new retraces
+            # beyond the stochastic solver family, each traced once)
+            gap_fields = _gap_schedule_ab(tmp)
         auc_stream = _auc(
             np.asarray(fit_st.model.score(val_data)), y_va
         )
@@ -1389,6 +1711,7 @@ def _streaming_bench():
             "cpus": os.cpu_count() or 1,
             "decode_workers": source.decode_workers,
             "backend": jax.default_backend(),
+            **gap_fields,
             "telemetry": summarize_telemetry(),
         }
         print(json.dumps(payload))
@@ -1396,6 +1719,14 @@ def _streaming_bench():
             with open(_STREAMING_PATH, "w") as f:
                 json.dump(payload, f, indent=2)
         _append_history(payload, "streaming")
+        _append_history(
+            {
+                "metric": "gap_vs_shuffle_visits",
+                "value": payload["gap_vs_shuffle_visits"],
+                "unit": "x_fewer_block_visits_to_target",
+            },
+            "gap_schedule",
+        )
     except Exception as e:  # noqa: BLE001 - one JSON line per exit path
         print(json.dumps({
             "metric": "streaming_fit_wall_s",

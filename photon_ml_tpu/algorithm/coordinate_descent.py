@@ -266,6 +266,10 @@ class CoordinateDescent:
         block_stats = getattr(coord, "last_block_stats", None)
         if block_stats:
             tracker.record_blocks(outer, cid, block_stats)
+        schedule = getattr(coord, "last_schedule_decisions", None)
+        if schedule:
+            tracker.record_schedule(outer, cid, schedule)
+            coord.last_schedule_decisions = None
         tracker.record_coordinate(
             outer,
             cid,

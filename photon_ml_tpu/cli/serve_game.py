@@ -87,6 +87,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--admit-batch", type=int, default=None,
                    help="rows per async admission step in sharded mode "
                         "(default 64); one fixed-shape scatter per step")
+    p.add_argument("--eviction-policy", choices=("oldest", "importance"),
+                   default="oldest",
+                   help="victim selection when admission needs headroom: "
+                        "'oldest' evicts FIFO (default); 'importance' evicts "
+                        "the lowest request-frequency x coefficient-norm "
+                        "score (docs/SERVING.md)")
     p.add_argument("--batch-deadline-ms", type=float, default=None,
                    help="continuous-batching deadline: a forming bucket is "
                         "scored once its oldest request has waited this "
@@ -223,6 +229,7 @@ def _effective_config(args, artifact, logger) -> dict:
         "shards": int(shards) if shards else 4,
         "device_budget_rows": args.device_budget_rows,
         "admit_batch": int(admit_batch) if admit_batch else 64,
+        "eviction_policy": args.eviction_policy,
         "batch_deadline_ms": (
             float(deadline_ms) if deadline_ms is not None else 2.0
         ),
@@ -506,6 +513,7 @@ def _serve_stream(
                     max_nnz=nnz,
                     num_shards=active["shards"],
                     device_budget_rows=active["device_budget_rows"],
+                    eviction_policy=active["eviction_policy"],
                     routing=routing,
                 )
                 routing = s.routing

@@ -230,6 +230,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "optimizer iteration (exact full-batch, default); "
                         "'stochastic' visits shuffled block groups per epoch "
                         "-- gate it on held-out metric parity first")
+    p.add_argument("--gap-schedule", action="store_true",
+                   help="stochastic streaming only: visit blocks by "
+                        "staleness-decayed duality-gap importance (DuHL) "
+                        "instead of a blind per-epoch shuffle. Epochs "
+                        "concentrate on the blocks with the largest gap "
+                        "estimates (with an exploration floor refreshing "
+                        "stale blocks), typically reaching the target "
+                        "held-out metric in far fewer block visits on "
+                        "skewed data; off is bitwise-identical to the "
+                        "historical shuffle order")
     p.add_argument("--progress-out", default=None, metavar="PROGRESS.jsonl",
                    help="write the convergence-plane ledger here: one JSONL "
                         "record per coordinate update (objective, grad norm, "
@@ -265,6 +275,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         p.error("--prefetch-depth must be >= 0")
     if args.decode_workers < -1:
         p.error("--decode-workers must be >= -1 (-1 = auto)")
+    if args.gap_schedule and not (
+        args.streaming and args.stream_mode == "stochastic"
+    ):
+        p.error(
+            "--gap-schedule requires --streaming with "
+            "--stream-mode stochastic (full-batch mode must visit every "
+            "block per pass to stay exact)"
+        )
     if args.staleness < 0:
         p.error("--staleness must be >= 0")
     if args.parallel_data < 0 or args.parallel_feat < 1:
@@ -857,6 +875,7 @@ def run(args: argparse.Namespace) -> GameFit:
                     checkpoint_dir=args.checkpoint_dir,
                     prefetch_depth=args.prefetch_depth,
                     mode=args.stream_mode,
+                    gap_schedule=args.gap_schedule,
                     progress=progress,
                 )
                 all_fits = [fit]

@@ -610,6 +610,7 @@ class GameEstimator:
         stochastic_chunk_iters: int = 4,
         blocks_per_update: int = 1,
         seed: int = 0,
+        gap_schedule: bool = False,
         progress: Optional[object] = None,
     ) -> GameFit:
         """Out-of-core ``fit``: fixed-effect coordinates stream fixed-shape
@@ -628,6 +629,8 @@ class GameEstimator:
         optimum as in-memory, the default); ``mode='stochastic'`` visits
         shuffled block groups per epoch on the resumable solver seam —
         gate it on held-out metric parity before trusting it.
+        ``gap_schedule=True`` (stochastic only) replaces the blind shuffle
+        with duality-gap-guided block selection (docs/SCALING.md).
         """
         from photon_ml_tpu.streaming.coordinate import (
             StreamingFixedEffectCoordinate,
@@ -685,6 +688,7 @@ class GameEstimator:
                     chunk_iters=stochastic_chunk_iters,
                     blocks_per_update=blocks_per_update,
                     seed=seed,
+                    gap_schedule=gap_schedule,
                     # convergence plane: per-block loss/grad/gap probes run
                     # only when a tracker is attached (bitwise contract)
                     collect_block_stats=progress is not None,

@@ -195,6 +195,12 @@ class AdmissionController:
             shards[: fresh.size] = a_shards
             slots[: fresh.size] = a_slots
             buf = self._stage(cid, primary, fresh, k)
+            # importance plane: the staged rows ARE the admitted content,
+            # so their L2 norms are free here (no-op under the default
+            # eviction policy)
+            routing.note_row_norms(
+                fresh, np.linalg.norm(buf[: fresh.size], axis=1)
+            )
             for scorer in self._scorers:
                 # the donated scatter invalidates the replica's previous
                 # table array; its write_lock keeps that away from a
@@ -277,6 +283,15 @@ class AdmissionController:
         return total
 
     def stats(self) -> Dict[str, float]:
+        # eviction reasons, aggregated over the (shared) routing truth —
+        # scorer 0's providers see every eviction the replicas share
+        evicted_by_policy = {"oldest": 0, "importance": 0}
+        for provider in getattr(self._scorers[0], "_providers", {}).values():
+            r = provider.routing
+            evicted_by_policy["oldest"] += getattr(r, "evicted_oldest", 0)
+            evicted_by_policy["importance"] += getattr(
+                r, "evicted_importance", 0
+            )
         return {
             "admit_batch": self.admit_batch,
             "admitted_total": self.admitted_total,
@@ -286,4 +301,5 @@ class AdmissionController:
             "queue_depth": self.queue_depth,
             "steps": self.steps,
             "replicas": len(self._scorers),
+            "evicted_by_policy": evicted_by_policy,
         }

@@ -53,12 +53,16 @@ class CoordinateRouting:
     the cyclic layout; the remaining device slots are admission headroom.
     """
 
+    #: batches between EWMA halvings of the request-frequency plane
+    FREQ_DECAY_EVERY = 64
+
     def __init__(
         self,
         n_rows: int,
         num_shards: int,
         shard_capacity: int,
         resident_rows: Optional[int] = None,
+        eviction_policy: str = "oldest",
     ):
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
@@ -66,9 +70,15 @@ class CoordinateRouting:
             raise ValueError(
                 f"shard_capacity must be >= 1, got {shard_capacity}"
             )
+        if eviction_policy not in ("oldest", "importance"):
+            raise ValueError(
+                "eviction_policy must be 'oldest' or 'importance', got "
+                f"{eviction_policy!r}"
+            )
         self.n_rows = int(n_rows)
         self.num_shards = int(num_shards)
         self.shard_capacity = int(shard_capacity)
+        self.eviction_policy = eviction_policy
         # serializes WRITERS (allocate/publish/grow/unpublish and every
         # multi-step sequence built on them); re-entrant so a caller
         # holding it for a compound mutation can still call the
@@ -100,12 +110,32 @@ class CoordinateRouting:
         # admitted (evictable) rows, oldest first
         self._admitted: Deque[int] = deque()
 
+        # importance plane (DuHL-style cache value, arxiv 1702.07005):
+        # per-row EWMA request frequency × coefficient-row magnitude — the
+        # magnitude bounds the score delta vs the FE-only fallback
+        # (|Δscore| <= ||w_r||·||x||), so freq × norm approximates the
+        # expected score impact of keeping the row resident. Tracked only
+        # under the "importance" policy (the default path allocates
+        # nothing); both planes are stats-grade — written without the
+        # routing lock from the scoring thread; eviction reads them under
+        # the lock, and a torn read can at worst mis-rank one victim,
+        # never corrupt placement.
+        if eviction_policy == "importance":
+            self._freq = np.zeros(max(self.n_rows, 1), dtype=np.float64)
+            self._norm = np.zeros(max(self.n_rows, 1), dtype=np.float32)
+        else:
+            self._freq = None
+            self._norm = None
+        self._freq_batches = 0
+
         # lookup accounting (reset via reset_counters)
         self.resident_lookups = 0
         self.deferred_lookups = 0  # known entity, not yet device-resident
         self.cold_lookups = 0  # entity absent from the model
         self.admitted_total = 0
         self.evicted_total = 0
+        self.evicted_oldest = 0
+        self.evicted_importance = 0
 
     # ---------------------------------------------------------------- route
 
@@ -148,6 +178,46 @@ class CoordinateRouting:
         )
         return shards, slots, deferred
 
+    # ------------------------------------------------- importance tracking
+
+    def note_requests(self, entity_rows: np.ndarray) -> None:
+        """Fold one request batch into the EWMA frequency plane (called by
+        the scorer's route step; no-op under the default policy). Every
+        ``FREQ_DECAY_EVERY`` batches the whole plane halves, so frequency
+        is an exponential window over recent traffic, not an all-time
+        count that would pin formerly-hot rows forever."""
+        if self._freq is None:
+            return
+        rows = np.asarray(entity_rows, dtype=np.int64).ravel()
+        rows = rows[(rows >= 0) & (rows < self._freq.size)]
+        if rows.size:
+            np.add.at(self._freq, rows, 1.0)
+        self._freq_batches += 1
+        if self._freq_batches >= self.FREQ_DECAY_EVERY:
+            self._freq_batches = 0
+            self._freq *= 0.5
+
+    def note_row_norms(self, rows: np.ndarray, norms: np.ndarray) -> None:
+        """Record the L2 magnitude of rows' coefficient content (called on
+        admission and hot-swap writes; no-op under the default policy)."""
+        if self._norm is None:
+            return
+        rows = np.asarray(rows, dtype=np.int64).ravel()
+        norms = np.asarray(norms, dtype=np.float32).ravel()
+        keep = (rows >= 0) & (rows < self._norm.size)
+        if keep.any():
+            self._norm[rows[keep]] = norms[keep]
+
+    def importance_of(self, rows: np.ndarray) -> np.ndarray:
+        """freq × max(norm, ε) per row — ε keeps frequency meaningful for
+        rows admitted through paths that never reported a norm."""
+        if self._freq is None:
+            return np.zeros(np.asarray(rows).size, dtype=np.float64)
+        rows = np.asarray(rows, dtype=np.int64).ravel()
+        return self._freq[rows] * np.maximum(
+            self._norm[rows].astype(np.float64), 1e-12
+        )
+
     def is_resident(self, row: int) -> bool:
         return 0 <= row < self.n_rows and self._slot_of[row] >= 0
 
@@ -162,8 +232,18 @@ class CoordinateRouting:
         ``(shards, slots)`` plus the list of rows EVICTED to make room
         (already unpublished here — the caller must zero/overwrite their
         device slots before publishing new occupants). Raises when the
-        coordinate has fewer than ``k`` evictable slots in total."""
+        coordinate has fewer than ``k`` evictable slots in total.
+
+        Victim selection is the ``eviction_policy``: ``oldest`` (default,
+        the historical FIFO — byte-identical behavior) pops the
+        longest-admitted row; ``importance`` evicts the admitted rows with
+        the LOWEST freq × norm score (see :meth:`importance_of`), so a hot
+        long-tail row survives arbitrarily many admission waves while a
+        one-hit row is recycled first — the DuHL cache policy applied to
+        device residency."""
         with self.lock:
+            if self.eviction_policy == "importance":
+                return self._allocate_importance(k)
             shards = np.empty(k, dtype=np.int32)
             slots = np.empty(k, dtype=np.int32)
             evicted: List[int] = []
@@ -177,6 +257,7 @@ class CoordinateRouting:
                     # victim fall back to FE-only from this point on
                     self._slot_of[victim] = -1
                     self.evicted_total += 1
+                    self.evicted_oldest += 1
                     evicted.append(victim)
                 else:
                     raise RuntimeError(
@@ -188,6 +269,70 @@ class CoordinateRouting:
                 shards[i] = shard
                 slots[i] = slot
             return shards, slots, evicted
+
+    def _allocate_importance(
+        self, k: int
+    ) -> Tuple[np.ndarray, np.ndarray, List[int]]:
+        """allocate() under the importance policy (caller holds the lock).
+
+        Victims are chosen by POSITION in the admitted deque, not by row
+        value: the deque can hold stale entries for rows already
+        unpublished by a hot swap (and, after a re-admission, duplicates),
+        so value-based removal would corrupt the capacity bookkeeping.
+        Only the first live position of each row is evictable; stale
+        positions are dropped during the rebuild."""
+        shards = np.empty(k, dtype=np.int32)
+        slots = np.empty(k, dtype=np.int32)
+        evicted: List[int] = []
+        take_free = min(k, len(self._free))
+        for i in range(take_free):
+            shards[i], slots[i] = self._free.popleft()
+        need = k - take_free
+        if need == 0:
+            return shards, slots, evicted
+        adm = np.fromiter(
+            self._admitted, dtype=np.int64, count=len(self._admitted)
+        )
+        live = self._slot_of[adm] >= 0
+        if live.any():
+            # duplicates (re-published rows): only the first position per
+            # row is "the" resident entry
+            first = np.zeros(adm.size, dtype=bool)
+            _, first_pos = np.unique(adm, return_index=True)
+            first[first_pos] = True
+            live &= first
+        live_pos = np.nonzero(live)[0]
+        if need > live_pos.size:
+            raise RuntimeError(
+                f"no admission headroom: {self.base_rows} base rows "
+                f"fill all {self.num_shards}x{self.shard_capacity} "
+                "device slots — raise the device budget or lower "
+                "the resident base"
+            )
+        score = self.importance_of(adm[live_pos])
+        if need < live_pos.size:
+            pick = live_pos[np.argpartition(score, need - 1)[:need]]
+        else:
+            pick = live_pos
+        for i, pos in enumerate(pick):
+            victim = int(adm[pos])
+            shard, slot = self.placement(victim)
+            self._slot_of[victim] = -1
+            self.evicted_total += 1
+            self.evicted_importance += 1
+            evicted.append(victim)
+            shards[take_free + i] = shard
+            slots[take_free + i] = slot
+        # rebuild the deque: surviving live entries keep their order;
+        # picked and stale positions drop out
+        drop = set(int(p) for p in pick)
+        stale = set(int(p) for p in np.nonzero(~live)[0])
+        self._admitted = deque(
+            int(r)
+            for pos, r in enumerate(adm)
+            if pos not in drop and pos not in stale
+        )
+        return shards, slots, evicted
 
     def publish(
         self, rows: np.ndarray, shards: np.ndarray, slots: np.ndarray
@@ -221,6 +366,13 @@ class CoordinateRouting:
                 )
                 self._shard_of = shard_of
                 self._slot_of = slot_of
+                if self._freq is not None:
+                    self._freq = np.concatenate(
+                        [self._freq, np.zeros(extra, dtype=np.float64)]
+                    )
+                    self._norm = np.concatenate(
+                        [self._norm, np.zeros(extra, dtype=np.float32)]
+                    )
             self.n_rows = n_rows
 
     def unpublish(self, rows: np.ndarray) -> None:
@@ -254,7 +406,7 @@ class CoordinateRouting:
         total = (
             self.resident_lookups + self.deferred_lookups + self.cold_lookups
         )
-        return {
+        out = {
             "num_shards": self.num_shards,
             "shard_capacity": self.shard_capacity,
             "device_rows": self.device_rows,
@@ -266,7 +418,20 @@ class CoordinateRouting:
             "total_lookups": total,
             "admitted_total": self.admitted_total,
             "evicted_total": self.evicted_total,
+            "eviction_policy": self.eviction_policy,
+            "evicted_oldest": self.evicted_oldest,
+            "evicted_importance": self.evicted_importance,
         }
+        if self._freq is not None:
+            with self.lock:
+                adm = np.fromiter(
+                    self._admitted, dtype=np.int64, count=len(self._admitted)
+                )
+                adm = adm[self._slot_of[adm] >= 0] if adm.size else adm
+            imp = self.importance_of(adm)
+            out["importance_mean"] = float(imp.mean()) if imp.size else 0.0
+            out["importance_max"] = float(imp.max()) if imp.size else 0.0
+        return out
 
 
 class RoutingIndex:
@@ -297,6 +462,7 @@ def build_routing(
     num_shards: int,
     device_budget_rows: Optional[int] = None,
     headroom_fraction: float = 0.25,
+    eviction_policy: str = "oldest",
 ) -> RoutingIndex:
     """Routing for a set of RE coordinates (``cid -> n_rows``).
 
@@ -306,6 +472,8 @@ def build_routing(
     without a table rebuild. A finite budget splits into a resident base
     (the first ``(1 - headroom_fraction) * budget`` rows — the packed
     table's hot prefix) and admission headroom for the long tail.
+    ``eviction_policy`` picks the admission victim rule: ``oldest`` (FIFO,
+    the default) or ``importance`` (evict lowest freq × norm).
     """
     coords: Dict[str, CoordinateRouting] = {}
     for cid, n_rows in re_tables.items():
@@ -324,5 +492,6 @@ def build_routing(
             num_shards=num_shards,
             shard_capacity=cap,
             resident_rows=base,
+            eviction_policy=eviction_policy,
         )
     return RoutingIndex(coords)

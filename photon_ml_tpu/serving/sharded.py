@@ -227,6 +227,9 @@ class ShardedReTable:
         with routing.lock:
             if rows.max() >= routing.n_rows:
                 routing.grow(int(rows.max()) + 1)
+            # importance plane: swapped-in content defines the rows' new
+            # magnitude (no-op under the default eviction policy)
+            routing.note_row_norms(rows, np.linalg.norm(values, axis=1))
             for _, table in replicas:
                 for r, v in zip(rows, values):
                     table._overrides[int(r)] = np.array(v, dtype=np.float32)
@@ -307,6 +310,7 @@ class ShardedGameScorer:
         mesh=None,
         routing: Optional[RoutingIndex] = None,
         headroom_fraction: float = 0.25,
+        eviction_policy: str = "oldest",
     ):
         import jax
         import jax.numpy as jnp
@@ -351,11 +355,14 @@ class ShardedGameScorer:
             if t.is_random_effect
         }
         if routing is None:
+            # eviction_policy only applies when this scorer builds its own
+            # routing; a shared RoutingIndex carries its own policy
             routing = build_routing(
                 re_rows,
                 num_shards=self.num_shards,
                 device_budget_rows=device_budget_rows,
                 headroom_fraction=self._headroom_fraction,
+                eviction_policy=eviction_policy,
             )
         self._routing = routing
         for cid in sorted(artifact.tables):
@@ -648,6 +655,9 @@ class ShardedGameScorer:
                 cid_shards, cid_slots, deferred = routing.route(
                     entity_rows[:n]
                 )
+                # importance plane: fold this batch into the EWMA request
+                # frequencies (no-op under the default eviction policy)
+                routing.note_requests(entity_rows[:n])
                 if deferred.size and self._admission is not None:
                     self._admission.note_deferred(cid, deferred)
                 # pad rows (and this batch's FE-only rows) gather the zero

@@ -14,9 +14,11 @@ from photon_ml_tpu.streaming.blocks import (
     RowPlanes,
     StreamingSource,
     auto_decode_workers,
+    group_by_part_file,
     readahead_file_budget,
 )
 from photon_ml_tpu.streaming.coordinate import StreamingFixedEffectCoordinate
+from photon_ml_tpu.streaming.gapsched import GapScheduler
 from photon_ml_tpu.streaming.prefetch import (
     BlockPrefetcher,
     DeviceBlock,
@@ -37,7 +39,9 @@ __all__ = [
     "CacheStats",
     "plan_fingerprint",
     "auto_decode_workers",
+    "group_by_part_file",
     "readahead_file_budget",
+    "GapScheduler",
     "BlockPlan",
     "HostBlock",
     "RowPlanes",

@@ -132,6 +132,40 @@ class BlockPlan:
         return out
 
 
+def group_by_part_file(
+    indices: Sequence[int], plan: BlockPlan
+) -> List[int]:
+    """Reorder ``indices`` so blocks that START in the same part file are
+    adjacent, without changing the set of blocks visited.
+
+    Shuffled or importance-ordered visits are the stochastic mode's
+    re-decode hazard: two blocks of the same file scheduled far apart make
+    the decode LRU decode that file twice. Grouping fixes it — part files
+    appear in order of their highest-priority block (the first appearance
+    in ``indices``), and within a file blocks run in ascending index so
+    the decode walk is monotone across each file's spans. With the default
+    ``file_cache_size`` (2 — current + next for boundary-spanning blocks),
+    each part file is decoded once per pass over the result, plus at most
+    one extra decode per file-boundary-straddling block whose neighbor
+    group lands much later — O(num_files) total instead of the O(visits)
+    worst case of an ungrouped shuffle.
+    """
+    by_file: Dict[int, List[int]] = {}
+    file_order: List[int] = []
+    for i in indices:
+        b = int(i)
+        fi = plan.spans(b)[0][0]
+        bucket = by_file.get(fi)
+        if bucket is None:
+            bucket = by_file[fi] = []
+            file_order.append(fi)
+        bucket.append(b)
+    out: List[int] = []
+    for fi in file_order:
+        out.extend(sorted(by_file[fi]))
+    return out
+
+
 @dataclasses.dataclass
 class HostBlock:
     """One decoded, padded, host-staged block (numpy only — built in the
@@ -567,8 +601,12 @@ class StreamingSource:
     ) -> Iterator[HostBlock]:
         """Yield HostBlocks in ``order`` (default: sequential). Sequential
         order decodes each part file exactly once thanks to the LRU;
-        shuffled orders may re-decode — that cost is the stochastic mode's
-        tradeoff and is visible in the io phase of the telemetry report."""
+        arbitrary shuffled orders may re-decode. Callers that control the
+        order (the gap scheduler, custom samplers) should pass it through
+        :func:`group_by_part_file` first — same visit set, same-file
+        blocks adjacent — so each part file is decoded at most once per
+        pass; any residual re-decode cost stays visible in the io phase
+        of the telemetry report."""
         indices = range(self.plan.num_blocks) if order is None else order
         for i in indices:
             with span("read stream block", block=int(i)):

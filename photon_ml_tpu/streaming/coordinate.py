@@ -38,6 +38,7 @@ from photon_ml_tpu.opt.tracking import (
     OptimizationStatesTracker,
 )
 from photon_ml_tpu.streaming.blocks import StreamingSource
+from photon_ml_tpu.streaming.gapsched import GapScheduler
 from photon_ml_tpu.streaming.prefetch import BlockPrefetcher, PrefetchStats
 from photon_ml_tpu.streaming.solver import (
     BlockStatsProbe,
@@ -136,6 +137,20 @@ class StreamingFixedEffectCoordinate(Coordinate):
     last_block_stats: Optional[list] = dataclasses.field(
         default=None, repr=False
     )
+    # DuHL: when True, stochastic epochs visit blocks by staleness-decayed
+    # duality-gap importance (GapScheduler) instead of the blind per-epoch
+    # permutation. Off by default — the off path is bitwise identical to
+    # the historical trajectory (CI parity gate). The scheduler persists
+    # across updates/outer iterations so gap scores survive between CD
+    # rounds; each solve's per-epoch decisions land in
+    # ``last_schedule_decisions`` for the progress ledger.
+    gap_schedule: bool = False
+    last_schedule_decisions: Optional[list] = dataclasses.field(
+        default=None, repr=False
+    )
+    _gap_scheduler: Optional[GapScheduler] = dataclasses.field(
+        default=None, repr=False
+    )
     _objective: Optional[GlmObjective] = dataclasses.field(
         default=None, repr=False
     )
@@ -151,6 +166,11 @@ class StreamingFixedEffectCoordinate(Coordinate):
             raise ValueError(
                 f"shard {self.shard_id!r} not in streaming plan "
                 f"{sorted(self.source.plan.shard_dims)}"
+            )
+        if self.gap_schedule and self.mode != "stochastic":
+            raise ValueError(
+                "gap_schedule requires stochastic streaming mode (full-batch"
+                " mode must visit every block per pass to stay exact)"
             )
 
     # -- shapes -----------------------------------------------------------
@@ -232,6 +252,13 @@ class StreamingFixedEffectCoordinate(Coordinate):
                 )
             else:
                 total_weight = float(np.sum(self.source.row_planes().weights))
+                scheduler = None
+                if self.gap_schedule:
+                    if self._gap_scheduler is None:
+                        self._gap_scheduler = GapScheduler(
+                            plan.num_blocks, plan=plan, seed=self.seed
+                        )
+                    scheduler = self._gap_scheduler
                 result = solve_streaming_stochastic(
                     self.objective(),
                     w0,
@@ -246,7 +273,12 @@ class StreamingFixedEffectCoordinate(Coordinate):
                     blocks_per_update=self.blocks_per_update,
                     seed=self.seed,
                     info=info,
+                    scheduler=scheduler,
                 )
+                if scheduler is not None:
+                    self.last_schedule_decisions = (
+                        scheduler.drain_decisions()
+                    )
             jax.block_until_ready(result.w)
         self.last_solve_info = info
         self.last_tracker = FixedEffectOptimizationTracker(

@@ -73,8 +73,9 @@ class PrefetchStats:
     cache_load_s: float = 0.0    # wall seconds mapping+validating entries
     # per-block duality-gap estimates of the most recent streamed solve's
     # final pass (block index -> gap), written by the streaming coordinate
-    # when the convergence plane is on; the seam a DuHL-style gap-guided
-    # block scheduler (ROADMAP item 3) will read
+    # when the convergence plane is on. The DuHL-style GapScheduler
+    # (streaming/gapsched.py) consumes the same signal in stochastic mode
+    # and drives BlockPrefetcher.order with it (ROADMAP item 3)
     block_gaps: Optional[Dict[int, float]] = None
 
     @property

@@ -194,8 +194,20 @@ class StreamPrograms:
             gap = f + jnp.dot(w, g)
             return f_acc + f, g_acc + g, f, jnp.linalg.norm(g), gap
 
+        @jax.jit
+        def gap_probe(w, data):
+            # the standalone gap scalar for the stochastic scheduler: same
+            # first-order surrogate as acc_vg_probe but without the
+            # accumulator plumbing (stochastic mode owns no f/g
+            # accumulators). Returns a future; the epoch-end D2H resolve
+            # is one host sync per epoch, not per block.
+            _note_trace("stream_gap_probe")
+            f, g = objective.value_and_grad(w, data, jnp.zeros((), w.dtype))
+            return f + jnp.dot(w, g)
+
         self.acc_vg = acc_vg
         self.acc_vg_probe = acc_vg_probe
+        self.gap_probe = gap_probe
         self.finalize = finalize
         self.direction = direction
         self.step = step
@@ -377,50 +389,60 @@ def _stochastic_step(
     return group_step
 
 
-def solve_streaming_stochastic(
+def _run_stochastic(
     objective: GlmObjective,
-    w0,
+    w,
     make_blocks_ordered: Callable[[Optional[np.ndarray]], Iterable],
-    configuration: GlmOptimizationConfiguration,
+    cfg: GlmOptimizationConfiguration,
     num_blocks: int,
     total_weight: float,
-    epochs: int = 5,
-    chunk_iters: int = 4,
-    blocks_per_update: int = 1,
-    seed: int = 0,
-    l2_weight: Optional[float] = None,
-    info: Optional[StreamSolveInfo] = None,
+    epochs: int,
+    chunk_iters: int,
+    blocks_per_update: int,
+    seed: int,
+    l2_full: float,
+    info: StreamSolveInfo,
+    scheduler=None,
 ) -> SolveResult:
-    """Stochastic block-sharded solving on the resumable solver seam.
+    """The stochastic epoch loop.
 
-    Per epoch the block order is reshuffled; every ``blocks_per_update``
-    consecutive blocks form one update group, solved with
-    ``solve_init → solve_chunk(num_iters=chunk_iters) → solve_finalize``
-    warm-started from the running ``w``. λ is scaled by the group's share
-    of the total example weight so each group optimizes a consistently
-    regularized subproblem. The whole init/chunk/finalize composition is
-    one jitted program (traced once), so block count never retraces.
+    With no scheduler the visit order is the blind per-epoch
+    ``rng.permutation`` — bitwise identical to the historical trajectory
+    (the CI parity gate pins this). With a :class:`GapScheduler` the order
+    comes from ``scheduler.epoch_order()`` and each visited block's
+    first-order gap is probed (``stream_gap_probe``, one extra jitted
+    scalar program) at the iterate it was visited with; the epoch-end
+    resolve feeds the magnitudes back via ``scheduler.update`` — one D2H
+    sync per epoch.
     """
-    info = info if info is not None else StreamSolveInfo()
-    cfg = configuration
-    w = jnp.asarray(w0, dtype=jnp.float32)
-    l2_full = float(cfg.l2_weight if l2_weight is None else l2_weight)
     rng = np.random.default_rng(seed)
     group_step = _stochastic_step(objective, cfg, chunk_iters)
+    gap_probe = (
+        StreamPrograms.for_objective(objective).gap_probe
+        if scheduler is not None
+        else None
+    )
 
     result = None
     for _ in range(max(1, epochs)):
-        order = rng.permutation(num_blocks)
+        if scheduler is None:
+            order = rng.permutation(num_blocks)
+        else:
+            order = scheduler.epoch_order()
+        epoch_blocks = len(order)
+        gap_futures: List = []
         group: List = []
         group_weight = 0.0
         blocks_seen = 0
         for blk in make_blocks_ordered(order):
+            if gap_probe is not None:
+                gap_futures.append(gap_probe(w, blk.data))
             group.append(blk.data)
             group_weight += blk.weight_sum
             blocks_seen += 1
             info.blocks += 1
             boundary = (
-                len(group) == blocks_per_update or blocks_seen == num_blocks
+                len(group) == blocks_per_update or blocks_seen == epoch_blocks
             )
             if not boundary:
                 continue
@@ -436,9 +458,64 @@ def solve_streaming_stochastic(
             info.iterations += int(result.iterations)
             group = []
             group_weight = 0.0
+        if scheduler is not None:
+            scheduler.update(
+                {
+                    int(order[pos]): float(v)
+                    for pos, v in enumerate(gap_futures)
+                }
+            )
         info.passes += 1
     assert result is not None, "no blocks streamed"
     return result
+
+
+def solve_streaming_stochastic(
+    objective: GlmObjective,
+    w0,
+    make_blocks_ordered: Callable[[Optional[np.ndarray]], Iterable],
+    configuration: GlmOptimizationConfiguration,
+    num_blocks: int,
+    total_weight: float,
+    epochs: int = 5,
+    chunk_iters: int = 4,
+    blocks_per_update: int = 1,
+    seed: int = 0,
+    l2_weight: Optional[float] = None,
+    info: Optional[StreamSolveInfo] = None,
+    scheduler=None,
+) -> SolveResult:
+    """Stochastic block-sharded solving on the resumable solver seam.
+
+    Per epoch the block order is reshuffled — or, when a
+    :class:`~photon_ml_tpu.streaming.gapsched.GapScheduler` is passed,
+    chosen by staleness-decayed duality-gap importance (DuHL, arxiv
+    1702.07005); every ``blocks_per_update`` consecutive blocks form one
+    update group, solved with
+    ``solve_init → solve_chunk(num_iters=chunk_iters) → solve_finalize``
+    warm-started from the running ``w``. λ is scaled by the group's share
+    of the total example weight so each group optimizes a consistently
+    regularized subproblem. The whole init/chunk/finalize composition is
+    one jitted program (traced once), so block count never retraces.
+    """
+    info = info if info is not None else StreamSolveInfo()
+    return _run_stochastic(
+        objective,
+        jnp.asarray(w0, dtype=jnp.float32),
+        make_blocks_ordered,
+        configuration,
+        num_blocks,
+        total_weight,
+        epochs,
+        chunk_iters,
+        blocks_per_update,
+        seed,
+        float(
+            configuration.l2_weight if l2_weight is None else l2_weight
+        ),
+        info,
+        scheduler=scheduler,
+    )
 
 
 def streamed_objective_value(

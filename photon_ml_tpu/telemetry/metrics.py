@@ -206,6 +206,20 @@ class MetricsRegistry:
                 ]
                 if values:
                     norm[f"residency_{key}"] = float(agg(values))
+            # eviction-policy plane (CoordinateRouting.stats): per-policy
+            # victim counters and the admitted set's importance spread
+            for key, agg, out in (
+                ("evicted_oldest", sum, "eviction.oldest"),
+                ("evicted_importance", sum, "eviction.importance"),
+                ("importance_mean", max, "importance.mean"),
+                ("importance_max", max, "importance.max"),
+            ):
+                values = [
+                    c[key] for c in coords
+                    if isinstance(c.get(key), (int, float))
+                ]
+                if values:
+                    norm[out] = float(agg(values))
         admission = snap.get("admission")
         if isinstance(admission, dict):
             for key in (
@@ -218,6 +232,13 @@ class MetricsRegistry:
                 value = admission.get(key)
                 if isinstance(value, (int, float)):
                     norm[f"admission_{key}"] = float(value)
+            by_policy = admission.get("evicted_by_policy")
+            if isinstance(by_policy, dict):
+                for policy, value in by_policy.items():
+                    if isinstance(value, (int, float)):
+                        norm[f"eviction.{policy}"] = max(
+                            norm.get(f"eviction.{policy}", 0.0), float(value)
+                        )
         swaps = snap.get("swaps")
         if isinstance(swaps, dict):
             if isinstance(swaps.get("num_swaps"), (int, float)):

@@ -24,8 +24,10 @@ saving a garbage model.
 ``convergence_report`` reconstructs the ledger into iterations-to-
 tolerance per coordinate, per-coordinate objective share, and
 stall/plateau detection (``analyze_run --progress``); the per-block gap
-estimates are exposed exactly where a future DuHL-style gap-guided block
-scheduler (ROADMAP item 3, arxiv 1702.07005) will read them.
+estimates use the same first-order surrogate the DuHL gap scheduler
+(``streaming/gapsched.py``, arxiv 1702.07005) schedules stochastic
+epochs by, and its per-epoch visit decisions land here as ``schedule``
+records via :meth:`ConvergenceTracker.record_schedule`.
 
 Disabled-by-default contract: with no tracker attached, training runs the
 identical programs and produces bitwise-identical models (same contract as
@@ -220,6 +222,33 @@ class ConvergenceTracker:
                 self.registry.gauge("stream.block_gap_max", max(gaps))
                 self.registry.gauge("stream.block_gap_sum", sum(gaps))
                 self.registry.count("progress.block_records", len(block_stats))
+
+    def record_schedule(
+        self, outer: int, coordinate: str, decisions: List[Dict[str, Any]]
+    ) -> None:
+        """Per-epoch gap-scheduler decisions of a stochastic streamed solve
+        (``GapScheduler.drain_decisions()``): how many blocks the epoch
+        visited, how many were pure exploration picks, and the score
+        spread the choice was made on."""
+        with self._lock:
+            for d in decisions:
+                rec = {
+                    "kind": "schedule",
+                    "outer": int(outer),
+                    "coordinate": str(coordinate),
+                    "epoch": int(d["epoch"]),
+                    "visited": int(d["visited"]),
+                    "explored": int(d["explored"]),
+                    "num_blocks": int(d["num_blocks"]),
+                }
+                for key in ("unvisited", "score_max", "score_mean"):
+                    if key in d:
+                        rec[key] = float(d[key])
+                self._emit(rec)
+            if decisions:
+                self.registry.count(
+                    "progress.schedule_records", len(decisions)
+                )
 
     # -- divergence watchdog ---------------------------------------------
 

@@ -37,6 +37,9 @@ _PROGRESS_SCHEMAS: Dict[str, tuple] = {
     "validation": ("outer", "coordinate", "metric"),
     "block": ("outer", "coordinate", "block", "partial_loss",
               "partial_grad_norm", "gap_estimate"),
+    # gap scheduler: one record per stochastic epoch's visit decision
+    "schedule": ("outer", "coordinate", "epoch", "visited", "explored",
+                 "num_blocks"),
     "anomaly": ("anomaly_kind", "objective"),
 }
 

@@ -378,6 +378,57 @@ register_knob(KnobSpec(
 ))
 
 register_knob(KnobSpec(
+    name="stream.gap_schedule",
+    kind="bool",
+    default=False,
+    applies_to="train",
+    phase="io",
+    metric_deps=(
+        "metric:stream.gap_sched.visited_blocks",
+        "metric:stream.gap_sched.visit_fraction",
+        "metric:stream.block_gap_max",
+        "metric:stream.blocks",
+        "phase:io",
+    ),
+    candidates=(False, True),
+    description=(
+        "Gap-guided block scheduling in stochastic streaming mode "
+        "(train_game --gap-schedule). Epochs visit the blocks with the "
+        "largest staleness-decayed duality-gap estimates (DuHL, arxiv "
+        "1702.07005) instead of a blind shuffle, cutting block visits to "
+        "a target metric when per-block gaps are skewed; off is bitwise-"
+        "identical to the historical shuffle. Not worth turning on when "
+        "block gaps are near-uniform (IID data) — the scheduler then "
+        "pays exploration for no visit savings."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="serve.eviction_policy",
+    kind="str",
+    default="oldest",
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "metric:serving.device_resident_rate",
+        "metric:serving.eviction.importance",
+        "metric:serving.eviction.oldest",
+        "metric:serving.importance.mean",
+        "metric:serving.deferred_rate",
+    ),
+    candidates=("oldest", "importance"),
+    description=(
+        "Admission-victim selection for device-resident RE rows "
+        "(serve_game --eviction-policy). 'oldest' is the historical FIFO; "
+        "'importance' evicts the lowest EWMA-request-frequency x "
+        "coefficient-norm row, keeping hot long-tail entities resident "
+        "under churn — worth trying when traffic is skewed and "
+        "serving.device_resident_rate sits below ~0.95 at the configured "
+        "device budget."
+    ),
+))
+
+register_knob(KnobSpec(
     name="train.engine",
     kind="str",
     default="auto",

@@ -285,10 +285,25 @@ def test_bench_streaming_contract(tmp_path):
     assert payload["warm_cache_hit_blocks"] == payload["warm_blocks_streamed"]
     assert payload["warm_blocks_streamed"] >= payload["num_blocks"]
     assert payload["warm_prefetch_hide_ratio"] == 1.0
+    # gap-guided scheduling A/B (DuHL): the fields the driver parses, with
+    # sane visit accounting and both arms' trajectories recorded; the
+    # shuffle arm visits every block every epoch so it always streams more
+    assert payload["gap_visits_to_target"] >= 1
+    assert payload["shuffle_visits_to_target"] >= 1
+    assert payload["gap_vs_shuffle_visits"] > 0
+    gap_ab = payload["gap_schedule_ab"]
+    assert gap_ab["num_blocks"] > len(gap_ab["hard_blocks"]) >= 1
+    assert 0.5 <= gap_ab["target_auc"] <= 1.0
+    assert gap_ab["shuffle_trajectory"] and gap_ab["gap_trajectory"]
+    assert (
+        gap_ab["shuffle_trajectory"][-1][0] > gap_ab["gap_trajectory"][-1][0]
+    )
     telemetry = payload["telemetry"]
     assert telemetry["validated"] is True
     assert telemetry["ledger"].startswith(str(tmp_path))
-    # every stream_* program traced exactly once across both fits
+    # every stream_* program traced exactly once across both fits AND the
+    # gap-scheduling A/B (which reuses the per-block program shapes and
+    # drives the solver seam directly, below the row-plane programs)
     stream_traces = {
         k: v for k, v in telemetry["jit_traces"].items()
         if k.startswith("stream_")
@@ -296,6 +311,7 @@ def test_bench_streaming_contract(tmp_path):
     assert stream_traces and all(v == 1 for v in stream_traces.values()), (
         stream_traces
     )
+    assert "stream_gap_probe/trace" in stream_traces
     # smoke mode leaves committed records untouched
     assert _artifact_fingerprint(artifact) == before
     assert _artifact_fingerprint(history) == history_before
@@ -340,6 +356,13 @@ def test_bench_streaming_committed_artifact():
         payload["peak_rss_inmemory_delta_mb"]
         + payload["staging_bound_mb"] * 4 + 256
     )
+    # DuHL gap scheduling: the committed record must back the headline
+    # claim — the gap-scheduled arm sustains the held-out AUC target in
+    # >=2x fewer block visits than the blind per-epoch shuffle
+    assert payload["gap_vs_shuffle_visits"] >= 2.0
+    assert payload["gap_schedule_ab"]["target_reached"] == {
+        "gap": True, "shuffle": True
+    }
 
 
 def test_bench_cd_async_contract(tmp_path):

@@ -859,8 +859,36 @@ class TestServingBench:
         # the admission tier scattering rows in the background
         assert payload["warm_compiles"] == len(payload["bucket_sizes"])
         assert payload["post_warmup_compiles"] == 0
+        # eviction-policy A/B: both arms recorded with rates in range and
+        # zero post-warmup compiles (victim choice must not retrace)
+        ab = payload["eviction_ab"]
+        assert ab["device_budget_rows"] > 0
+        for arm in ("oldest", "importance"):
+            stats = ab[arm]
+            assert 0.0 <= stats["device_resident_rate"] <= 1.0
+            assert 0.0 <= stats["deferred_rate"] <= 1.0
+            assert stats["evicted_total"] >= 0
+            assert stats["post_warmup_compiles"] == 0
+        assert "resident_rate_gain" in ab
         # smoke must not overwrite a committed measurement
         mtime_after = (
             os.path.getmtime(out_path) if os.path.exists(out_path) else None
         )
         assert mtime_after == mtime_before
+
+    def test_bench_serving_committed_artifact(self):
+        """The committed full-scale record must back the importance-eviction
+        claim: at the same device budget on the Zipf-replay A/B, scoring
+        victims by request-frequency x coefficient-norm keeps a higher
+        device-resident rate than oldest-admitted FIFO."""
+        path = os.path.join(REPO, "BENCH_SERVING.json")
+        assert os.path.exists(path), "full-scale --serving record missing"
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["metric"] == "serving_p99_latency_s"
+        ab = payload["eviction_ab"]
+        assert ab["importance"]["device_resident_rate"] > (
+            ab["oldest"]["device_resident_rate"]
+        )
+        assert ab["oldest"]["post_warmup_compiles"] == 0
+        assert ab["importance"]["post_warmup_compiles"] == 0

@@ -29,6 +29,7 @@ from photon_ml_tpu.indexmap import DefaultIndexMap
 from photon_ml_tpu.serving import (
     AdmissionController,
     ContinuousBatcher,
+    CoordinateRouting,
     CoordinatedHotSwap,
     GameScorer,
     HotSwapManager,
@@ -457,14 +458,19 @@ class TestRoutingThreadSafety:
         assert slots[1] == routing.cold_slot  # beyond n_rows: deferred
         assert deferred.tolist() == [12]
 
-    def test_concurrent_admission_and_hotswap_updates(self):
+    @pytest.mark.parametrize("policy", ["oldest", "importance"])
+    def test_concurrent_admission_and_hotswap_updates(self, policy):
         """The background admission thread and hot-swap row updates
         mutate the SAME routing concurrently; the routing lock must keep
         allocate/publish atomic — no double-popped slot, no two rows
-        published into one slot, no dead admission thread."""
+        published into one slot, no dead admission thread. Runs under
+        BOTH eviction policies: importance selection walks the admitted
+        deque (which hot swaps riddle with stale entries), so it must
+        uphold the same invariants as the FIFO path."""
         artifact = _artifact(n_ent=128)
         scorer = ShardedGameScorer(
-            artifact, max_nnz=MAX_NNZ, num_shards=2, device_budget_rows=32
+            artifact, max_nnz=MAX_NNZ, num_shards=2, device_budget_rows=32,
+            eviction_policy=policy,
         )
         admission = AdmissionController([scorer], admit_batch=8)
         scorer.attach_admission(admission)
@@ -480,6 +486,9 @@ class TestRoutingThreadSafety:
                     admission.note_deferred(
                         "per_user", rng.integers(0, 128, size=16)
                     )
+                    # the scoring thread's lock-free frequency notes race
+                    # the eviction reads by design (stats-grade planes)
+                    routing.note_requests(rng.integers(0, 128, size=16))
                     time.sleep(0.0005)
             except Exception as e:  # pragma: no cover
                 errors.append(e)
@@ -518,6 +527,135 @@ class TestRoutingThreadSafety:
             # and bookkeeping balances: occupied + free == all data slots
             occupied = resident.size
             assert occupied + routing.free_slots == routing.device_rows
+
+
+class TestEvictionPolicy:
+    """Importance-scored admission eviction (freq × norm, DuHL applied to
+    device residency) vs the historical FIFO — and the FIFO default must
+    stay byte-identical."""
+
+    def _routing(self, policy):
+        # 20-row table, budget 8 -> base 6 pinned + 2 headroom slots
+        return build_routing(
+            {"c": 20}, num_shards=2, device_budget_rows=8,
+            eviction_policy=policy,
+        )["c"]
+
+    def _admit(self, routing, rows):
+        shards, slots, evicted = routing.allocate(len(rows))
+        routing.publish(np.asarray(rows), shards, slots)
+        return evicted
+
+    def test_invalid_policy_raises(self):
+        with pytest.raises(ValueError, match="eviction_policy"):
+            CoordinateRouting(8, 2, 4, eviction_policy="lru")
+
+    def test_policies_pick_different_victims(self):
+        """Admit cold-then-hot; FIFO evicts the hot first-admitted row,
+        importance keeps it and recycles the unrequested one."""
+        for policy, expect_victim in (("oldest", 10), ("importance", 11)):
+            routing = self._routing(policy)
+            assert self._admit(routing, [10, 11]) == []  # into free slots
+            routing.note_requests(np.array([10, 10, 10]))
+            routing.note_row_norms(np.array([10, 11]), np.array([1.0, 1.0]))
+            evicted = self._admit(routing, [12])
+            assert evicted == [expect_victim], policy
+            assert routing.is_resident(10) == (policy == "importance")
+            assert routing.is_resident(12)
+            stats = routing.stats()
+            assert stats["eviction_policy"] == policy
+            assert stats[f"evicted_{policy}"] == 1
+
+    def test_norm_scales_importance(self):
+        """Equal frequency, unequal coefficient magnitude: the near-zero
+        row loses — its score barely differs from the FE-only fallback."""
+        routing = self._routing("importance")
+        self._admit(routing, [10, 11])
+        routing.note_requests(np.array([10, 11]))
+        routing.note_row_norms(np.array([10, 11]), np.array([1e-6, 2.0]))
+        assert self._admit(routing, [12]) == [10]
+
+    def test_importance_skips_stale_deque_entries(self):
+        """A hot-swap unpublish leaves the row's deque entry behind; the
+        importance pass must neither evict through it (slot -1) nor let it
+        unbalance occupancy accounting."""
+        routing = self._routing("importance")
+        self._admit(routing, [10, 11])
+        routing.unpublish(np.array([10]))  # stale deque entry for 10
+        evicted = self._admit(routing, [12])
+        assert evicted == [11]  # the only LIVE admitted row
+        with routing.lock:
+            assert 10 not in routing._admitted  # stale entry dropped
+        # row 10's slot stays in limbo until a re-admission re-publishes
+        # it (unpublish never frees storage) — exactly one orphaned slot,
+        # and no two resident rows share a (shard, slot) pair
+        resident = np.nonzero(routing._slot_of[: routing.n_rows] >= 0)[0]
+        assert resident.size + routing.free_slots == routing.device_rows - 1
+        pairs = {
+            (int(routing._shard_of[r]), int(routing._slot_of[r]))
+            for r in resident
+        }
+        assert len(pairs) == resident.size
+
+    def test_importance_headroom_exhaustion_raises(self):
+        routing = self._routing("importance")
+        self._admit(routing, [10, 11])
+        with pytest.raises(RuntimeError, match="headroom"):
+            routing.allocate(3)  # only 2 evictable slots exist
+
+    def test_frequency_plane_is_an_exponential_window(self):
+        routing = self._routing("importance")
+        routing.note_row_norms(np.array([10]), np.array([1.0]))
+        routing.note_requests(np.array([10]))
+        before = float(routing.importance_of(np.array([10]))[0])
+        for _ in range(CoordinateRouting.FREQ_DECAY_EVERY):
+            routing.note_requests(np.empty(0, dtype=np.int64))
+        after = float(routing.importance_of(np.array([10]))[0])
+        assert after == pytest.approx(before / 2)
+
+    def test_oldest_policy_tracks_no_planes(self):
+        routing = self._routing("oldest")
+        routing.note_requests(np.array([1, 2]))  # no-ops, no allocation
+        routing.note_row_norms(np.array([1]), np.array([1.0]))
+        assert routing._freq is None and routing._norm is None
+        assert routing.importance_of(np.array([1, 2])).tolist() == [0.0, 0.0]
+        assert "importance_mean" not in routing.stats()
+
+    def test_grow_extends_importance_planes(self):
+        routing = self._routing("importance")
+        routing.grow(40)
+        routing.note_requests(np.array([35]))
+        routing.note_row_norms(np.array([35]), np.array([3.0]))
+        assert routing.importance_of(np.array([35]))[0] == pytest.approx(3.0)
+
+    def test_admission_stats_report_policy_counters(self):
+        artifact = _artifact(n_ent=32)
+        scorer = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2, device_budget_rows=8,
+            eviction_policy="importance",
+        )
+        admission = AdmissionController([scorer], admit_batch=4)
+        scorer.attach_admission(admission)
+        admission.warmup()
+        by_policy = admission.stats()["evicted_by_policy"]
+        assert set(by_policy) == {"oldest", "importance"}
+        assert scorer.routing["per_user"].stats()["eviction_policy"] == (
+            "importance"
+        )
+
+    def test_snapshot_exports_eviction_gauges(self):
+        from photon_ml_tpu.telemetry import get_registry
+
+        routing = self._routing("importance")
+        self._admit(routing, [10, 11])
+        routing.note_requests(np.array([10]))
+        routing.note_row_norms(np.array([10]), np.array([1.0]))
+        self._admit(routing, [12])
+        reg = get_registry()
+        reg.record_serving_snapshot({"residency": {"c": routing.stats()}})
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["serving.eviction.importance"]["last"] == 1.0
+        assert "serving.importance.mean" in gauges
 
 
 class TestEntityIdCoercion:
