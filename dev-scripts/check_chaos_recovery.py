@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""CI helper behind the failure-plane chaos gates (docs/RELIABILITY.md).
+
+Two subcommands:
+
+``counters LEDGER --require NAME[:MIN] ...``
+    Read the last ``type: "metrics"`` snapshot in a telemetry run ledger
+    and assert each required counter is present with value >= MIN
+    (default 1). The chaos smoke gate trains with ``PHOTON_FAULTS``
+    arming transient faults and then requires the matching
+    ``resilience.retry.<site>.recovered`` / ``resilience.fault.<site>.trips``
+    counters — proving the faults actually fired AND were recovered, not
+    that the run merely happened to pass.
+
+``models DIR_A DIR_B``
+    Load two trained GAME model artifacts and assert their coefficients
+    are bitwise identical (exact float equality, exact per-entity sparse
+    maps). Used by the disabled-path parity gate: a run with an armed but
+    never-firing fault site must match an unarmed run bit for bit.
+    Compared at the coefficient level (not file bytes) because the Avro
+    container embeds a random sync marker per file.
+"""
+
+import argparse
+import json
+import sys
+
+
+def _fail(msg: str) -> "int":
+    print(f"CHAOS GATE FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def check_counters(args) -> int:
+    snapshot = None
+    with open(args.ledger) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # crash-truncated tail line
+            if rec.get("type") == "metrics":
+                snapshot = rec.get("snapshot", {})
+    if snapshot is None:
+        return _fail(f"no metrics snapshot in {args.ledger}")
+    counters = snapshot.get("counters", {})
+    bad = []
+    for spec in args.require:
+        name, _, floor = spec.partition(":")
+        floor = int(floor) if floor else 1
+        got = counters.get(name, 0)
+        marker = "ok" if got >= floor else "MISSING"
+        print(f"  {name} = {got} (require >= {floor}) {marker}")
+        if got < floor:
+            bad.append(name)
+    if bad:
+        return _fail(f"counters below floor: {', '.join(bad)}")
+    print(f"CHAOS GATE OK: {len(args.require)} recovery counters present")
+    return 0
+
+
+def _model_digest(model):
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for cid in sorted(model.models):
+        m = model.models[cid]
+        h.update(cid.encode())
+        coeffs = getattr(m, "coefficients", None)
+        means = getattr(coeffs, "means", None)
+        if means is not None:  # fixed-effect GLM
+            h.update(np.ascontiguousarray(np.asarray(means)).tobytes())
+            continue
+        for ent, w in sorted(m.items()):  # random-effect table
+            h.update(str(ent).encode())
+            if isinstance(w, dict):  # sparse {feature: weight} map
+                for k in sorted(w):
+                    h.update(f"{k}={float(w[k]).hex()};".encode())
+            else:
+                h.update(np.ascontiguousarray(np.asarray(w)).tobytes())
+    return h.hexdigest()
+
+
+def check_models(args) -> int:
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    model_a, _ = load_game_model(args.dir_a)
+    model_b, _ = load_game_model(args.dir_b)
+    dig_a, dig_b = _model_digest(model_a), _model_digest(model_b)
+    print(f"  {args.dir_a}: {dig_a}")
+    print(f"  {args.dir_b}: {dig_b}")
+    if dig_a != dig_b:
+        return _fail("models differ — armed-but-idle fault plane perturbed "
+                     "training output (disabled-path parity broken)")
+    print("CHAOS GATE OK: models bitwise identical")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("counters", help="assert recovery counters in ledger")
+    c.add_argument("ledger")
+    c.add_argument("--require", action="append", default=[],
+                   metavar="NAME[:MIN]", required=True)
+    c.set_defaults(func=check_counters)
+    m = sub.add_parser("models", help="assert two model outputs identical")
+    m.add_argument("dir_a")
+    m.add_argument("dir_b")
+    m.set_defaults(func=check_models)
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

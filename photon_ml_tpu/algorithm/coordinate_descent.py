@@ -270,6 +270,18 @@ class CoordinateDescent:
         if schedule:
             tracker.record_schedule(outer, cid, schedule)
             coord.last_schedule_decisions = None
+        skipped = getattr(coord, "last_skipped_blocks", None)
+        if skipped:
+            for s in skipped:
+                tracker.record_resilience(
+                    "block_skipped",
+                    "stream.build_block",
+                    s.get("error", ""),
+                    outer=outer,
+                    coordinate=cid,
+                    block=s.get("block"),
+                )
+            coord.last_skipped_blocks = None
         tracker.record_coordinate(
             outer,
             cid,

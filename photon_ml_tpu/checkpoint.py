@@ -27,6 +27,8 @@ import numpy as np
 
 from photon_ml_tpu.parallel.mesh import fetch_global
 
+from photon_ml_tpu.resilience.faultpoints import fault_point, register_fault_site
+
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
@@ -37,6 +39,12 @@ STATE_FILE = "training-state.json"
 _FORMAT_VERSION = 1
 _TMP_PREFIX = ".ckpt-tmp-"
 _OLD_PREFIX = ".ckpt-old-"
+
+FAULT_PUBLISH = register_fault_site(
+    "train.checkpoint.publish",
+    "between the checkpoint tmp-dir fsync and the atomic rename — a fault "
+    "here must leave the previous checkpoint loadable",
+)
 
 
 # ------------------------------------------------------------- serialization
@@ -285,6 +293,7 @@ def save_training_checkpoint(
             json.dump(payload, f)
             f.flush()
             os.fsync(f.fileno())
+        fault_point(FAULT_PUBLISH)
         # crash-safe swap: move the old checkpoint ASIDE first so a kill at
         # any point leaves either the old or the new checkpoint loadable,
         # then delete the old one
@@ -311,7 +320,15 @@ def has_checkpoint(directory: str) -> bool:
 def load_training_checkpoint(
     directory: str,
 ) -> Tuple[Dict[str, object], dict, Optional[Dict[str, object]]]:
-    """→ (models, state, best_models or None)."""
+    """→ (models, state, best_models or None).
+
+    A successful load also sweeps orphaned ``.ckpt-tmp-*`` / ``.ckpt-old-*``
+    sibling dirs: a job killed between the tmp-dir fsync and the atomic
+    rename leaves its half-built tmp behind, and the NEXT save may be hours
+    away — resume is the earliest safe point to reclaim the disk. The sweep
+    runs after the checkpoint parses, so a corrupt state file never deletes
+    material an operator might recover from."""
+    directory = os.path.abspath(directory)
     with open(os.path.join(directory, STATE_FILE)) as f:
         payload = json.load(f)
     if payload.get("version") != _FORMAT_VERSION:
@@ -328,4 +345,5 @@ def load_training_checkpoint(
             cid: _load_submodel(os.path.join(directory, "best", cid), meta)
             for cid, meta in payload["best_models"].items()
         }
+    _sweep_orphans(os.path.dirname(directory) or ".", keep=directory)
     return models, payload["state"], best

@@ -350,7 +350,7 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
             save_artifact(artifact, args.export_artifact_dir)
         logger.info("exported serving artifact to %s", args.export_artifact_dir)
 
-    state = {"manager": None, "phase": "starting"}
+    state = {"manager": None, "admission": None, "phase": "starting"}
     introspect = None
     if args.introspect_port is not None:
         from photon_ml_tpu.serving import IntrospectionServer
@@ -365,6 +365,19 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
             }
             if manager is not None:
                 doc["swap_generation"] = manager.generation
+            # degraded modes: a dead supervised daemon (admission past its
+            # restart cap) flips /healthz to 503 with the reason, while
+            # serving itself keeps answering (cold entities score FE-only)
+            degraded = []
+            admission = state["admission"]
+            if admission is not None:
+                adm = admission.health()
+                doc["admission"] = adm
+                if not adm.get("healthy", True):
+                    degraded.append(adm.get("degraded", "admission dead"))
+            if degraded:
+                doc["healthy"] = False
+                doc["degraded"] = "; ".join(degraded)
             return doc
 
         introspect = IntrospectionServer(
@@ -525,6 +538,7 @@ def _serve_stream(
                 s.attach_admission(admission)
             # compile the fixed-shape admission scatter before traffic
             admission.warmup()
+            state["admission"] = admission
         continuous = not active["sealed"]
         if active["sealed"] and len(scorers) > 1:
             logger.warning(

@@ -218,6 +218,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--no-block-cache", action="store_true",
                    help="streaming: disable the decoded block cache and "
                         "re-decode Avro every epoch")
+    p.add_argument("--on-block-error", default="abort",
+                   choices=("abort", "skip"),
+                   help="streaming: what to do when a block permanently "
+                        "fails to decode after IO retries — 'abort' (default) "
+                        "fails the fit; 'skip' drops the block from the "
+                        "epoch, records a resilience anomaly in the progress "
+                        "ledger, and excludes it from gap scheduling")
     p.add_argument("--decode-workers", type=int, default=-1,
                    help="streaming: decode pool threads (-1 = auto: "
                         "cpu_count-1 capped at 16; 0 = synchronous decode in "
@@ -572,6 +579,9 @@ def run(args: argparse.Namespace) -> GameFit:
                 emitter=emitter,
                 label="train_game",
             )
+            # mirror resilience failures (retry exhaustion, skipped blocks,
+            # thread crashes) into the convergence ledger as they happen
+            progress.attach_failure_sink()
         if args.introspect_port is not None:
             from photon_ml_tpu.serving.introspect import IntrospectionServer
 
@@ -635,6 +645,7 @@ def run(args: argparse.Namespace) -> GameFit:
                     cache_dir=cache_dir,
                     **col_names,
                 )
+            source.on_block_error = args.on_block_error
             index_maps = source.index_maps
             data = None
             logger.info(

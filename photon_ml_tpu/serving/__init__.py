@@ -37,6 +37,9 @@ map onto that design:
 - :mod:`photon_ml_tpu.serving.continuous` — continuous microbatching:
   requests join in-flight buckets up to a deadline, scored by per-replica
   threads with backpressure-bounded queues.
+- :mod:`photon_ml_tpu.serving.deltawatch` — the ``--watch-deltas`` poll as
+  a supervised daemon (``photon_ml_tpu.resilience``): crashes restart with
+  backoff, corrupt deltas are skipped without advancing the generation.
 """
 
 from photon_ml_tpu.serving.artifact import (
@@ -53,6 +56,7 @@ from photon_ml_tpu.serving.admission import AdmissionController
 from photon_ml_tpu.serving.batcher import MicroBatcher
 from photon_ml_tpu.serving.cache import HotEntityCache
 from photon_ml_tpu.serving.continuous import ContinuousBatcher, PendingResult
+from photon_ml_tpu.serving.deltawatch import DeltaWatcher
 from photon_ml_tpu.serving.hotswap import (
     CoordinatedHotSwap,
     HotSwapManager,
@@ -78,6 +82,7 @@ __all__ = [
     "ContinuousBatcher",
     "CoordinateRouting",
     "CoordinatedHotSwap",
+    "DeltaWatcher",
     "GameScorer",
     "HotEntityCache",
     "HotSwapManager",

@@ -33,7 +33,21 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from photon_ml_tpu.resilience.failures import record_failure
+from photon_ml_tpu.resilience.faultpoints import fault_point, register_fault_site
+from photon_ml_tpu.resilience.retry import DEFAULT_IO_RETRY
+from photon_ml_tpu.resilience.supervisor import SupervisedThread
 from photon_ml_tpu.telemetry import span
+
+FAULT_STEP = register_fault_site(
+    "serve.admission.step",
+    "admission controller step(): an uncaught error here used to kill the"
+    " daemon silently; now the supervisor restarts it",
+)
+FAULT_STAGE = register_fault_site(
+    "serve.admission.stage",
+    "host-row gather into the staging buffer (mmap-backed IO; retried)",
+)
 
 
 class AdmissionController:
@@ -68,13 +82,14 @@ class AdmissionController:
         # first admit (dim known then); index flips every step
         self._staging: Dict[str, List[np.ndarray]] = {}
         self._flip: Dict[str, int] = {}
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[SupervisedThread] = None
         self._stop = threading.Event()
         self.admitted_total = 0
         self.evicted_total = 0
         self.deferred_total = 0
         self.dropped_total = 0  # queue overflow (admission can't keep up)
         self.steps = 0
+        self.admit_failures = 0  # per-coordinate admit errors (requeued)
 
     # -------------------------------------------------------------- intake
 
@@ -122,7 +137,13 @@ class AdmissionController:
 
     def step(self) -> int:
         """Admit up to ``admit_batch`` rows per coordinate. Returns the
-        number of rows admitted across coordinates."""
+        number of rows admitted across coordinates.
+
+        One coordinate's failure must not starve the others (or kill a
+        background driver): a failed admit puts its rows back at the
+        queue head, records the failure, and the loop moves on — the
+        next step naturally retries them."""
+        fault_point(FAULT_STEP)
         admitted = 0
         for cid in list(self._queues):
             with self._lock:
@@ -131,7 +152,19 @@ class AdmissionController:
                 rows = [q.popitem(last=False)[0] for _ in range(take)]
             if not rows:
                 continue
-            admitted += self._admit(cid, np.asarray(rows, dtype=np.int64))
+            batch = np.asarray(rows, dtype=np.int64)
+            try:
+                admitted += self._admit(cid, batch)
+            except Exception as exc:  # noqa: BLE001 - contained per-cid
+                self._requeue(cid, batch)
+                self.admit_failures += 1
+                record_failure(
+                    "admit_failed",
+                    "serve.admission.step",
+                    f"{type(exc).__name__}: {exc}",
+                    coordinate=cid,
+                    rows=int(batch.size),
+                )
         if admitted:
             self.steps += 1
         return admitted
@@ -226,7 +259,15 @@ class AdmissionController:
         self._flip[cid] ^= 1
         buf = bufs[self._flip[cid]]
         buf[:] = 0.0
-        buf[: rows.size] = provider.host_rows(rows)
+        if rows.size:
+            # mmap-backed gather: page-in can hit transient IO errors, and
+            # the step holds routing.lock — retry in place (state untouched
+            # until the buffer is written) rather than unwinding the admit
+            def _gather():
+                fault_point(FAULT_STAGE)
+                buf[: rows.size] = provider.host_rows(rows)
+
+            DEFAULT_IO_RETRY.run("serve.admission.stage", _gather)
         return buf
 
     def warmup(self) -> None:
@@ -247,21 +288,34 @@ class AdmissionController:
 
     # --------------------------------------------------------- background
 
-    def start(self, interval_s: float = 0.001) -> None:
-        """Run :meth:`step` on a background thread every ``interval_s``
-        (sooner when a step admitted a full batch — drain bursts fast)."""
+    def start(
+        self,
+        interval_s: float = 0.001,
+        max_restarts: int = 5,
+        emitter=None,
+    ) -> None:
+        """Run :meth:`step` on a supervised background thread every
+        ``interval_s`` (sooner when a step admitted a full batch — drain
+        bursts fast). A crash in :meth:`step` is captured and the tick
+        restarted with backoff up to ``max_restarts``; past the cap the
+        thread is declared dead and :meth:`health` turns degraded while
+        the scorer keeps serving cold entities FE-only."""
         if self._thread is not None:
             raise RuntimeError("admission thread already running")
         self._stop.clear()
 
-        def _run():
-            while not self._stop.is_set():
-                n = self.step()
-                if n < self.admit_batch:
-                    self._stop.wait(interval_s)
+        def _tick():
+            n = self.step()
+            if n < self.admit_batch:
+                self._stop.wait(interval_s)
 
-        self._thread = threading.Thread(
-            target=_run, name="serving-admission", daemon=True
+        self._thread = SupervisedThread(
+            "serving-admission",
+            _tick,
+            mode="tick",
+            stop_event=self._stop,
+            max_restarts=max_restarts,
+            emitter=emitter,
         )
         self._thread.start()
 
@@ -292,7 +346,7 @@ class AdmissionController:
             evicted_by_policy["importance"] += getattr(
                 r, "evicted_importance", 0
             )
-        return {
+        stats = {
             "admit_batch": self.admit_batch,
             "admitted_total": self.admitted_total,
             "evicted_total": self.evicted_total,
@@ -302,4 +356,27 @@ class AdmissionController:
             "steps": self.steps,
             "replicas": len(self._scorers),
             "evicted_by_policy": evicted_by_policy,
+            "admit_failures": self.admit_failures,
+            "thread_restarts": 0,
+            "thread_crashes": 0,
+            "thread_dead": False,
         }
+        thread = self._thread
+        if isinstance(thread, SupervisedThread):
+            sup = thread.stats()
+            stats["thread_restarts"] = sup["restarts"]
+            stats["thread_crashes"] = sup["crashes"]
+            stats["thread_dead"] = sup["dead"]
+            stats["supervisor"] = sup
+        return stats
+
+    def health(self) -> Dict[str, object]:
+        """Health contribution for ``/healthz``: degraded (unhealthy)
+        once the supervised thread is declared dead — serving itself
+        stays up, cold entities just score FE-only forever."""
+        thread = self._thread
+        if isinstance(thread, SupervisedThread):
+            doc = thread.health()
+            doc["running"] = thread.is_alive()
+            return doc
+        return {"healthy": True, "running": thread is not None}

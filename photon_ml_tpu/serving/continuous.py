@@ -35,6 +35,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from photon_ml_tpu.resilience.supervisor import SupervisedThread
 from photon_ml_tpu.serving.batcher import DEFAULT_BUCKET_SIZES
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.scorer import ScoreRequest, ScoreResult
@@ -110,22 +111,31 @@ class ContinuousBatcher:
         )
         self._inflight = 0  # requests popped but not yet resolved
         self._running = False
-        self._threads: List[threading.Thread] = []
+        self._stop_event = threading.Event()
+        self._threads: List[SupervisedThread] = []
         self._scorer_errors = 0
 
     # ------------------------------------------------------------ lifecycle
 
-    def start(self) -> "ContinuousBatcher":
+    def start(
+        self, max_restarts: int = 5, emitter=None
+    ) -> "ContinuousBatcher":
         with self._cond:
             if self._running:
                 raise RuntimeError("batcher already running")
             self._running = True
+        self._stop_event = threading.Event()
+        # mode="loop": _serve_loop returns cleanly when _running flips
+        # False; a crash anywhere else is contained and the loop re-enters
+        # after backoff instead of silently stranding its replica.
         self._threads = [
-            threading.Thread(
-                target=self._serve_loop,
-                args=(scorer,),
-                name=f"serving-batcher-{i}",
-                daemon=True,
+            SupervisedThread(
+                f"serving-batcher-{i}",
+                (lambda s=scorer: self._serve_loop(s)),
+                mode="loop",
+                stop_event=self._stop_event,
+                max_restarts=max_restarts,
+                emitter=emitter,
             )
             for i, scorer in enumerate(self._scorers)
         ]
@@ -137,6 +147,7 @@ class ContinuousBatcher:
         with self._cond:
             self._running = False
             self._cond.notify_all()
+        self._stop_event.set()
         for t in self._threads:
             t.join()
         self._threads = []
@@ -154,6 +165,23 @@ class ContinuousBatcher:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    def thread_stats(self) -> List[dict]:
+        return [t.stats() for t in self._threads]
+
+    def health(self) -> dict:
+        """Healthy while at least one replica worker is not dead; every
+        dead worker contributes a ``degraded`` reason."""
+        workers = [t.health() for t in self._threads]
+        degraded = [w["degraded"] for w in workers if not w["healthy"]]
+        doc = {
+            "healthy": not workers or len(degraded) < len(workers),
+            "workers": workers,
+            "scorer_errors": self._scorer_errors,
+        }
+        if degraded:
+            doc["degraded"] = "; ".join(degraded)
+        return doc
 
     # --------------------------------------------------------------- intake
 

@@ -30,6 +30,9 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from photon_ml_tpu.resilience.failures import record_failure
+from photon_ml_tpu.resilience.faultpoints import fault_point, register_fault_site
+from photon_ml_tpu.resilience.retry import DEFAULT_IO_RETRY, RetryPolicy
 from photon_ml_tpu.serving.artifact import ServingArtifact
 from photon_ml_tpu.serving.cache import HotEntityCache
 from photon_ml_tpu.serving.metrics import ServingMetrics
@@ -37,6 +40,21 @@ from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest
 from photon_ml_tpu.telemetry import span
 
 _log = logging.getLogger("photon_ml_tpu.serving.hotswap")
+
+FAULT_DELTA_LOAD = register_fault_site(
+    "serve.delta.load",
+    "loading one published delta artifact inside the watch loop",
+)
+
+# Delta loads race the publisher: a partially-written or corrupt artifact
+# must not kill the watcher OR advance the processed set — the old
+# generation keeps serving and the same path is retried on the next poll
+# (by then the atomic publish has usually completed).
+_DELTA_RETRY = RetryPolicy(
+    max_attempts=DEFAULT_IO_RETRY.max_attempts,
+    base_delay_s=0.01,
+    retryable=(OSError, ValueError, KeyError, EOFError),
+)
 
 
 @dataclasses.dataclass
@@ -133,6 +151,7 @@ class HotSwapManager:
         self._baseline_metric: Optional[float] = None
         self._undo: Optional[_Undo] = None
         self._processed_dirs: set = set()
+        self.delta_load_failures = 0
 
     # ------------------------------------------------------------- swapping
 
@@ -355,7 +374,30 @@ class HotSwapManager:
         for path in discover_deltas(watch_dir):
             if path in self._processed_dirs:
                 continue
-            delta = load_delta(path)
+
+            def _load(p=path):
+                fault_point(FAULT_DELTA_LOAD)
+                return load_delta(p)
+
+            try:
+                delta = _DELTA_RETRY.run("serve.delta.load", _load)
+            except Exception as exc:
+                # partial write or corruption: keep the live generation,
+                # leave the path unprocessed so the next poll retries it
+                # once the publisher finishes, and move on to any later
+                # delta that IS complete.
+                self.delta_load_failures += 1
+                record_failure(
+                    "delta_load_failed",
+                    "serve.delta.load",
+                    f"{type(exc).__name__}: {exc}",
+                    path=str(path),
+                )
+                _log.warning(
+                    "skipping unreadable delta %s (kept generation %d): %s",
+                    path, self.generation, exc,
+                )
+                continue
             if (
                 delta.fingerprint is not None
                 and delta.fingerprint == self.fingerprint
@@ -372,7 +414,24 @@ class HotSwapManager:
         serving loop between batches."""
         reports: List[SwapReport] = []
         for path, delta in self.poll_directory_deltas(watch_dir):
-            reports.append(self.apply_delta(delta))
+            try:
+                reports.append(self.apply_delta(delta))
+            except Exception as exc:
+                # a delta that loads but won't apply (broken chain after a
+                # skipped predecessor, corrupt content past the header)
+                # must not kill the watch loop; the live generation stands.
+                self.delta_load_failures += 1
+                record_failure(
+                    "delta_apply_failed",
+                    "serve.delta.load",
+                    f"{type(exc).__name__}: {exc}",
+                    path=str(path),
+                )
+                _log.warning(
+                    "delta %s failed to apply (kept generation %d): %s",
+                    path, self.generation, exc,
+                )
+                continue
             self._processed_dirs.add(path)
         return reports
 

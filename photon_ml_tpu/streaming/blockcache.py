@@ -50,11 +50,30 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from photon_ml_tpu.resilience.failures import record_failure
+from photon_ml_tpu.resilience.faultpoints import fault_point, register_fault_site
+from photon_ml_tpu.resilience.retry import RetryExhausted, RetryPolicy
+
 logger = logging.getLogger("photon_ml_tpu")
 
 MAGIC = b"PHBLKC01"
 CACHE_VERSION = 1
 _ALIGN = 64
+
+FAULT_CACHE_LOAD = register_fault_site(
+    "stream.blockcache.load",
+    "block-cache entry open/mmap (retried once; any persistent failure"
+    " is a clean miss and the block re-decodes)",
+)
+FAULT_CACHE_STORE = register_fault_site(
+    "stream.blockcache.store",
+    "block-cache spill write+publish (retried once; a failing cache"
+    " never fails training)",
+)
+
+# cache IO gets a tighter policy than the decode seam: the fallback
+# (re-decode / skip the spill) is cheap, so one quick retry is enough
+_CACHE_RETRY = RetryPolicy(max_attempts=2, base_delay_s=0.01)
 
 
 def _index_map_digest(im) -> str:
@@ -212,29 +231,36 @@ class BlockCache:
             base = _align(len(MAGIC) + 4 + len(hdr))
 
             path = self.entry_path(block.index, shards)
-            fd, tmp = tempfile.mkstemp(
-                dir=self.dir, prefix=f".tmp-{os.getpid()}-", suffix=".blk"
-            )
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    f.write(MAGIC)
-                    f.write(len(hdr).to_bytes(4, "little"))
-                    f.write(hdr)
-                    f.write(b"\x00" * (base - len(MAGIC) - 4 - len(hdr)))
-                    at = 0
-                    for _, arr in arrays:
-                        pad = _align(at) - at
-                        if pad:
-                            f.write(b"\x00" * pad)
-                            at += pad
-                        f.write(arr.tobytes())
-                        at += arr.nbytes
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)  # atomic publish: readers never see torn files
-            finally:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
+
+            def _publish():
+                # each attempt writes a fresh private tmp, so a retried
+                # publish never reuses a half-written file
+                fault_point(FAULT_CACHE_STORE)
+                fd, tmp = tempfile.mkstemp(
+                    dir=self.dir, prefix=f".tmp-{os.getpid()}-", suffix=".blk"
+                )
+                try:
+                    with os.fdopen(fd, "wb") as f:
+                        f.write(MAGIC)
+                        f.write(len(hdr).to_bytes(4, "little"))
+                        f.write(hdr)
+                        f.write(b"\x00" * (base - len(MAGIC) - 4 - len(hdr)))
+                        at = 0
+                        for _, arr in arrays:
+                            pad = _align(at) - at
+                            if pad:
+                                f.write(b"\x00" * pad)
+                                at += pad
+                            f.write(arr.tobytes())
+                            at += arr.nbytes
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, path)  # atomic publish: readers never see torn files
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+
+            _CACHE_RETRY.run("stream.blockcache.store", _publish)
             with self._lock:
                 self.stats.writes += 1
                 self._validated.add(path)  # we just wrote + checksummed it
@@ -243,6 +269,12 @@ class BlockCache:
             # not just OSError: an odd id-tag dtype, a MemoryError on
             # tobytes() of a huge shard — none of it may abort training
             logger.warning("block cache store failed (%s); continuing", e)
+            record_failure(
+                "cache_store_failed",
+                "stream.blockcache.store",
+                f"{type(e).__name__}: {e}",
+                block=int(block.index),
+            )
             return False
         finally:
             with self._lock:
@@ -262,17 +294,23 @@ class BlockCache:
 
         t0 = _time.perf_counter()
         path = self.entry_path(index, shards)
-        try:
+
+        def _open():
             # map via an explicit fd so fstat pins the identity of the file
             # actually mapped: the invalidation unlink below must not delete
             # a FRESH entry a concurrent writer just os.replace'd over this
             # path after we opened the stale one
+            fault_point(FAULT_CACHE_LOAD)
             with open(path, "rb") as f:
-                st_mapped = os.fstat(f.fileno())
-                mm = np.memmap(f, dtype=np.uint8, mode="r")
-            mapped_key = (st_mapped.st_ino, st_mapped.st_size,
-                          st_mapped.st_mtime_ns)
-        except (OSError, ValueError):
+                st = os.fstat(f.fileno())
+                m = np.memmap(f, dtype=np.uint8, mode="r")
+            return m, (st.st_ino, st.st_size, st.st_mtime_ns)
+
+        try:
+            # FileNotFoundError is a normal miss (non-retryable); a flaky
+            # open/mmap gets one quick retry before degrading to re-decode
+            mm, mapped_key = _CACHE_RETRY.run("stream.blockcache.load", _open)
+        except (RetryExhausted, OSError, ValueError):
             with self._lock:
                 self.stats.misses += 1
                 self.stats.load_s += _time.perf_counter() - t0

@@ -38,8 +38,22 @@ from photon_ml_tpu.io.data_reader import (
     read_game_data,
 )
 from photon_ml_tpu.ops.features import pack_ell_into
+from photon_ml_tpu.resilience.failures import record_failure
+from photon_ml_tpu.resilience.faultpoints import fault_point, register_fault_site
+from photon_ml_tpu.resilience.retry import DEFAULT_IO_RETRY
 from photon_ml_tpu.streaming.blockcache import BlockCache, plan_fingerprint
 from photon_ml_tpu.telemetry import span
+
+FAULT_READ = register_fault_site(
+    "stream.read_part_file",
+    "part-file read + columnar decode (retried; pool failures fall back"
+    " to a synchronous decode on the consumer thread)",
+)
+FAULT_BUILD = register_fault_site(
+    "stream.build_block",
+    "block assembly after decode; a permanent failure here is what"
+    " on_block_error=abort|skip governs",
+)
 
 
 def auto_decode_workers() -> int:
@@ -241,6 +255,12 @@ class StreamingSource:
         self._pending: Dict[int, Future] = {}  # fi -> in-flight decode
         self._pool: Optional[ThreadPoolExecutor] = None
         self._row_planes: Optional[RowPlanes] = None
+        # degraded mode for permanent block failures: "abort" (default —
+        # exactness over availability) or "skip" (train on the blocks that
+        # decode; each skip is recorded and excluded from gap scheduling)
+        self.on_block_error = "abort"
+        self.failed_blocks: set = set()
+        self._skipped_log: List[dict] = []
         # decode accounting for the planning/setup passes (bench evidence)
         self.files_decoded = 0
         self._work_s = 0.0  # host decode+pack seconds, whatever thread
@@ -387,13 +407,20 @@ class StreamingSource:
 
     def _decode_now_inner(self, fi: int, t0: float):
         with span("read stream file", file=self.files[fi]):
-            data, _, _ = read_game_data(
-                [self.files[fi]],
-                self.shard_configs,
-                index_maps=self.index_maps,
-                id_tags=self.id_tags,
-                **self.read_kwargs,
-            )
+            # the one seam where disk flakiness enters streaming: a
+            # transient read/decode error retries with backoff instead of
+            # aborting an hours-long fit (the Spark task-retry analogue)
+            def _read():
+                fault_point(FAULT_READ)
+                return read_game_data(
+                    [self.files[fi]],
+                    self.shard_configs,
+                    index_maps=self.index_maps,
+                    id_tags=self.id_tags,
+                    **self.read_kwargs,
+                )
+
+            data, _, _ = DEFAULT_IO_RETRY.run("stream.read_part_file", _read)
         # sort each shard's COO by (row, col) once here: block assembly
         # then slices row ranges by binary search instead of masking the
         # whole file, and ELL packing skips its per-block lexsort
@@ -425,7 +452,18 @@ class StreamingSource:
                 return cached
             fut = self._pending.get(fi)
         if fut is not None:
-            return fut.result()  # the pool job inserts into the cache
+            try:
+                return fut.result()  # the pool job inserts into the cache
+            except Exception as exc:  # noqa: BLE001 - degraded mode below
+                # pool decode failed even after its own retries: fall back
+                # to a synchronous decode on this (consumer) thread — one
+                # more independent attempt before the failure is permanent
+                record_failure(
+                    "prefetch_decode_failed",
+                    "stream.read_part_file",
+                    f"{type(exc).__name__}: {exc}; retrying synchronously",
+                    file=self.files[fi],
+                )
         data = self._decode_now(fi)
         if cache:
             self._cache_insert(fi, data)
@@ -493,22 +531,57 @@ class StreamingSource:
 
     def build_block(
         self, index: int, shards: Optional[Sequence[str]] = None
-    ) -> HostBlock:
+    ) -> Optional[HostBlock]:
         """Assemble one padded HostBlock (host numpy only). ``shards``
         restricts ELL packing to the named feature shards (the streamed
         fixed-effect coordinate only needs its own). With a block cache
         attached, a valid cached entry is returned as zero-copy memmap
         views (no Avro work at all); otherwise the block is decoded and
-        spilled so the NEXT visit hits."""
+        spilled so the NEXT visit hits.
+
+        A permanently failing block (decode retries exhausted) either
+        propagates (``on_block_error='abort'``, the default) or — under
+        ``'skip'`` — is recorded, excluded from future gap scheduling,
+        and returned as ``None`` (iteration drops it)."""
         want = tuple(shards) if shards is not None else tuple(self.shard_configs)
-        if self.cache is not None:
-            blk = self.cache.load(index, want)
-            if blk is not None:
-                return blk
-        blk = self._build_block_decode(index, want)
+        try:
+            fault_point(FAULT_BUILD)
+            if self.cache is not None:
+                blk = self.cache.load(index, want)
+                if blk is not None:
+                    return blk
+            blk = self._build_block_decode(index, want)
+        except Exception as exc:  # noqa: BLE001 - policy decides below
+            if self.on_block_error != "skip":
+                raise
+            self._note_skipped(index, exc)
+            return None
         if self.cache is not None:
             self.cache.store(blk, want)
         return blk
+
+    def _note_skipped(self, index: int, exc: BaseException) -> None:
+        with self._lock:
+            self.failed_blocks.add(int(index))
+            self._skipped_log.append(
+                {
+                    "block": int(index),
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+            )
+        record_failure(
+            "block_skipped",
+            "stream.build_block",
+            f"block {int(index)}: {type(exc).__name__}: {exc}",
+            block=int(index),
+        )
+
+    def drain_skipped_blocks(self) -> List[dict]:
+        """Skip records accumulated since the last drain (the streamed
+        coordinate forwards them to the progress ledger)."""
+        with self._lock:
+            out, self._skipped_log = self._skipped_log, []
+        return out
 
     def _build_block_decode(
         self, index: int, want: Tuple[str, ...]
@@ -610,7 +683,9 @@ class StreamingSource:
         indices = range(self.plan.num_blocks) if order is None else order
         for i in indices:
             with span("read stream block", block=int(i)):
-                yield self.build_block(int(i), shards=shards)
+                blk = self.build_block(int(i), shards=shards)
+            if blk is not None:
+                yield blk
 
     # -- whole-dataset row planes (setup pass) ----------------------------
 

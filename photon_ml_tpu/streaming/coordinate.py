@@ -148,6 +148,11 @@ class StreamingFixedEffectCoordinate(Coordinate):
     last_schedule_decisions: Optional[list] = dataclasses.field(
         default=None, repr=False
     )
+    # failure plane: blocks skipped this update (on_block_error=skip),
+    # drained by the CD driver into the progress ledger
+    last_skipped_blocks: Optional[list] = dataclasses.field(
+        default=None, repr=False
+    )
     _gap_scheduler: Optional[GapScheduler] = dataclasses.field(
         default=None, repr=False
     )
@@ -280,6 +285,13 @@ class StreamingFixedEffectCoordinate(Coordinate):
                         scheduler.drain_decisions()
                     )
             jax.block_until_ready(result.w)
+        skipped = self.source.drain_skipped_blocks()
+        if skipped:
+            self.last_skipped_blocks = skipped
+            if self._gap_scheduler is not None:
+                self._gap_scheduler.mark_failed(
+                    [s["block"] for s in skipped]
+                )
         self.last_solve_info = info
         self.last_tracker = FixedEffectOptimizationTracker(
             states=OptimizationStatesTracker.from_result(result)
@@ -330,6 +342,7 @@ class _OwnShardBlocks:
             yield _ShardBlock(
                 data=blk.data[self.coord.shard_id],
                 weight_sum=blk.weight_sum,
+                index=blk.index,
             )
 
 
@@ -337,3 +350,6 @@ class _OwnShardBlocks:
 class _ShardBlock:
     data: object
     weight_sum: float
+    # real block index: keeps gap attribution correct when a degraded
+    # pass (on_block_error=skip) yields fewer blocks than ordered
+    index: int = -1

@@ -95,6 +95,9 @@ class GapScheduler:
         # measured one, so bootstrap epochs visit everything first
         self.scores = np.full(self.num_blocks, np.inf, dtype=np.float64)
         self.age = np.zeros(self.num_blocks, dtype=np.int64)
+        # failure plane: blocks that permanently failed to build under
+        # on_block_error=skip — never scheduled again this run
+        self.excluded = np.zeros(self.num_blocks, dtype=bool)
         self.epoch = 0
         self.decisions: List[dict] = []
         self._rng = np.random.default_rng(seed)
@@ -115,9 +118,21 @@ class GapScheduler:
         exploration picks — the stalest blocks not already selected.
         """
         eff = self.effective_scores()
+        # excluded (permanently failed) blocks sink below every candidate
+        # and never re-enter the schedule — not even as exploration picks
+        available = int(self.num_blocks - np.sum(self.excluded))
+        if available == 0:
+            raise RuntimeError(
+                "gap scheduler: every block is excluded (permanent"
+                " failures) — nothing left to schedule"
+            )
+        eff[self.excluded] = -np.inf
         n_visit = max(1, math.ceil(self.visit_fraction * self.num_blocks))
-        n_visit = max(n_visit, int(np.sum(~np.isfinite(self.scores))))
-        n_visit = min(n_visit, self.num_blocks)
+        n_visit = max(
+            n_visit,
+            int(np.sum(~np.isfinite(self.scores) & ~self.excluded)),
+        )
+        n_visit = min(n_visit, available)
         # stable argsort on (-eff) keeps index order among exact ties —
         # deterministic schedules for a deterministic gap history
         ranked = np.argsort(-eff, kind="stable")
@@ -126,7 +141,7 @@ class GapScheduler:
         chosen[selected] = True
 
         n_explore = max(1, int(round(self.explore * self.num_blocks)))
-        rest = np.nonzero(~chosen)[0]
+        rest = np.nonzero(~chosen & ~self.excluded)[0]
         explored = np.zeros(0, dtype=np.int64)
         if rest.size:
             # stalest first; ties broken uniformly so exploration does not
@@ -150,7 +165,8 @@ class GapScheduler:
             "visited": int(order.size),
             "explored": int(explored.size),
             "num_blocks": int(self.num_blocks),
-            "unvisited": int(np.sum(~np.isfinite(self.scores))),
+            "unvisited": int(np.sum(~np.isfinite(self.scores) & ~self.excluded)),
+            "excluded": int(np.sum(self.excluded)),
             "score_max": float(finite.max()) if finite.size else 0.0,
             "score_mean": float(finite.mean()) if finite.size else 0.0,
         }
@@ -186,6 +202,15 @@ class GapScheduler:
                 )
             self.scores[b] = abs(float(gap))
             self.age[b] = 0
+
+    def mark_failed(self, blocks) -> None:
+        """Exclude permanently failed blocks (on_block_error=skip) from
+        all future schedules. Idempotent; feedback for an excluded block
+        is simply never measured again."""
+        for b in blocks:
+            bi = int(b)
+            if 0 <= bi < self.num_blocks:
+                self.excluded[bi] = True
 
     def drain_decisions(self) -> List[dict]:
         """Per-epoch decision records accumulated since the last drain

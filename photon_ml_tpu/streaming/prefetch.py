@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.ops.data import LabeledData
 from photon_ml_tpu.ops.features import EllFeatures
+from photon_ml_tpu.resilience.failures import record_failure
 from photon_ml_tpu.streaming.blocks import (
     HostBlock,
     StreamingSource,
@@ -228,6 +229,7 @@ class BlockPrefetcher:
         order = self._block_order()
 
         def worker() -> None:
+            pos = 0
             try:
                 for pos, b in enumerate(order):
                     if stop.is_set():
@@ -235,10 +237,11 @@ class BlockPrefetcher:
                     self._readahead(order, pos)
                     with span("read stream block", block=int(b)):
                         blk = self.source.build_block(int(b), shards=self.shards)
-                    q.put(blk)
+                    if blk is not None:  # None = skipped (on_block_error)
+                        q.put((pos, blk))
                 q.put(_DONE)
-            except BaseException as e:  # propagate to the consumer
-                q.put(e)
+            except BaseException as e:  # degraded mode: consumer takes over
+                q.put((pos, e))
 
         t = threading.Thread(
             target=worker, name="stream-prefetch", daemon=True
@@ -255,10 +258,32 @@ class BlockPrefetcher:
                     item = q.get()
                 if item is _DONE:
                     break
-                if isinstance(item, BaseException):
-                    raise item
+                pos, payload = item
+                if isinstance(payload, BaseException):
+                    # the prefetch thread died past build_block's own
+                    # retries: finish the pass with synchronous decodes on
+                    # this thread (one more independent attempt per block;
+                    # a truly permanent failure still raises here, under
+                    # whatever on_block_error policy the source carries)
+                    record_failure(
+                        "prefetch_worker_failed",
+                        "stream.prefetch",
+                        f"{type(payload).__name__}: {payload}; falling back"
+                        f" to synchronous decode for {len(order) - pos}"
+                        " remaining blocks",
+                    )
+                    for b in order[pos:]:
+                        with span("read stream block", block=int(b)):
+                            blk = self.source.build_block(
+                                int(b), shards=self.shards
+                            )
+                        if blk is None:
+                            continue
+                        self.stats.blocks += 1
+                        yield self._to_device(blk)
+                    break
                 self.stats.blocks += 1
-                yield self._to_device(item)
+                yield self._to_device(payload)
         finally:
             stop.set()
             # drain so a blocked worker can observe the stop flag and exit

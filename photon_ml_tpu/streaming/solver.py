@@ -431,10 +431,19 @@ def _run_stochastic(
             order = scheduler.epoch_order()
         epoch_blocks = len(order)
         gap_futures: List = []
+        visited: List[int] = []
         group: List = []
         group_weight = 0.0
         blocks_seen = 0
         for blk in make_blocks_ordered(order):
+            # the stream may yield fewer blocks than ordered (degraded
+            # on_block_error=skip); gap attribution must follow the
+            # block's OWN index, falling back to order position for
+            # callers whose block wrappers carry none
+            idx = getattr(blk, "index", -1)
+            visited.append(
+                int(idx) if int(idx) >= 0 else int(order[blocks_seen])
+            )
             if gap_probe is not None:
                 gap_futures.append(gap_probe(w, blk.data))
             group.append(blk.data)
@@ -458,15 +467,35 @@ def _run_stochastic(
             info.iterations += int(result.iterations)
             group = []
             group_weight = 0.0
+        if group:
+            # a skipped block kept blocks_seen short of epoch_blocks, so
+            # the in-loop boundary never flushed the tail — flush it here
+            # (unreachable on a clean pass: the boundary clears the group)
+            while len(group) < blocks_per_update:
+                group.append(group[-1])
+            data = _group_data(group)
+            frac = group_weight / max(total_weight, 1e-30)
+            l2_eff = jnp.asarray(l2_full * frac, dtype=w.dtype)
+            result = group_step(w, data, l2_eff)
+            w = result.w
+            info.iterations += int(result.iterations)
         if scheduler is not None:
+            missing = set(int(b) for b in order) - set(visited)
+            if missing:
+                # ordered but never yielded: permanently failed and
+                # skipped — exclude from every later epoch's schedule
+                scheduler.mark_failed(sorted(missing))
             scheduler.update(
                 {
-                    int(order[pos]): float(v)
+                    visited[pos]: float(v)
                     for pos, v in enumerate(gap_futures)
                 }
             )
         info.passes += 1
-    assert result is not None, "no blocks streamed"
+    if result is None:
+        raise RuntimeError(
+            "no blocks streamed (every block failed or was skipped)"
+        )
     return result
 
 

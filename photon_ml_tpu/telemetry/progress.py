@@ -116,6 +116,8 @@ class ConvergenceTracker:
         self.records: List[Dict[str, Any]] = []
         self.anomaly: Optional[Dict[str, Any]] = None
         self._last_objective: Optional[float] = None
+        self._resilience_count = 0
+        self._failure_sink = None
         self._ls_failures = 0
         self._phase = "training"
         self._closed = False
@@ -250,6 +252,75 @@ class ConvergenceTracker:
                     "progress.schedule_records", len(decisions)
                 )
 
+    def record_resilience(
+        self,
+        failure_kind: str,
+        site: str,
+        detail: str = "",
+        outer: Optional[int] = None,
+        coordinate: Optional[str] = None,
+        block: Optional[int] = None,
+    ) -> None:
+        """One failure-plane event (retry exhaustion, skipped block,
+        thread crash) as a ``resilience`` ledger record. These are the
+        *recovered/degraded* signals: they count and persist but do NOT
+        flip health — divergence anomalies keep that role."""
+        with self._lock:
+            if self._closed:
+                return
+            rec: Dict[str, Any] = {
+                "kind": "resilience",
+                "failure_kind": str(failure_kind),
+                "site": str(site),
+                "detail": str(detail),
+            }
+            if outer is not None:
+                rec["outer"] = int(outer)
+            if coordinate is not None:
+                rec["coordinate"] = str(coordinate)
+            if block is not None:
+                rec["block"] = int(block)
+            self._emit(rec)
+            self._resilience_count += 1
+            self.registry.count("progress.resilience_records")
+        if self.emitter is not None:
+            self.emitter.send_event(AnomalyEvent(
+                kind=str(failure_kind),
+                coordinate_id=str(coordinate) if coordinate else str(site),
+                outer_iteration=int(outer) if outer is not None else -1,
+                objective_value=float("nan"),
+                detail={"site": str(site), "detail": str(detail)},
+            ))
+
+    def attach_failure_sink(self) -> None:
+        """Subscribe this tracker to the process-global resilience failure
+        stream: every ``record_failure`` lands in the progress ledger as a
+        ``resilience`` record (detached automatically by :meth:`finish`)."""
+        from photon_ml_tpu.resilience.failures import add_failure_sink
+
+        if getattr(self, "_failure_sink", None) is not None:
+            return
+
+        def _sink(rec: Dict[str, Any]) -> None:
+            self.record_resilience(
+                rec.get("kind", "unknown"),
+                rec.get("site", ""),
+                rec.get("detail", ""),
+                block=rec.get("block"),
+            )
+
+        self._failure_sink = _sink
+        add_failure_sink(_sink)
+
+    def detach_failure_sink(self) -> None:
+        sink = getattr(self, "_failure_sink", None)
+        if sink is None:
+            return
+        from photon_ml_tpu.resilience.failures import remove_failure_sink
+
+        remove_failure_sink(sink)
+        self._failure_sink = None
+
     # -- divergence watchdog ---------------------------------------------
 
     def _watchdog(
@@ -346,6 +417,9 @@ class ConvergenceTracker:
                 doc["objective"] = last["objective"]
             if self.anomaly is not None:
                 doc["anomaly"] = dict(self.anomaly)
+            if self._resilience_count:
+                # recovered/degraded events: visible, but not unhealthy
+                doc["resilience_events"] = self._resilience_count
             return doc
 
     def progress_json(self) -> Dict[str, Any]:
@@ -362,6 +436,7 @@ class ConvergenceTracker:
 
     def finish(self) -> None:
         """Mark training done and close an owned ledger (idempotent)."""
+        self.detach_failure_sink()
         with self._lock:
             if self._closed:
                 return

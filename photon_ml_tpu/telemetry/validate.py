@@ -41,6 +41,9 @@ _PROGRESS_SCHEMAS: Dict[str, tuple] = {
     "schedule": ("outer", "coordinate", "epoch", "visited", "explored",
                  "num_blocks"),
     "anomaly": ("anomaly_kind", "objective"),
+    # failure plane (resilience/): recovered or degraded events — retry
+    # exhaustion, skipped blocks, supervised-thread crashes
+    "resilience": ("failure_kind", "site"),
 }
 
 

@@ -1,0 +1,1065 @@
+"""Failure-plane chaos harness (photon_ml_tpu.resilience).
+
+The reference Photon-ML inherited fault tolerance from Spark (lineage
+recompute, task retry, supervised executors); this runtime carries its
+own failure plane and this module is its chaos gate:
+
+- every registered fault site is armed at least once here
+  (``test_chaos_covers_every_registered_site`` pins the coverage);
+- transient (recovered) faults leave training output **bitwise
+  identical** to a fault-free run, and an armed-but-never-firing site is
+  bitwise invisible (the disabled-path parity contract);
+- permanent faults degrade, never kill: blocks are skipped into the
+  progress ledger and excluded from gap scheduling, corrupt deltas keep
+  the previous serving generation, a dead admission daemon flips
+  ``/healthz`` to 503 while the scorer keeps answering FE-only.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.resilience import (
+    FatalInjectedFault,
+    InjectedFault,
+    RetryExhausted,
+    RetryPolicy,
+    SupervisedThread,
+    arm_fault,
+    clear_failures,
+    configure_faults,
+    fault_point,
+    fault_stats,
+    parse_fault_env,
+    recent_failures,
+    record_failure,
+    register_fault_site,
+    registered_fault_sites,
+    reset_faults,
+)
+from photon_ml_tpu.telemetry.metrics import get_registry
+
+# Every fault site the production modules register. Importing these
+# modules is what registers the sites; the coverage test below fails if a
+# new site appears without a chaos test arming it here.
+import photon_ml_tpu.checkpoint  # noqa: F401  train.checkpoint.publish
+import photon_ml_tpu.serving.admission  # noqa: F401  serve.admission.*
+import photon_ml_tpu.serving.hotswap  # noqa: F401  serve.delta.load
+import photon_ml_tpu.streaming.blockcache  # noqa: F401  stream.blockcache.*
+import photon_ml_tpu.streaming.blocks  # noqa: F401  stream.read/build
+
+COVERED_SITES = {
+    "stream.read_part_file",
+    "stream.build_block",
+    "stream.blockcache.load",
+    "stream.blockcache.store",
+    "serve.admission.step",
+    "serve.admission.stage",
+    "serve.delta.load",
+    "train.checkpoint.publish",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Every test starts and ends with nothing armed and an empty ring."""
+    reset_faults()
+    clear_failures()
+    yield
+    reset_faults()
+    clear_failures()
+
+
+def _counter(name):
+    return get_registry().snapshot()["counters"].get(name, 0)
+
+
+def _failure_kinds():
+    return [r["kind"] for r in recent_failures()]
+
+
+# ===================================================================== units
+class TestFaultPoints:
+    def test_chaos_covers_every_registered_site(self):
+        assert set(registered_fault_sites()) == COVERED_SITES
+
+    def test_parse_env_spec(self):
+        specs = parse_fault_env(
+            "a=once:2, b=every:5,c=prob:0.25:7,d=once:1!fatal"
+        )
+        assert specs["a"].mode == "once" and specs["a"].param == 2
+        assert specs["b"].mode == "every" and specs["b"].param == 5
+        assert specs["c"].mode == "prob" and specs["c"].seed == 7
+        assert specs["d"].fatal and not specs["a"].fatal
+
+    @pytest.mark.parametrize("bad", ["nonsense", "a=warp:3", "a=prob:2.0"])
+    def test_bad_spec_rejected(self, bad):
+        with pytest.raises(ValueError):
+            configure_faults(bad)
+
+    def test_once_fires_exactly_on_nth_call(self):
+        site = register_fault_site("chaos.test.once", "test seam")
+        configure_faults({site: parse_fault_env(f"{site}=once:3")[site]})
+        fault_point(site)
+        fault_point(site)
+        with pytest.raises(InjectedFault):
+            fault_point(site)
+        fault_point(site)  # only call 3, ever
+        assert fault_stats()[site] == {"calls": 4, "trips": 1}
+
+    def test_every_nth_and_fatal(self):
+        site = register_fault_site("chaos.test.every", "test seam")
+        configure_faults(f"{site}=every:2!fatal")
+        fault_point(site)
+        with pytest.raises(FatalInjectedFault):
+            fault_point(site)
+        fault_point(site)
+        with pytest.raises(FatalInjectedFault):
+            fault_point(site)
+
+    def test_prob_is_seeded_and_reproducible(self):
+        site = register_fault_site("chaos.test.prob", "test seam")
+
+        def trips():
+            configure_faults(f"{site}=prob:0.5:11")
+            fired = []
+            for i in range(50):
+                try:
+                    fault_point(site)
+                    fired.append(False)
+                except InjectedFault:
+                    fired.append(True)
+            return fired
+
+        first, second = trips(), trips()
+        assert first == second
+        assert any(first) and not all(first)
+
+    def test_env_var_arms_faults(self, monkeypatch):
+        site = register_fault_site("chaos.test.env", "test seam")
+        monkeypatch.setenv("PHOTON_FAULTS", f"{site}=once:1")
+        reset_faults()  # forget the env was read, so it re-reads
+        with pytest.raises(InjectedFault):
+            fault_point(site)
+
+    def test_unarmed_site_is_a_noop(self):
+        site = register_fault_site("chaos.test.noop", "test seam")
+        for _ in range(10):
+            fault_point(site)
+        assert fault_stats() == {}
+
+    def test_trips_are_counted_in_the_registry(self):
+        site = register_fault_site("chaos.test.count", "test seam")
+        before = _counter(f"resilience.fault.{site}.trips")
+        configure_faults(f"{site}=once:1")
+        with pytest.raises(InjectedFault):
+            fault_point(site)
+        assert _counter(f"resilience.fault.{site}.trips") == before + 1
+
+
+class TestRetryPolicy:
+    def _policy(self, **kw):
+        kw.setdefault("sleep", lambda s: None)
+        kw.setdefault("base_delay_s", 0.0)
+        return RetryPolicy(**kw)
+
+    def test_recovers_from_transient_failure(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        before = _counter("resilience.retry.t.recovered")
+        assert self._policy().run("t", flaky) == "ok"
+        assert calls["n"] == 3
+        assert _counter("resilience.retry.t.recovered") == before + 1
+
+    def test_exhaustion_raises_and_records(self):
+        def dead():
+            raise OSError("always")
+
+        before = _counter("resilience.retry.t2.exhausted")
+        with pytest.raises(RetryExhausted) as ei:
+            self._policy(max_attempts=3).run("t2", dead)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.last, OSError)
+        assert _counter("resilience.retry.t2.exhausted") == before + 1
+        assert "retry_exhausted" in _failure_kinds()
+
+    def test_non_retryable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def missing():
+            calls["n"] += 1
+            raise FileNotFoundError("not a transient fault")
+
+        with pytest.raises(FileNotFoundError):
+            self._policy().run("t3", missing)
+        assert calls["n"] == 1
+
+        def fatal():
+            calls["n"] += 1
+            raise FatalInjectedFault("chaos")
+
+        with pytest.raises(FatalInjectedFault):
+            self._policy().run("t3", fatal)
+        assert calls["n"] == 2
+
+    def test_jitter_is_deterministic(self):
+        p = RetryPolicy()
+        assert p.delay_for("site", 2) == p.delay_for("site", 2)
+        assert p.delay_for("site", 1) != p.delay_for("other", 1)
+
+    def test_on_retry_callback_sees_each_failure(self):
+        seen = []
+
+        def flaky():
+            if len(seen) < 2:
+                raise OSError("x")
+            return 1
+
+        self._policy().run(
+            "t4", flaky, on_retry=lambda a, e: seen.append((a, str(e)))
+        )
+        assert [a for a, _ in seen] == [1, 2]
+
+
+class TestFailureRing:
+    def test_records_are_ordered_and_counted(self):
+        before = _counter("resilience.failures")
+        record_failure("test_kind", "test.site", "detail", block=3)
+        record_failure("test_kind", "test.site", "detail2")
+        recs = recent_failures()
+        assert [r["kind"] for r in recs] == ["test_kind", "test_kind"]
+        assert recs[0]["seq"] < recs[1]["seq"]
+        assert recs[0]["block"] == 3
+        assert _counter("resilience.failures") == before + 2
+        assert _counter("resilience.failures.test_kind") >= 2
+
+    def test_ring_is_bounded(self):
+        for i in range(300):
+            record_failure("flood", "test.site", str(i))
+        recs = recent_failures(1000)
+        assert len(recs) == 256
+        assert recs[-1]["detail"] == "299"
+
+    def test_sink_errors_are_swallowed(self):
+        from photon_ml_tpu.resilience import add_failure_sink, remove_failure_sink
+
+        def bad_sink(rec):
+            raise RuntimeError("sink exploded")
+
+        add_failure_sink(bad_sink)
+        try:
+            record_failure("test_kind", "test.site")  # must not raise
+        finally:
+            remove_failure_sink(bad_sink)
+        assert "test_kind" in _failure_kinds()
+
+
+class TestSupervisedThread:
+    def test_tick_crash_restarts_and_recovers(self):
+        hits, crashed = [], []
+
+        def tick():
+            hits.append(1)
+            if len(crashed) < 2:
+                crashed.append(1)
+                raise RuntimeError("tick exploded")
+            if len(hits) > 10:
+                time.sleep(0.001)
+
+        t = SupervisedThread(
+            "chaos-tick", tick, max_restarts=5, restart_backoff_s=0.001
+        )
+        t.start()
+        deadline = time.time() + 5
+        while len(hits) < 5 and time.time() < deadline:
+            time.sleep(0.005)
+        t.stop()
+        s = t.stats()
+        assert len(hits) >= 5
+        assert s["crashes"] == 2 and s["restarts"] == 2 and not s["dead"]
+        assert t.health()["healthy"]
+
+    def test_loop_clean_return_ends_thread(self):
+        done = []
+
+        def loop():
+            done.append(1)
+
+        t = SupervisedThread("chaos-loop", loop, mode="loop")
+        t.start()
+        t.join(5)
+        assert not t.is_alive() and done == [1]
+        assert t.stats()["crashes"] == 0
+
+    def test_death_past_restart_cap_flips_health(self):
+        dead_cb = []
+
+        def always():
+            raise ValueError("permanent")
+
+        t = SupervisedThread(
+            "chaos-dead", always, max_restarts=2,
+            restart_backoff_s=0.001, on_dead=dead_cb.append,
+        )
+        t.start()
+        t.join(5)
+        s = t.stats()
+        assert s["dead"] and s["crashes"] == 3 and s["restarts"] == 2
+        assert dead_cb and dead_cb[0] is t
+        h = t.health()
+        assert not h["healthy"] and "permanent" in h["degraded"]
+        assert "thread_dead" in _failure_kinds()
+
+
+# ========================================================== streaming chaos
+FILE_ROWS = (110, 90)
+N_ROWS = sum(FILE_ROWS)
+D_GLOBAL = 8
+BLOCK_ROWS = 64  # 200 rows -> 4 blocks, final one ragged
+
+from photon_ml_tpu.io.data_reader import (  # noqa: E402
+    FeatureShardConfiguration,
+    build_index_maps,
+    write_training_examples,
+)
+
+STREAM_SHARDS = {
+    "global": FeatureShardConfiguration(
+        feature_bags=("features",), add_intercept=True
+    ),
+}
+
+
+@pytest.fixture(scope="module")
+def stream_dataset(tmp_path_factory):
+    rng = np.random.default_rng(23)
+    root = tmp_path_factory.mktemp("chaos_stream")
+    X = rng.normal(size=(N_ROWS, D_GLOBAL)).astype(np.float32)
+    w = rng.normal(size=D_GLOBAL).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-(X @ w))) > rng.random(N_ROWS)).astype(
+        np.float32
+    )
+    users = rng.integers(0, 6, size=N_ROWS)
+    paths, row = [], 0
+    for fi, n in enumerate(FILE_ROWS):
+        recs = [
+            {
+                "uid": f"r{i}",
+                "label": float(y[i]),
+                "weight": 1.0,
+                "features": [
+                    ("g", str(j), float(X[i, j])) for j in range(D_GLOBAL)
+                ],
+                "metadataMap": {"userId": f"u{users[i]:02d}"},
+            }
+            for i in range(row, row + n)
+        ]
+        p = str(root / f"part-{fi:05d}.avro")
+        write_training_examples(p, recs)
+        paths.append(p)
+        row += n
+    return {"paths": paths, "index_maps": build_index_maps(paths, STREAM_SHARDS)}
+
+
+def _open_source(stream_dataset, cache_dir=None, decode_workers=None):
+    from photon_ml_tpu.streaming import StreamingSource
+
+    return StreamingSource.open(
+        stream_dataset["paths"], STREAM_SHARDS,
+        index_maps=stream_dataset["index_maps"],
+        block_rows=BLOCK_ROWS, id_tags=("userId",),
+        cache_dir=cache_dir, decode_workers=decode_workers,
+    )
+
+
+def _solve_streamed(source):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.losses.objective import make_glm_objective
+    from photon_ml_tpu.losses.pointwise import LogisticLoss
+    from photon_ml_tpu.opt import GlmOptimizationConfiguration
+    from photon_ml_tpu.opt.config import RegularizationContext
+    from photon_ml_tpu.streaming import BlockPrefetcher, solve_streaming
+    from photon_ml_tpu.types import RegularizationType
+
+    cfg = GlmOptimizationConfiguration(
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=0.5,
+    )
+    objective = make_glm_objective(LogisticLoss)
+    dim = source.plan.shard_dims["global"]
+    w0 = jnp.zeros((dim,), jnp.float32)
+
+    def gen():
+        for blk in BlockPrefetcher(source, shards=("global",), depth=2):
+            yield blk.data["global"]
+
+    return np.asarray(solve_streaming(objective, w0, gen, cfg).w)
+
+
+class TestStreamingChaos:
+    def test_transient_read_fault_is_bitwise_invisible(self, stream_dataset):
+        """The acceptance gate: a streamed solve whose part-file reads hit
+        (recovered) transient faults produces the bit-for-bit same model
+        as a fault-free run."""
+        reset_faults()
+        ref = _solve_streamed(_open_source(stream_dataset))
+
+        configure_faults("stream.read_part_file=once:2")
+        before = _counter("resilience.retry.stream.read_part_file.recovered")
+        got = _solve_streamed(_open_source(stream_dataset))
+        assert fault_stats()["stream.read_part_file"]["trips"] == 1
+        assert (
+            _counter("resilience.retry.stream.read_part_file.recovered")
+            == before + 1
+        )
+        assert np.array_equal(ref, got)
+
+    def test_armed_but_never_firing_site_is_bitwise_invisible(
+        self, stream_dataset
+    ):
+        """Disabled-path parity: arming machinery itself (spec parsing,
+        per-call trigger checks) must not perturb output."""
+        reset_faults()
+        ref = _solve_streamed(_open_source(stream_dataset))
+        configure_faults("stream.read_part_file=once:1000000000")
+        got = _solve_streamed(_open_source(stream_dataset))
+        assert fault_stats()["stream.read_part_file"]["trips"] == 0
+        assert np.array_equal(ref, got)
+
+    def test_cache_load_exhaustion_degrades_to_decode(
+        self, stream_dataset, tmp_path
+    ):
+        """A block cache that cannot be read is a MISS, not a crash: the
+        epoch falls back to decoding Avro and the data is identical."""
+        cache_dir = str(tmp_path / "cache")
+        reset_faults()
+        warm = _open_source(stream_dataset, cache_dir=cache_dir)
+        ref = _solve_streamed(warm)  # epoch 1 populates the cache
+
+        configure_faults("stream.blockcache.load=every:1")
+        got = _solve_streamed(
+            _open_source(stream_dataset, cache_dir=cache_dir)
+        )
+        assert fault_stats()["stream.blockcache.load"]["trips"] >= 1
+        assert np.array_equal(ref, got)
+
+    def test_cache_store_failure_is_nonfatal(self, stream_dataset, tmp_path):
+        """Spill failures lose the cache, never the epoch."""
+        configure_faults("stream.blockcache.store=every:1")
+        src = _open_source(
+            stream_dataset, cache_dir=str(tmp_path / "cache2")
+        )
+        blocks = list(src.iter_blocks(shards=("global",)))
+        assert len(blocks) == src.plan.num_blocks
+        assert fault_stats()["stream.blockcache.store"]["trips"] >= 1
+        assert "cache_store_failed" in _failure_kinds()
+
+    def test_skip_mode_drops_block_and_records_it(self, stream_dataset):
+        configure_faults("stream.build_block=once:2!fatal")
+        src = _open_source(stream_dataset, decode_workers=0)
+        src.on_block_error = "skip"
+        blocks = list(src.iter_blocks(shards=("global",)))
+        assert len(blocks) == src.plan.num_blocks - 1
+        assert src.failed_blocks == {1}
+        skipped = src.drain_skipped_blocks()
+        assert len(skipped) == 1 and skipped[0]["block"] == 1
+        assert src.drain_skipped_blocks() == []  # drained
+        assert "block_skipped" in _failure_kinds()
+
+    def test_abort_mode_raises_by_default(self, stream_dataset):
+        configure_faults("stream.build_block=once:1!fatal")
+        src = _open_source(stream_dataset, decode_workers=0)
+        assert src.on_block_error == "abort"
+        with pytest.raises(FatalInjectedFault):
+            list(src.iter_blocks(shards=("global",)))
+
+    def test_prefetch_worker_crash_falls_back_to_sync_decode(
+        self, stream_dataset
+    ):
+        """A crash that escapes the prefetch worker (abort mode) degrades
+        to synchronous decode for the remaining blocks instead of losing
+        the epoch — and the once-fired fault doesn't fire again on the
+        sync path, so every block still streams."""
+        from photon_ml_tpu.streaming import BlockPrefetcher
+
+        configure_faults("stream.build_block=once:1!fatal")
+        src = _open_source(stream_dataset)
+        blocks = list(BlockPrefetcher(src, shards=("global",), depth=2))
+        assert len(blocks) == src.plan.num_blocks
+        assert "prefetch_worker_failed" in _failure_kinds()
+
+
+class TestGapSchedulerExclusion:
+    def _sched(self, n=6):
+        from photon_ml_tpu.streaming.gapsched import GapScheduler
+
+        return GapScheduler(num_blocks=n, seed=4)
+
+    def test_mark_failed_excludes_from_epochs(self):
+        s = self._sched()
+        s.mark_failed([2, 4])
+        for _ in range(5):
+            order = s.epoch_order()
+            assert 2 not in order and 4 not in order
+            s.update({int(b): 1.0 for b in order})
+
+    def test_exclusion_survives_scoring(self):
+        s = self._sched()
+        order = s.epoch_order()
+        s.update({int(b): float(b + 1) for b in order})
+        s.mark_failed([0])
+        assert 0 not in s.epoch_order()
+
+    def test_all_excluded_raises(self):
+        s = self._sched(3)
+        s.mark_failed([0, 1, 2])
+        with pytest.raises(RuntimeError, match="excluded"):
+            s.epoch_order()
+
+    def test_no_exclusions_is_bitwise_identical(self):
+        a, b = self._sched(), self._sched()
+        b.mark_failed([])  # the no-op path must not perturb anything
+        for _ in range(3):
+            oa, ob = a.epoch_order(), b.epoch_order()
+            assert np.array_equal(oa, ob)
+            a.update({int(x): 1.0 for x in oa})
+            b.update({int(x): 1.0 for x in ob})
+
+
+class TestStreamingEstimatorChaos:
+    def _estimator(self):
+        from photon_ml_tpu.estimators.game import (
+            FixedEffectCoordinateConfiguration,
+            GameEstimator,
+        )
+        from photon_ml_tpu.opt import (
+            GlmOptimizationConfiguration,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.types import RegularizationType, TaskType
+
+        return GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinates={
+                "fixed": FixedEffectCoordinateConfiguration(
+                    "global",
+                    GlmOptimizationConfiguration(
+                        regularization=RegularizationContext(
+                            RegularizationType.L2
+                        ),
+                        regularization_weight=0.5,
+                    ),
+                )
+            },
+            num_outer_iterations=1,
+        )
+
+    def test_streamed_fit_recovers_bitwise_identical(self, stream_dataset):
+        reset_faults()
+        ref = self._estimator().fit_streaming(
+            _open_source(stream_dataset)
+        )
+        configure_faults("stream.read_part_file=once:3")
+        got = self._estimator().fit_streaming(
+            _open_source(stream_dataset)
+        )
+        assert fault_stats()["stream.read_part_file"]["trips"] == 1
+        rw = np.asarray(ref.model.models["fixed"].coefficients.means)
+        gw = np.asarray(got.model.models["fixed"].coefficients.means)
+        assert np.array_equal(rw, gw)
+
+    def test_skipped_block_lands_in_the_progress_ledger(
+        self, stream_dataset, tmp_path
+    ):
+        from photon_ml_tpu.telemetry import ConvergenceTracker
+        from photon_ml_tpu.telemetry.validate import validate_ledger
+
+        ledger = str(tmp_path / "progress.jsonl")
+        tracker = ConvergenceTracker(ledger_path=ledger, label="chaos")
+        tracker.attach_failure_sink()
+        configure_faults("stream.build_block=once:2!fatal")
+        src = _open_source(stream_dataset, decode_workers=0)
+        src.on_block_error = "skip"
+        try:
+            fit = self._estimator().fit_streaming(src, progress=tracker)
+        finally:
+            tracker.finish()
+        assert fit is not None
+        recs = validate_ledger(ledger)
+        res = [
+            r for r in recs
+            if r["type"] == "progress" and r["kind"] == "resilience"
+        ]
+        assert res, "skip must emit a resilience progress record"
+        assert any(r["failure_kind"] == "block_skipped" for r in res)
+        # degraded, not unhealthy: resilience records never flip health
+        assert tracker.health()["healthy"]
+        assert tracker.health()["resilience_events"] >= 1
+
+
+# ============================================================ serving chaos
+from photon_ml_tpu.indexmap import DefaultIndexMap  # noqa: E402
+from photon_ml_tpu.serving import (  # noqa: E402
+    AdmissionController,
+    GameScorer,
+    HotSwapManager,
+    ScoreRequest,
+    ServingArtifact,
+    ServingTable,
+    ShardedGameScorer,
+)
+from photon_ml_tpu.types import TaskType  # noqa: E402
+
+N_ENT, D_RE, D_FE = 48, 4, 8
+SERVE_NNZ = {"global": 6, "per_user": D_RE}
+
+
+def _serving_artifact(n_ent=N_ENT, seed=5):
+    rng = np.random.default_rng(seed)
+    return ServingArtifact(
+        task=TaskType.LOGISTIC_REGRESSION,
+        tables={
+            "fixed": ServingTable(
+                feature_shard="global", random_effect_type=None,
+                weights=(rng.standard_normal(D_FE) * 0.1).astype(np.float32),
+            ),
+            "per_user": ServingTable(
+                feature_shard="per_user", random_effect_type="userId",
+                weights=(
+                    rng.standard_normal((n_ent, D_RE)) * 0.3
+                ).astype(np.float32),
+                entity_index=DefaultIndexMap(
+                    {f"u{i}": i for i in range(n_ent)}
+                ),
+            ),
+        },
+        model_name="chaos-test",
+    )
+
+
+def _score_request(i, uid="u1"):
+    rng = np.random.default_rng(100 + i)
+    return ScoreRequest(
+        request_id=f"r{i}",
+        features={
+            "global": {
+                int(c): float(v)
+                for c, v in zip(
+                    rng.integers(0, D_FE, 6), rng.standard_normal(6)
+                )
+            },
+            "per_user": {
+                j: float(v) for j, v in enumerate(rng.standard_normal(D_RE))
+            },
+        },
+        entity_ids={"userId": uid},
+    )
+
+
+def _admission_pair(budget=24, admit=8):
+    scorer = ShardedGameScorer(
+        _serving_artifact(), max_nnz=SERVE_NNZ, num_shards=2,
+        device_budget_rows=budget,
+    )
+    admission = AdmissionController([scorer], admit_batch=admit)
+    scorer.attach_admission(admission)
+    admission.warmup()
+    return scorer, admission
+
+
+class TestAdmissionSupervision:
+    def test_step_killed_once_daemon_resumes(self):
+        """The motivating regression: one exception in step() used to kill
+        the admission daemon silently. Now the supervisor records the
+        crash, restarts the tick, and the queue still drains."""
+        scorer, admission = _admission_pair()
+        configure_faults("serve.admission.step=once:1")
+        admission.note_deferred("per_user", np.arange(30, 46))
+        admission.start(interval_s=0.001)
+        try:
+            deadline = time.time() + 10
+            while admission.queue_depth and time.time() < deadline:
+                time.sleep(0.005)
+            stats = admission.stats()
+        finally:
+            admission.stop()
+        assert admission.queue_depth == 0
+        assert stats["admitted_total"] == 16
+        assert stats["thread_crashes"] >= 1
+        assert stats["thread_restarts"] >= 1
+        assert not stats["thread_dead"]
+        assert "thread_crash" in _failure_kinds()
+
+    def test_one_bad_coordinate_requeues_not_crashes(self, monkeypatch):
+        scorer, admission = _admission_pair()
+        orig = admission._admit
+        calls = {"n": 0}
+
+        def flaky(cid, rows):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("scatter exploded")
+            return orig(cid, rows)
+
+        monkeypatch.setattr(admission, "_admit", flaky)
+        admission.note_deferred("per_user", np.arange(30, 38))
+        admission.drain()
+        assert admission.queue_depth == 0
+        assert admission.admitted_total == 8
+        assert admission.stats()["admit_failures"] == 1
+        assert "admit_failed" in _failure_kinds()
+
+    def test_stage_gather_fault_is_retried(self):
+        scorer, admission = _admission_pair()
+        configure_faults("serve.admission.stage=once:1")
+        before = _counter("resilience.retry.serve.admission.stage.recovered")
+        admission.note_deferred("per_user", np.arange(30, 34))
+        admitted = admission.step()
+        assert admitted == 4
+        assert (
+            _counter("resilience.retry.serve.admission.stage.recovered")
+            == before + 1
+        )
+
+    def test_dead_daemon_degrades_healthz_serving_stays_up(self):
+        """Kill admission permanently: the thread dies past its restart
+        cap, /healthz flips to 503 with the degraded reason, and the
+        scorer keeps answering (cold entities FE-only)."""
+        from photon_ml_tpu.serving import IntrospectionServer
+
+        scorer, admission = _admission_pair()
+        configure_faults("serve.admission.step=every:1!fatal")
+        admission.note_deferred("per_user", np.arange(30, 38))
+        admission.start(interval_s=0.001, max_restarts=2)
+        try:
+            deadline = time.time() + 10
+            while not admission.stats()["thread_dead"] and (
+                time.time() < deadline
+            ):
+                time.sleep(0.005)
+            stats = admission.stats()
+            health = admission.health()
+            assert stats["thread_dead"]
+            assert not health["healthy"]
+            assert "serving-admission" in health["degraded"]
+
+            # serving still answers: a cold (deferred) entity scores FE-only
+            results = scorer.score_batch(
+                [_score_request(0, uid="u45"), _score_request(1, uid="u2")],
+                bucket_size=2,
+            )
+            assert len(results) == 2
+            assert all(np.isfinite(r.score) for r in results)
+
+            # and the introspection endpoint reports 503 + the reason
+            server = IntrospectionServer(health=admission.health, port=0)
+            server.start()
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}/healthz"
+                )
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=5)
+                assert ei.value.code == 503
+                doc = json.loads(ei.value.read().decode())
+                assert not doc["healthy"]
+                assert "serving-admission" in doc["degraded"]
+            finally:
+                server.stop()
+        finally:
+            admission.stop()
+
+
+class TestContinuousBatcherSupervision:
+    def _scorer(self):
+        return GameScorer(
+            _serving_artifact(), max_nnz=SERVE_NNZ, cache_capacity=16
+        )
+
+    def test_worker_crash_restarts_and_keeps_scoring(self):
+        from photon_ml_tpu.serving import ContinuousBatcher
+
+        batcher = ContinuousBatcher(
+            self._scorer(), bucket_sizes=[1, 2, 4], max_wait_s=0.001
+        )
+        # crash the serve loop itself (not score_batch, which is already
+        # contained): the first clock() call inside the loop explodes
+        real_clock = batcher._clock
+        state = {"armed": True}
+
+        def bomb_clock():
+            # only explode on the supervised worker thread — the clock is
+            # also consulted on the submit path
+            if state["armed"] and threading.current_thread().name.startswith(
+                "serving-batcher"
+            ):
+                state["armed"] = False
+                raise RuntimeError("loop exploded")
+            return real_clock()
+
+        batcher._clock = bomb_clock
+        batcher.start(max_restarts=3)
+        try:
+            handles = [batcher.submit(_score_request(i)) for i in range(4)]
+            scores = [h.result(timeout=60).score for h in handles]
+            assert all(np.isfinite(s) for s in scores)
+            stats = batcher.thread_stats()
+            assert sum(s["crashes"] for s in stats) >= 1
+            assert batcher.health()["healthy"]
+        finally:
+            batcher.stop()
+
+    def test_all_workers_dead_flips_health(self):
+        from photon_ml_tpu.serving import ContinuousBatcher
+
+        batcher = ContinuousBatcher(self._scorer(), bucket_sizes=[1])
+
+        def always(*a, **k):
+            raise RuntimeError("permanently broken")
+
+        batcher._serve_loop = always
+        batcher.start(max_restarts=1)
+        try:
+            deadline = time.time() + 10
+            while batcher.health()["healthy"] and time.time() < deadline:
+                time.sleep(0.005)
+            h = batcher.health()
+            assert not h["healthy"]
+            assert "serving-batcher-0" in h["degraded"]
+        finally:
+            batcher._running = False
+            batcher._stop_event.set()
+            batcher._threads = []
+
+
+# ======================================================== delta watch chaos
+def _fe_delta(artifact, generation, scale):
+    from photon_ml_tpu.incremental.delta import DeltaArtifact
+
+    w = np.asarray(artifact.tables["fixed"].weights, np.float32) * scale
+    return DeltaArtifact(
+        base_fingerprint=None, generation=generation,
+        re_rows={}, fe_updates={"fixed": w},
+    )
+
+
+class TestDeltaResilience:
+    def _manager(self):
+        artifact = _serving_artifact()
+        scorer = GameScorer(artifact, max_nnz=SERVE_NNZ)
+        return artifact, scorer, HotSwapManager(scorer)
+
+    def _corrupt_delta(self, watch_dir, name):
+        d = os.path.join(watch_dir, name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "delta-manifest.json"), "w") as f:
+            f.write('{"format_version": 1, "coordinates": {truncated')
+
+    def test_corrupt_delta_keeps_generation_and_next_good_applies(
+        self, tmp_path
+    ):
+        """Satellite: partial/corrupt delta artifact — serving keeps the
+        old generation, records the failure, and still picks up the next
+        good delta. The corrupt path stays unprocessed, so a re-publish
+        at the same name is retried on a later poll."""
+        from photon_ml_tpu.incremental.delta import delta_dir_name, save_delta
+
+        artifact, scorer, mgr = self._manager()
+        watch = str(tmp_path / "deltas")
+        self._corrupt_delta(watch, delta_dir_name(1))
+        save_delta(_fe_delta(artifact, 2, 2.0), os.path.join(
+            watch, delta_dir_name(2)
+        ))
+
+        req = _score_request(0)
+        before = scorer.score_batch([req], bucket_size=1)[0].score
+        reports = mgr.poll_directory(watch)
+        after = scorer.score_batch([req], bucket_size=1)[0].score
+
+        assert len(reports) == 1 and not reports[0].rolled_back
+        assert mgr.generation == 1  # the good delta applied...
+        assert after != before      # ...and actually changed the scores
+        assert mgr.delta_load_failures >= 1
+        assert "delta_load_failed" in _failure_kinds()
+
+        # re-publishing a good artifact at the failed name is picked up
+        save_delta(_fe_delta(artifact, 1, 3.0), os.path.join(
+            watch, delta_dir_name(1)
+        ))
+        reports = mgr.poll_directory(watch)
+        assert len(reports) == 1
+        assert mgr.generation == 2
+
+    def test_injected_delta_load_fault_recovers(self, tmp_path):
+        from photon_ml_tpu.incremental.delta import delta_dir_name, save_delta
+
+        artifact, scorer, mgr = self._manager()
+        watch = str(tmp_path / "deltas")
+        save_delta(_fe_delta(artifact, 1, 2.0), os.path.join(
+            watch, delta_dir_name(1)
+        ))
+        configure_faults("serve.delta.load=once:1")
+        reports = mgr.poll_directory(watch)
+        assert len(reports) == 1 and mgr.generation == 1
+        assert mgr.delta_load_failures == 0  # retried, recovered
+        assert _counter("resilience.retry.serve.delta.load.recovered") >= 1
+
+    def test_watcher_thread_survives_poll_crashes(self, tmp_path):
+        from photon_ml_tpu.serving import DeltaWatcher
+
+        calls = {"n": 0}
+
+        class FlakyMgr:
+            def poll_directory(self, d):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("poll exploded")
+                return []
+
+        w = DeltaWatcher(FlakyMgr(), str(tmp_path), interval_s=0.001)
+        w.start()
+        try:
+            deadline = time.time() + 10
+            while calls["n"] < 4 and time.time() < deadline:
+                time.sleep(0.005)
+        finally:
+            w.stop()
+        assert calls["n"] >= 4
+        assert w.stats()["polls"] >= 3
+        assert w.health()["healthy"]
+
+    def test_watcher_applies_deltas_in_background(self, tmp_path):
+        from photon_ml_tpu.incremental.delta import delta_dir_name, save_delta
+        from photon_ml_tpu.serving import DeltaWatcher
+
+        artifact, scorer, mgr = self._manager()
+        watch = str(tmp_path / "deltas")
+        os.makedirs(watch)
+        w = DeltaWatcher(mgr, watch, interval_s=0.001)
+        w.start()
+        try:
+            save_delta(_fe_delta(artifact, 1, 2.0), os.path.join(
+                watch, delta_dir_name(1)
+            ))
+            deadline = time.time() + 10
+            while mgr.generation == 0 and time.time() < deadline:
+                time.sleep(0.005)
+        finally:
+            w.stop()
+        assert mgr.generation == 1
+        assert w.swaps >= 1
+        assert len(w.drain_reports()) == 1
+
+
+# ========================================================= checkpoint chaos
+def _glm(value, dim=4):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.glm import GeneralizedLinearModel
+
+    return GeneralizedLinearModel(
+        coefficients=Coefficients(means=jnp.full((dim,), float(value))),
+        task=TaskType.LINEAR_REGRESSION,
+    )
+
+
+class TestCheckpointResilience:
+    def test_publish_fault_keeps_previous_checkpoint(self, tmp_path):
+        from photon_ml_tpu.checkpoint import (
+            load_training_checkpoint,
+            save_training_checkpoint,
+        )
+
+        ckpt = str(tmp_path / "ckpt")
+        save_training_checkpoint(ckpt, {"fixed": _glm(1.0)}, {"outer": 1})
+        configure_faults("train.checkpoint.publish=once:1")
+        with pytest.raises(InjectedFault):
+            save_training_checkpoint(ckpt, {"fixed": _glm(2.0)}, {"outer": 2})
+        # the failed save cleaned its tmp dir and left generation 1 intact
+        assert not glob.glob(str(tmp_path / ".ckpt-*"))
+        models, state, _ = load_training_checkpoint(ckpt)
+        assert state["outer"] == 1
+        assert float(np.asarray(models["fixed"].coefficients.means)[0]) == 1.0
+        # and the NEXT save succeeds (once:1 fired already)
+        save_training_checkpoint(ckpt, {"fixed": _glm(2.0)}, {"outer": 2})
+        _, state, _ = load_training_checkpoint(ckpt)
+        assert state["outer"] == 2
+
+    def test_resume_sweeps_orphaned_tmp_and_old_dirs(self, tmp_path):
+        from photon_ml_tpu.checkpoint import (
+            load_training_checkpoint,
+            save_training_checkpoint,
+        )
+
+        ckpt = str(tmp_path / "ckpt")
+        save_training_checkpoint(ckpt, {"fixed": _glm(1.0)}, {"outer": 1})
+        # replicate what a kill between tmp write and rename leaves behind
+        for orphan in (".ckpt-tmp-dead1", ".ckpt-old-dead2"):
+            d = tmp_path / orphan
+            d.mkdir()
+            (d / "junk.bin").write_bytes(b"x" * 128)
+        _, state, _ = load_training_checkpoint(ckpt)
+        assert state["outer"] == 1
+        assert not glob.glob(str(tmp_path / ".ckpt-*"))
+        assert os.path.isdir(ckpt)  # the live checkpoint is never swept
+
+    @pytest.mark.slow
+    def test_sigkill_between_tmp_write_and_rename(self, tmp_path):
+        """A real SIGKILL after the tmp dir is fully written but before
+        the publish rename: the previous checkpoint must resume cleanly
+        and the orphaned tmp dir is swept on that resume."""
+        from photon_ml_tpu.checkpoint import load_training_checkpoint
+
+        ckpt = str(tmp_path / "ckpt")
+        script = r"""
+import os, signal, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax.numpy as jnp
+from photon_ml_tpu.checkpoint import save_training_checkpoint
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+def glm(v):
+    return GeneralizedLinearModel(
+        coefficients=Coefficients(means=jnp.full((4,), float(v))),
+        task=TaskType.LINEAR_REGRESSION,
+    )
+
+d = sys.argv[1]
+save_training_checkpoint(d, {"fixed": glm(1.0)}, {"outer": 1})
+# second save: die at the first rename — tmp is written and fsynced,
+# nothing has been published
+os.replace = lambda s, t: os.kill(os.getpid(), signal.SIGKILL)
+save_training_checkpoint(d, {"fixed": glm(2.0)}, {"outer": 2})
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", script, ckpt],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == -signal.SIGKILL, proc.stderr
+        orphans = glob.glob(str(tmp_path / ".ckpt-tmp-*"))
+        assert orphans, "the kill must strand the tmp dir"
+        models, state, _ = load_training_checkpoint(ckpt)
+        assert state["outer"] == 1
+        assert float(np.asarray(models["fixed"].coefficients.means)[0]) == 1.0
+        assert not glob.glob(str(tmp_path / ".ckpt-*"))
