@@ -713,6 +713,11 @@ def _bench_telemetry(mode: str = "bench"):
             **({"transfers": transfers} if transfers else {}),
         }
 
+    # expose the live run so sub-benches can attach more producers to the
+    # same validated ledger (the scenarios bench drains request-plane
+    # records into it; validate_ledger in summarize() then schema-checks
+    # them like every other record kind)
+    summarize.run = run
     return summarize
 
 
@@ -752,6 +757,63 @@ EV_BUDGET = 192 if _SMOKE else 2048
 EV_ADMIT = 8 if _SMOKE else 64              # rows per fixed-shape admit step
 EV_CHUNK = 128                              # synchronous replay batch rows
 _SERVING_PATH = os.path.join(_REPO, "BENCH_SERVING.json")
+_SCENARIOS_PATH = os.path.join(_REPO, "BENCH_SCENARIOS.json")
+
+
+def _build_serving_workload(seed=None):
+    """The synthetic GLMix serving workload shared by ``--serving`` and
+    ``--scenarios``: a dense FE prior, one RE coordinate with Zipf(1.3)
+    entity popularity (~2% of entities take most traffic), N_SRV_REQ
+    sparse requests. Returns (artifact, requests, ent)."""
+    from photon_ml_tpu.indexmap import DefaultIndexMap
+    from photon_ml_tpu.serving import ServingArtifact, ServingTable
+    from photon_ml_tpu.serving.scorer import ScoreRequest
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(SEED if seed is None else seed)
+    fe_w = (rng.standard_normal(D_SRV_FE) * 0.1).astype(np.float32)
+    re_table = (
+        rng.standard_normal((N_SRV_ENT, D_SRV_RE)) * 0.3
+    ).astype(np.float32)
+    artifact = ServingArtifact(
+        task=TaskType.LOGISTIC_REGRESSION,
+        tables={
+            "fixed": ServingTable(
+                feature_shard="global", random_effect_type=None,
+                weights=fe_w,
+            ),
+            "per_user": ServingTable(
+                feature_shard="per_user", random_effect_type="userId",
+                weights=re_table,
+                entity_index=DefaultIndexMap(
+                    {f"u{i}": i for i in range(N_SRV_ENT)}
+                ),
+            ),
+        },
+        model_name="serving-bench",
+    )
+
+    ent = (rng.zipf(1.3, N_SRV_REQ) - 1) % N_SRV_ENT
+    fe_idx = rng.integers(0, D_SRV_FE, (N_SRV_REQ, K_SRV_FE))
+    fe_val = rng.standard_normal((N_SRV_REQ, K_SRV_FE)).astype(np.float32)
+    re_val = rng.standard_normal((N_SRV_REQ, D_SRV_RE)).astype(np.float32)
+    requests = [
+        ScoreRequest(
+            request_id=f"r{i}",
+            features={
+                "global": {
+                    int(c): float(v)
+                    for c, v in zip(fe_idx[i], fe_val[i])
+                },
+                "per_user": {
+                    j: float(re_val[i, j]) for j in range(D_SRV_RE)
+                },
+            },
+            entity_ids={"userId": f"u{ent[i]}"},
+        )
+        for i in range(N_SRV_REQ)
+    ]
+    return artifact, requests, ent
 
 
 def _serving_bench():
@@ -771,63 +833,15 @@ def _serving_bench():
 
         if _SMOKE:
             jax.config.update("jax_platforms", "cpu")
-        from photon_ml_tpu.indexmap import DefaultIndexMap
         from photon_ml_tpu.serving import (
             AdmissionController,
-            ServingArtifact,
-            ServingTable,
             ShardedGameScorer,
             replay_requests,
         )
         from photon_ml_tpu.serving.scorer import ScoreRequest
-        from photon_ml_tpu.types import TaskType
 
         summarize_telemetry = _bench_telemetry("serving")
-        rng = np.random.default_rng(SEED)
-        fe_w = (rng.standard_normal(D_SRV_FE) * 0.1).astype(np.float32)
-        re_table = (
-            rng.standard_normal((N_SRV_ENT, D_SRV_RE)) * 0.3
-        ).astype(np.float32)
-        artifact = ServingArtifact(
-            task=TaskType.LOGISTIC_REGRESSION,
-            tables={
-                "fixed": ServingTable(
-                    feature_shard="global", random_effect_type=None,
-                    weights=fe_w,
-                ),
-                "per_user": ServingTable(
-                    feature_shard="per_user", random_effect_type="userId",
-                    weights=re_table,
-                    entity_index=DefaultIndexMap(
-                        {f"u{i}": i for i in range(N_SRV_ENT)}
-                    ),
-                ),
-            },
-            model_name="serving-bench",
-        )
-
-        # Zipf entity popularity (~2% of entities take most traffic): the
-        # regime the LRU cache is built for
-        ent = (rng.zipf(1.3, N_SRV_REQ) - 1) % N_SRV_ENT
-        fe_idx = rng.integers(0, D_SRV_FE, (N_SRV_REQ, K_SRV_FE))
-        fe_val = rng.standard_normal((N_SRV_REQ, K_SRV_FE)).astype(np.float32)
-        re_val = rng.standard_normal((N_SRV_REQ, D_SRV_RE)).astype(np.float32)
-        requests = [
-            ScoreRequest(
-                request_id=f"r{i}",
-                features={
-                    "global": {
-                        int(c): float(v)
-                        for c, v in zip(fe_idx[i], fe_val[i])
-                    },
-                    "per_user": {
-                        j: float(re_val[i, j]) for j in range(D_SRV_RE)
-                    },
-                },
-                entity_ids={"userId": f"u{ent[i]}"},
-            )
-            for i in range(N_SRV_REQ)
-        ]
+        artifact, requests, ent = _build_serving_workload()
 
         routing = None
         scorers = []
@@ -1014,6 +1028,165 @@ def _serving_bench():
     except Exception as e:  # noqa: BLE001 - one JSON line per exit path
         print(json.dumps({
             "metric": "serving_p99_latency_s",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
+
+
+# ---- scenario replay harness (bench.py --scenarios) ----
+
+# scenario shaping: phases per scenario and the idle-gap scale (diurnal
+# troughs, storm quiets); the request-plane sample rate trades record
+# volume for attribution resolution (1 = every request in smoke)
+SCN_PHASES = 8
+SCN_PAUSE_S = 0.002 if _SMOKE else 0.02
+SCN_SAMPLE_RATE = 1 if _SMOKE else 4
+SCN_SLO_LATENCY_S = 0.050                   # per-request latency objective
+SCN_SLO_LATENCY_OBJ = 0.99
+SCN_SLO_AVAIL_OBJ = 0.999
+
+
+def _scenarios_bench():
+    """Replay the serving workload through the seeded traffic-shape
+    scenarios (steady, diurnal, burst storm, cold-entity flood, hot-swap
+    under load) with the request plane sampling lifecycles and the SLO
+    tracker keeping verdicts.
+
+    One JSON line out; writes BENCH_SCENARIOS.json (full runs, or smoke
+    with BENCH_SCENARIOS_WRITE=1) with one document per scenario: per-stage
+    p50/p99 breakdown, device residency, throughput, SLO verdict. The
+    request records drain into the bench telemetry ledger, so the
+    summarizer's validate_ledger schema-checks them — the CI scenario
+    sentinel runs this in smoke mode and gates on both artifacts."""
+    import sys
+
+    try:
+        import jax
+
+        if _SMOKE:
+            jax.config.update("jax_platforms", "cpu")
+        from photon_ml_tpu.serving import (
+            AdmissionController,
+            RequestPlane,
+            SCENARIO_NAMES,
+            SLOTracker,
+            ServingMetrics,
+            ShardedGameScorer,
+            build_scenario,
+            run_scenario,
+        )
+        from photon_ml_tpu.serving.scenarios import make_row_swap_fn
+
+        summarize_telemetry = _bench_telemetry("scenarios")
+        ledger = summarize_telemetry.run.ledger
+        artifact, requests, _ = _build_serving_workload()
+
+        routing = None
+        scorers = []
+        for _ in range(SRV_SCORERS):
+            s = ShardedGameScorer(
+                artifact,
+                max_nnz={"global": K_SRV_FE, "per_user": D_SRV_RE},
+                num_shards=SRV_SHARDS,
+                device_budget_rows=SRV_BUDGET,
+                routing=routing,
+            )
+            routing = s.routing
+            scorers.append(s)
+        lead = scorers[0]
+        # compile every bucket once outside the measured scenarios (the
+        # same deploy-time-cost discipline as the serving bench)
+        for s in scorers:
+            for b in SRV_BUCKETS:
+                s.score_batch(requests[:b], bucket_size=b)
+        admission = AdmissionController(scorers, admit_batch=SRV_ADMIT)
+        for s in scorers:
+            s.attach_admission(admission)
+        admission.warmup()
+        admission.start(interval_s=SRV_ADMIT_INTERVAL_S)
+
+        import gc
+
+        scenario_docs = []
+        gc.collect()
+        gc.disable()
+        try:
+            for name in SCENARIO_NAMES:
+                # scorers/admission stay warm across scenarios (the
+                # production regime); verdicts are isolated per scenario
+                # via fresh metrics/plane/SLO and reset routing counters
+                lead.routing.reset_counters()
+                metrics = ServingMetrics()
+                slo = SLOTracker(
+                    latency_threshold_s=SCN_SLO_LATENCY_S,
+                    latency_objective=SCN_SLO_LATENCY_OBJ,
+                    availability_objective=SCN_SLO_AVAIL_OBJ,
+                )
+                plane = RequestPlane(
+                    sample_rate=SCN_SAMPLE_RATE,
+                    seed=SEED,
+                    ledger=ledger,
+                    capacity=max(4096, len(requests)),
+                    slo=slo,
+                )
+                scenario = build_scenario(
+                    name, requests, seed=SEED,
+                    num_phases=SCN_PHASES, pause_s=SCN_PAUSE_S,
+                )
+                swap_fn = None
+                if name == "hot_swap_under_load":
+                    swap_fn = make_row_swap_fn(
+                        scorers, metrics, seed=SEED
+                    )
+                doc = run_scenario(
+                    scenario,
+                    scorers,
+                    bucket_sizes=SRV_BUCKETS,
+                    metrics=metrics,
+                    plane=plane,
+                    slo=slo,
+                    admission=admission,
+                    continuous=True,
+                    max_wait_s=SRV_DEADLINE_S,
+                    max_queue=SRV_MAX_QUEUE,
+                    swap_fn=swap_fn,
+                )
+                scenario_docs.append(doc)
+        finally:
+            gc.enable()
+            admission.stop()
+
+        ok = sum(
+            1 for d in scenario_docs if d.get("slo_verdict") == "ok"
+        )
+        payload = {
+            "metric": "scenario_slo_ok_rate",
+            "value": round(ok / len(scenario_docs), 4),
+            "unit": "fraction_of_scenarios",
+            "num_scenarios": len(scenario_docs),
+            "num_requests_per_scenario": N_SRV_REQ,
+            "sample_rate": SCN_SAMPLE_RATE,
+            "slo": {
+                "latency_threshold_s": SCN_SLO_LATENCY_S,
+                "latency_objective": SCN_SLO_LATENCY_OBJ,
+                "availability_objective": SCN_SLO_AVAIL_OBJ,
+            },
+            "serving_mode": "sharded-continuous",
+            "num_shards": SRV_SHARDS,
+            "device_budget_rows": SRV_BUDGET,
+            "bucket_sizes": list(SRV_BUCKETS),
+            "backend": jax.default_backend(),
+            "scenarios": scenario_docs,
+        }
+        payload["telemetry"] = summarize_telemetry()
+        print(json.dumps(payload))
+        if not _SMOKE or _env_flag("BENCH_SCENARIOS_WRITE"):
+            with open(_SCENARIOS_PATH, "w") as f:
+                json.dump(payload, f, indent=2)
+        _append_history(payload, "scenarios")
+    except Exception as e:  # noqa: BLE001 - one JSON line per exit path
+        print(json.dumps({
+            "metric": "scenario_slo_ok_rate",
             "error": f"{type(e).__name__}: {e}",
         }))
         sys.exit(1)
@@ -2580,6 +2753,16 @@ def _main():
              "sustained requests/sec, and write BENCH_SERVING.json",
     )
     ap.add_argument(
+        "--scenarios", action="store_true",
+        help="run the scenario replay harness instead of the training "
+             "bench: drive the serving workload through seeded traffic "
+             "shapes (steady, diurnal, burst storm, cold-entity flood, "
+             "hot-swap under load) with request-plane lifecycle sampling "
+             "and SLO tracking; writes one per-stage p50/p99 breakdown, "
+             "residency rate and SLO verdict per scenario to "
+             "BENCH_SCENARIOS.json",
+    )
+    ap.add_argument(
         "--incremental", action="store_true",
         help="run the nearline-update benchmark instead of the training "
              "bench: warm-started incremental re-solve, delta publish and "
@@ -2633,6 +2816,9 @@ def _main():
         return
     if args.serving:
         _serving_bench()
+        return
+    if args.scenarios:
+        _scenarios_bench()
         return
     if args.incremental:
         _incremental_bench()

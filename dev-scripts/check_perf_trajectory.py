@@ -32,6 +32,16 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# History modes/metrics that are rates or verdicts, not throughput: a low
+# value is a legitimate measurement (e.g. scenarios missing their SLO under
+# a storm shape), not a perf collapse, so the collapse gate must not fire
+# on them — they get the trajectory report only. The scenario sentinel
+# (dev-scripts/check_scenarios.py) owns gating those artifacts.
+REPORT_ONLY_METRICS = {
+    "scenario_slo_ok_rate",
+    "eviction_resident_rate_gain",
+}
+
 
 def _host_fingerprint() -> str:
     # must mirror bench.py's fingerprint so equality is meaningful
@@ -105,6 +115,14 @@ def main(argv=None) -> int:
                 f"perf-trajectory: history {rec.get('ts')}: "
                 f"{rec.get('metric')}={rec.get('value')} {rec.get('unit')}"
             )
+
+    if fresh.get("metric") in REPORT_ONLY_METRICS:
+        print(
+            f"perf-trajectory: {fresh['metric']}={fresh.get('value')} is a "
+            "rate/verdict metric, not throughput — report only, no collapse "
+            "gate"
+        )
+        return 0
 
     if not os.path.exists(args.reference):
         print(

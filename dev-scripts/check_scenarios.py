@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Scenario sentinel: validate a BENCH_SCENARIOS.json artifact.
+
+CI runs the scenario replay harness in smoke mode (``BENCH_SMOKE=1
+python bench.py --scenarios``) and hands the resulting JSON to this
+script; it also runs against the committed ``BENCH_SCENARIOS.json`` so a
+stale or hand-mangled artifact cannot ship. The gate asserts the request
+plane's contract, not performance numbers (smoke shapes are tiny and CPU
+timing is noisy):
+
+* at least ``--min-scenarios`` scenario documents (default 4), each
+  carrying a per-stage p50/p99 breakdown over all six request stages, a
+  ``device_resident_rate``, and an SLO verdict;
+* each scenario's tail attribution coverage >= ``--min-coverage``
+  (default 0.95): the per-stage breakdown must explain the end-to-end
+  tail latency, the property the telescoping stage boundaries guarantee;
+* with ``--ledger``, the bench telemetry ledger passes
+  ``validate_ledger`` (schema check for every record kind, the sampled
+  ``request`` records included) and actually carries request records.
+
+Exit 0 = artifact sound; exit 1 names every violated invariant.
+
+Usage:
+    BENCH_SMOKE=1 python bench.py --scenarios > /tmp/fresh-scenarios.json
+    python dev-scripts/check_scenarios.py /tmp/fresh-scenarios.json \
+        [--ledger /tmp/scenarios-ledger.jsonl] [--min-scenarios 4] \
+        [--min-coverage 0.95]
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+REQUEST_STAGES = ("queue", "featurize", "route", "dispatch", "device", "reply")
+
+
+def _last_json_line(path):
+    """Accept either form of the artifact: the committed
+    BENCH_SCENARIOS.json (one pretty-printed document) or a capture of
+    the bench's stdout (one JSON object per line, last line wins)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path}: empty")
+    return json.loads(lines[-1])
+
+
+def check_payload(payload, min_scenarios, min_coverage):
+    """Return the list of violated invariants (empty = sound)."""
+    problems = []
+    if payload.get("error"):
+        return [f"harness errored: {payload['error']}"]
+    scenarios = payload.get("scenarios")
+    if not isinstance(scenarios, list):
+        return ["payload carries no 'scenarios' list"]
+    if len(scenarios) < min_scenarios:
+        problems.append(
+            f"only {len(scenarios)} scenario(s), need >= {min_scenarios}"
+        )
+    for doc in scenarios:
+        name = doc.get("name", "?")
+        if not doc.get("num_requests"):
+            problems.append(f"{name}: no requests replayed")
+            continue
+        plane = doc.get("request_plane") or {}
+        stages = plane.get("stages") or {}
+        for stage in REQUEST_STAGES:
+            dist = stages.get(stage)
+            if not isinstance(dist, dict) or not all(
+                isinstance(dist.get(k), (int, float))
+                for k in ("p50_s", "p99_s")
+            ):
+                problems.append(
+                    f"{name}: stage '{stage}' missing p50/p99 breakdown"
+                )
+        tail = plane.get("tail") or {}
+        coverage = tail.get("attribution_coverage")
+        if not isinstance(coverage, (int, float)):
+            problems.append(f"{name}: no tail attribution coverage")
+        elif coverage < min_coverage:
+            problems.append(
+                f"{name}: tail attribution coverage {coverage:.4f} < "
+                f"{min_coverage} — stage boundaries are leaking time"
+            )
+        if not isinstance(
+            doc.get("device_resident_rate"), (int, float)
+        ):
+            problems.append(f"{name}: no device_resident_rate")
+        if not doc.get("slo_verdict"):
+            problems.append(f"{name}: no SLO verdict")
+    return problems
+
+
+def check_ledger(path):
+    """Schema-validate the bench telemetry ledger and require sampled
+    request records in it. Returns the list of problems."""
+    sys.path.insert(0, REPO)
+    from photon_ml_tpu.telemetry.validate import validate_ledger
+
+    try:
+        records = validate_ledger(path)
+    except Exception as e:  # noqa: BLE001 - named in the gate output
+        return [f"ledger {path} failed validation: {type(e).__name__}: {e}"]
+    n_req = sum(1 for r in records if r.get("type") == "request")
+    if not n_req:
+        return [f"ledger {path} carries no 'request' records"]
+    print(
+        f"scenario-sentinel: ledger ok — {len(records)} record(s), "
+        f"{n_req} sampled request record(s), schema-validated"
+    )
+    return []
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "payload",
+        help="BENCH_SCENARIOS.json or a file holding the bench's JSON line",
+    )
+    ap.add_argument(
+        "--ledger", default=None,
+        help="also schema-validate this bench telemetry ledger and require "
+             "sampled 'request' records in it",
+    )
+    ap.add_argument("--min-scenarios", type=int, default=4)
+    ap.add_argument(
+        "--min-coverage", type=float, default=0.95,
+        help="minimum tail attribution coverage per scenario (default 0.95)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        payload = _last_json_line(args.payload)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"scenario-sentinel: cannot read payload ({e})")
+        return 1
+
+    problems = check_payload(payload, args.min_scenarios, args.min_coverage)
+    if args.ledger:
+        problems += check_ledger(args.ledger)
+
+    if problems:
+        for p in problems:
+            print(f"scenario-sentinel: FAIL — {p}")
+        return 1
+    scenarios = payload.get("scenarios") or []
+    verdicts = ", ".join(
+        f"{d.get('name')}={d.get('slo_verdict')}" for d in scenarios
+    )
+    print(
+        f"scenario-sentinel: ok — {len(scenarios)} scenario(s) "
+        f"({verdicts}), slo_ok_rate={payload.get('value')}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

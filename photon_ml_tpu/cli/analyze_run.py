@@ -75,6 +75,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         ),
     )
     parser.add_argument(
+        "--requests",
+        action="store_true",
+        help=(
+            "Render the request-plane tail-latency attribution (per-stage "
+            "p50/p99, tail breakdown with exemplar request ids, "
+            "interference overlap) from the ledger's sampled request "
+            "records; exits nonzero when the ledger carries none."
+        ),
+    )
+    parser.add_argument(
         "--quiet",
         action="store_true",
         help="Suppress the human-readable report (JSON outputs still written).",
@@ -96,6 +106,19 @@ def run(args: argparse.Namespace) -> int:
             return 1
         if not args.quiet:
             print(format_progress_report(report.progress))
+    if args.requests:
+        from photon_ml_tpu.telemetry.analyze import format_request_report
+
+        if not report.requests:
+            print(
+                "analyze_run: ledger carries no request records (serve with "
+                "a RequestPlane attached — serve_game --request-sample-rate "
+                "— to record sampled lifecycles)",
+                file=sys.stderr,
+            )
+            return 1
+        if not args.quiet:
+            print(format_request_report(report.requests))
     if not args.quiet:
         print(format_report(report))
     if args.json:

@@ -144,6 +144,28 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="after the replay, keep the introspection endpoints "
                         "up for this many seconds (or until "
                         "/quitquitquit is hit)")
+    p.add_argument("--request-sample-rate", type=int, default=0,
+                   help="request-plane lifecycle sampling: trace ~1/N "
+                        "requests' per-stage timings (0 = off, the default; "
+                        "1 = every request). Sampled records land in the "
+                        "--telemetry-out ledger (analyze_run --requests) "
+                        "and the live /requests introspection route")
+    p.add_argument("--request-sample-seed", type=int, default=0,
+                   help="seed for the request-plane sampler hash "
+                        "(default 0); the same (id, seed) always samples "
+                        "identically")
+    p.add_argument("--slo-latency-ms", type=float, default=None,
+                   help="enable SLO tracking with this per-request latency "
+                        "threshold in ms: rolling availability + latency "
+                        "objectives with error-budget burn accounting; "
+                        "budget exhaustion flips /healthz degraded and the "
+                        "serving.slo.* gauges")
+    p.add_argument("--slo-latency-objective", type=float, default=0.99,
+                   help="fraction of requests that must beat the latency "
+                        "threshold (default 0.99)")
+    p.add_argument("--slo-availability-objective", type=float, default=0.999,
+                   help="fraction of requests that must not error "
+                        "(default 0.999)")
     add_telemetry_args(p)
     return p.parse_args(argv)
 
@@ -328,7 +350,7 @@ def run(args: argparse.Namespace) -> Optional[dict]:
         emitter.register_listener_class(name)
     telemetry = start_telemetry(args, "serve_game", emitter=emitter)
     try:
-        return _run_serving(args, logger, timer, emitter)
+        return _run_serving(args, logger, timer, emitter, telemetry)
     finally:
         # listeners must flush/close even when the run fails; telemetry
         # finishes after them so every bridged event is in the ledger
@@ -336,12 +358,42 @@ def run(args: argparse.Namespace) -> Optional[dict]:
         finish_telemetry(telemetry, phases=dict(timer.durations))
 
 
-def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
+def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]:
     artifact = _load_or_pack(args, logger, timer)
     model_id = args.model_id or artifact.model_name
     active = _effective_config(args, artifact, logger)
     active["model_id"] = model_id
     bucket_sizes = tuple(active["bucket_sizes"])
+
+    # request plane + SLO tracker (both off unless asked for)
+    slo = None
+    plane = None
+    if args.slo_latency_ms is not None:
+        from photon_ml_tpu.serving import SLOTracker
+        from photon_ml_tpu.telemetry.metrics import get_registry
+
+        slo = SLOTracker(
+            latency_threshold_s=args.slo_latency_ms / 1e3,
+            latency_objective=args.slo_latency_objective,
+            availability_objective=args.slo_availability_objective,
+            registry=get_registry(),
+        )
+    if args.request_sample_rate > 0 or slo is not None:
+        from photon_ml_tpu.serving import RequestPlane
+
+        plane = RequestPlane(
+            sample_rate=max(0, args.request_sample_rate),
+            seed=args.request_sample_seed,
+            ledger=telemetry.ledger if telemetry is not None else None,
+            slo=slo,
+        )
+        logger.info(
+            "request plane: sampling ~1/%d requests (seed %d)%s",
+            max(1, args.request_sample_rate), args.request_sample_seed,
+            ", SLO tracking on" if slo is not None else "",
+        )
+    active["request_sample_rate"] = args.request_sample_rate
+    active["slo_latency_ms"] = args.slo_latency_ms
 
     if args.export_artifact_dir:
         from photon_ml_tpu.serving import save_artifact
@@ -375,15 +427,32 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
                 doc["admission"] = adm
                 if not adm.get("healthy", True):
                     degraded.append(adm.get("degraded", "admission dead"))
+            # an exhausted error budget degrades health (still serving,
+            # but the SLO says users are feeling it)
+            if slo is not None:
+                sh = slo.health()
+                doc["slo"] = sh
+                if not sh.get("healthy", True):
+                    degraded.append(sh.get("degraded", "slo budget exhausted"))
             if degraded:
                 doc["healthy"] = False
                 doc["degraded"] = "; ".join(degraded)
             return doc
 
+        def _varz():
+            doc = dict(active)
+            if slo is not None:
+                doc["slo"] = slo.status()
+            return doc
+
+        extra = {}
+        if plane is not None:
+            extra["/requests"] = plane.live_report
         introspect = IntrospectionServer(
-            varz=lambda: dict(active),
+            varz=_varz,
             health=_health,
             port=args.introspect_port,
+            extra_json=extra or None,
         ).start()
         logger.info("introspection endpoints on 127.0.0.1:%d", introspect.port)
         if args.introspect_port_file:
@@ -392,7 +461,7 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
     try:
         snapshot = _serve_stream(
             args, logger, timer, emitter, artifact, model_id, active,
-            bucket_sizes, state,
+            bucket_sizes, state, plane,
         )
         state["phase"] = "drained"
         if introspect is not None and args.introspect_hold > 0:
@@ -409,7 +478,7 @@ def _run_serving(args, logger, timer, emitter) -> Optional[dict]:
 
 def _serve_stream(
     args, logger, timer, emitter, artifact, model_id, active, bucket_sizes,
-    state,
+    state, plane=None,
 ) -> Optional[dict]:
     snapshot: Optional[dict] = None
     if args.data_dirs:
@@ -596,6 +665,7 @@ def _serve_stream(
                 max_wait_s=active["batch_deadline_ms"] / 1e3,
                 max_queue=active["max_queue"],
                 admission=admission,
+                plane=plane,
             )
         if manager is not None:
             logger.info(

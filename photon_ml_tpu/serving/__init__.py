@@ -40,6 +40,18 @@ map onto that design:
 - :mod:`photon_ml_tpu.serving.deltawatch` — the ``--watch-deltas`` poll as
   a supervised daemon (``photon_ml_tpu.resilience``): crashes restart with
   backoff, corrupt deltas are skipped without advancing the generation.
+- :mod:`photon_ml_tpu.serving.requestplane` — sampled per-request
+  lifecycle tracing: a seeded sampler tags ~1/N requests, stage
+  boundaries (queue → featurize → route → dispatch → device → reply) are
+  stamped through the batcher/scorer, hot-swap and admission stalls are
+  folded in as interference, and records drain to the run ledger for
+  ``analyze_run --requests`` tail attribution.
+- :mod:`photon_ml_tpu.serving.slo` — availability + latency objectives
+  over a rolling window with error-budget burn-rate accounting
+  (``/healthz`` degraded reason + ``serving.slo.*`` gauges).
+- :mod:`photon_ml_tpu.serving.scenarios` — seeded traffic-shape scenarios
+  (steady, diurnal, burst storm, cold-entity flood, hot-swap under load)
+  driving ``replay_requests`` for the ``bench.py --scenarios`` harness.
 """
 
 from photon_ml_tpu.serving.artifact import (
@@ -65,6 +77,13 @@ from photon_ml_tpu.serving.hotswap import (
 )
 from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.replay import replay_requests, requests_from_game_data
+from photon_ml_tpu.serving.requestplane import REQUEST_STAGES, RequestPlane
+from photon_ml_tpu.serving.scenarios import (
+    SCENARIO_NAMES,
+    build_scenario,
+    run_scenario,
+)
+from photon_ml_tpu.serving.slo import SLOTracker
 from photon_ml_tpu.serving.routing import (
     CoordinateRouting,
     RoutingIndex,
@@ -80,6 +99,12 @@ from photon_ml_tpu.serving.sharded import (
 __all__ = [
     "AdmissionController",
     "ContinuousBatcher",
+    "REQUEST_STAGES",
+    "RequestPlane",
+    "SCENARIO_NAMES",
+    "SLOTracker",
+    "build_scenario",
+    "run_scenario",
     "CoordinateRouting",
     "CoordinatedHotSwap",
     "DeltaWatcher",

@@ -84,6 +84,10 @@ class AdmissionController:
         self._flip: Dict[str, int] = {}
         self._thread: Optional[SupervisedThread] = None
         self._stop = threading.Event()
+        # request plane (serving/requestplane.py): admit steps hold the
+        # scorers' write locks, so their windows are interference sampled
+        # requests attribute their stalls to
+        self.request_plane = None
         self.admitted_total = 0
         self.evicted_total = 0
         self.deferred_total = 0
@@ -218,6 +222,7 @@ class AdmissionController:
             overflow = fresh[capacity:]
             fresh = fresh[:capacity]
             self._requeue(cid, overflow)
+        t_admit0 = time.perf_counter() if self.request_plane is not None else 0.0
         with span("serve/admit", cid=cid, rows=int(fresh.size)):
             k = self.admit_batch
             shards = np.zeros(k, dtype=np.int32)
@@ -243,6 +248,10 @@ class AdmissionController:
             routing.publish(fresh, a_shards, a_slots)
             self.admitted_total += int(fresh.size)
             self.evicted_total += len(evicted)
+        if self.request_plane is not None:
+            self.request_plane.note_interference(
+                "admission", t_admit0, time.perf_counter()
+            )
         return int(fresh.size)
 
     def _stage(self, cid: str, provider, rows: np.ndarray, k: int) -> np.ndarray:

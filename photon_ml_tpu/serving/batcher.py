@@ -38,6 +38,7 @@ class MicroBatcher:
         metrics: Optional[ServingMetrics] = None,
         clock: Callable[[], float] = time.perf_counter,
         max_wait_s: Optional[float] = None,
+        plane=None,
     ):
         if max_wait_s is not None and max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
@@ -55,6 +56,10 @@ class MicroBatcher:
                 )
         self._scorer = scorer
         self._metrics = metrics
+        # request plane (serving/requestplane.py): lifecycle sampling +
+        # SLO feed; None (the default) costs one check per drained batch
+        self._plane = plane
+        self._stage_capable: Optional[bool] = None
         self._clock = clock
         self.max_wait_s = max_wait_s
         self._pending: "deque[Tuple[ScoreRequest, float]]" = deque()
@@ -104,20 +109,66 @@ class MicroBatcher:
             out.extend(self._drain(min(len(self._pending), self.max_bucket)))
         return out
 
+    def _supports_stages(self) -> bool:
+        """Whether the scorer's ``score_batch`` accepts a stage clock
+        (checked once: drivers may pass scorers without stage support)."""
+        cap = self._stage_capable
+        if cap is None:
+            import inspect
+
+            try:
+                cap = "stages" in inspect.signature(
+                    self._scorer.score_batch
+                ).parameters
+            except (TypeError, ValueError):
+                cap = False
+            self._stage_capable = cap
+        return cap
+
     def _drain(self, n: int) -> List[ScoreResult]:
         batch = [self._pending.popleft() for _ in range(n)]
         dequeued = self._clock()
         bucket = self._bucket_for(n)
-        with span("serve/drain", n=n, bucket=bucket):
-            results = self._scorer.score_batch([req for req, _ in batch], bucket)
-        done = self._clock()
-        if self._metrics is not None:
-            self._metrics.observe_batch(
-                n_real=n, bucket_size=bucket, queue_depth=len(self._pending)
+        plane = self._plane
+        sampled: Optional[List[int]] = None
+        stages: Optional[dict] = None
+        if plane is not None:
+            sampled = plane.sample_indices(
+                [req.request_id for req, _ in batch]
             )
+            if sampled and self._supports_stages():
+                stages = {}
+        with span("serve/drain", n=n, bucket=bucket):
+            if stages is not None:
+                results = self._scorer.score_batch(
+                    [req for req, _ in batch], bucket, stages=stages
+                )
+            else:
+                results = self._scorer.score_batch(
+                    [req for req, _ in batch], bucket
+                )
+        done = self._clock()
+        if self._metrics is not None or plane is not None:
             enqueued = np.fromiter(
                 (t for _, t in batch), dtype=np.float64, count=n
             )
-            self._metrics.observe_queue_waits(dequeued - enqueued)
-            self._metrics.observe_latencies(done - enqueued, bucket_size=bucket)
+            latencies = done - enqueued
+            if self._metrics is not None:
+                self._metrics.observe_batch(
+                    n_real=n, bucket_size=bucket,
+                    queue_depth=len(self._pending),
+                )
+                self._metrics.observe_queue_waits(dequeued - enqueued)
+                self._metrics.observe_latencies(latencies, bucket_size=bucket)
+            if plane is not None:
+                plane.observe_complete(latencies)
+                if sampled:
+                    plane.record_batch(
+                        "sealed", bucket, n,
+                        [
+                            (batch[i][0].request_id, batch[i][1])
+                            for i in sampled
+                        ],
+                        dequeued, stages, done,
+                    )
         return results

@@ -74,6 +74,7 @@ class ContinuousBatcher:
         max_wait_s: float = 0.002,
         max_queue: Optional[int] = None,
         clock: Callable[[], float] = time.perf_counter,
+        plane=None,
     ):
         scorers = (
             list(scorers) if isinstance(scorers, (list, tuple)) else [scorers]
@@ -104,6 +105,10 @@ class ContinuousBatcher:
                 f"max_queue {self.max_queue} < max bucket {self.max_bucket}"
             )
         self._metrics = metrics
+        # request plane (serving/requestplane.py): lifecycle sampling +
+        # SLO feed; None (the default) costs one check per drained batch
+        self._plane = plane
+        self._stage_capable: dict = {}
         self._clock = clock
         self._cond = threading.Condition()
         self._pending: "deque[Tuple[ScoreRequest, float, PendingResult]]" = (
@@ -287,17 +292,48 @@ class ContinuousBatcher:
                 self._cond.notify_all()
             self._score(scorer, batch)
 
+    def _supports_stages(self, scorer) -> bool:
+        """Whether this replica's ``score_batch`` accepts a stage clock
+        (checked once per scorer: drivers may pass stage-less scorers)."""
+        key = id(scorer)
+        cap = self._stage_capable.get(key)
+        if cap is None:
+            import inspect
+
+            try:
+                cap = "stages" in inspect.signature(
+                    scorer.score_batch
+                ).parameters
+            except (TypeError, ValueError):
+                cap = False
+            self._stage_capable[key] = cap
+        return cap
+
     def _score(self, scorer, batch) -> None:
         n = len(batch)
         dequeued = self._clock()
         bucket = self._bucket_for(n)
+        plane = self._plane
+        sampled: Optional[List[int]] = None
+        stages: Optional[dict] = None
+        if plane is not None:
+            sampled = plane.sample_indices(
+                [req.request_id for req, _, _ in batch]
+            )
+            if sampled and self._supports_stages(scorer):
+                stages = {}
         results: Optional[List[ScoreResult]] = None
         error: Optional[BaseException] = None
         try:
             with span("serve/drain", n=n, bucket=bucket):
-                results = scorer.score_batch(
-                    [req for req, _, _ in batch], bucket
-                )
+                if stages is not None:
+                    results = scorer.score_batch(
+                        [req for req, _, _ in batch], bucket, stages=stages
+                    )
+                else:
+                    results = scorer.score_batch(
+                        [req for req, _, _ in batch], bucket
+                    )
         except BaseException as e:  # resolve handles, keep the loop alive
             error = e
             self._scorer_errors += 1
@@ -311,12 +347,28 @@ class ContinuousBatcher:
                 handle.done = True
             self._inflight -= n
             self._cond.notify_all()
-        if self._metrics is not None and error is None:
-            self._metrics.observe_batch(
-                n_real=n, bucket_size=bucket, queue_depth=len(self._pending)
-            )
+        if plane is not None and error is not None:
+            plane.observe_errors(n)
+        if error is None and (self._metrics is not None or plane is not None):
             enqueued = np.fromiter(
                 (t for _, t, _ in batch), dtype=np.float64, count=n
             )
-            self._metrics.observe_queue_waits(dequeued - enqueued)
-            self._metrics.observe_latencies(done - enqueued, bucket_size=bucket)
+            latencies = done - enqueued
+            if self._metrics is not None:
+                self._metrics.observe_batch(
+                    n_real=n, bucket_size=bucket,
+                    queue_depth=len(self._pending),
+                )
+                self._metrics.observe_queue_waits(dequeued - enqueued)
+                self._metrics.observe_latencies(latencies, bucket_size=bucket)
+            if plane is not None:
+                plane.observe_complete(latencies)
+                if sampled:
+                    plane.record_batch(
+                        "continuous", bucket, n,
+                        [
+                            (batch[i][0].request_id, batch[i][1])
+                            for i in sampled
+                        ],
+                        dequeued, stages, done,
+                    )

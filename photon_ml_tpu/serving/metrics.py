@@ -100,12 +100,28 @@ class _Reservoir:
         return self._samples[: len(self)]
 
     def percentile(self, q) -> np.ndarray:
-        return np.percentile(self.samples(), q)
+        """Percentile(s) of the kept sample. An EMPTY reservoir returns
+        NaN shaped like ``q`` (scalar q -> scalar NaN, array q -> NaN
+        array) instead of letting numpy raise — callers guard on
+        ``count`` for display, but analysis paths may probe blind."""
+        samples = self.samples()
+        if samples.size == 0:
+            return np.full(np.shape(q), np.nan)[()]
+        return np.percentile(samples, q)
 
 
 class ServingMetrics:
-    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        request_plane=None,
+    ):
         self._clock = clock
+        # request plane (serving/requestplane.py): hot-swap blackouts are
+        # forwarded as interference spans so swap pauses show up in the
+        # sampled requests' p99 breakdown instead of vanishing from every
+        # latency attribution
+        self.request_plane = request_plane
         self._latencies = _Reservoir(seed=0)
         self._hist = np.zeros(len(LATENCY_BUCKET_BOUNDS) + 1, dtype=np.int64)
         self._fill_real = 0
@@ -208,6 +224,15 @@ class ServingMetrics:
         self._max_swap_blackout_s = max(
             self._max_swap_blackout_s, float(blackout_s)
         )
+        if self.request_plane is not None and blackout_s > 0:
+            # the swap manager calls this right after its critical section,
+            # so the pause window is [now - blackout, now] on the shared
+            # perf_counter timebase — in-flight and queued sampled requests
+            # overlap it and attribute the pause as swap_pause interference
+            end = self._clock()
+            self.request_plane.note_interference(
+                "swap_pause", end - float(blackout_s), end
+            )
         if rolled_back:
             self.num_rollbacks += 1
             return

@@ -94,6 +94,7 @@ def replay_requests(
     max_wait_s: float = 0.002,
     max_queue: Optional[int] = None,
     admission=None,
+    plane=None,
 ) -> Tuple[List[ScoreResult], dict]:
     """Pump a request stream through a fresh microbatcher.
 
@@ -113,12 +114,26 @@ def replay_requests(
     bound deadline and backpressure. An ``AdmissionController`` passed as
     ``admission`` runs for the duration of the replay (started/stopped
     here when not already running) and its stats ride in the snapshot.
+
+    A :class:`~photon_ml_tpu.serving.requestplane.RequestPlane` passed as
+    ``plane`` is threaded through the batcher (lifecycle sampling + SLO
+    feed), the metrics (hot-swap pauses become interference spans), and
+    the admission controller (admit windows likewise); its summary — and
+    the SLO status when the plane carries a tracker — ride in the
+    snapshot under ``"request_plane"`` / ``"slo"``. ``plane=None`` (the
+    default) is the bitwise-pinned zero-cost path.
     """
     from photon_ml_tpu.event import ScoringFinishEvent, ScoringStartEvent
 
     scorers = list(scorer) if isinstance(scorer, (list, tuple)) else [scorer]
     lead = scorers[0]
     metrics = metrics if metrics is not None else ServingMetrics()
+    if plane is not None:
+        # interference producers: hot-swap pauses via the metrics hook,
+        # admission windows via the controller hook
+        metrics.request_plane = plane
+        if admission is not None:
+            admission.request_plane = plane
     if emitter is not None:
         emitter.send_event(
             ScoringStartEvent(model_id=model_id, num_requests=len(requests))
@@ -144,6 +159,7 @@ def replay_requests(
                     metrics=metrics,
                     max_wait_s=max_wait_s,
                     max_queue=max_queue,
+                    plane=plane,
                 ).start()
                 try:
                     handles = []
@@ -170,7 +186,8 @@ def replay_requests(
                         "continuous=True for multi-scorer mode"
                     )
                 batcher = MicroBatcher(
-                    lead, bucket_sizes=bucket_sizes, metrics=metrics
+                    lead, bucket_sizes=bucket_sizes, metrics=metrics,
+                    plane=plane,
                 )
                 for i, req in enumerate(requests):
                     if watching and i % poll_every == 0:
@@ -197,6 +214,12 @@ def replay_requests(
     snapshot["replay_wall_seconds"] = round(wall, 6)
     if wall > 0:
         snapshot["replay_requests_per_s"] = round(len(requests) / wall, 3)
+    if plane is not None:
+        report = plane.live_report()
+        slo = report.pop("slo", None)
+        snapshot["request_plane"] = report
+        if slo is not None:
+            snapshot["slo"] = slo
     if watching:
         snapshot["swap_reports"] = [
             {

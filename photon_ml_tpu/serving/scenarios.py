@@ -1,0 +1,346 @@
+"""Seeded traffic-shape scenarios for the serving replay harness.
+
+Steady-state replay (``bench.py --serving``) regression-gates one traffic
+shape. Production regressions live in the others: a diurnal ramp that
+outruns admission, a burst storm that fills the backpressure queue, a
+cold-entity flood that craters device residency, a hot-swap landing under
+load. Each scenario here is a deterministic (seeded) reshaping of a base
+request stream into phases driven through
+:func:`~photon_ml_tpu.serving.replay.replay_requests`, with the request
+plane sampling lifecycles and the SLO tracker keeping the verdict — so
+``bench.py --scenarios`` emits one per-stage p50/p99 breakdown, residency
+rate, and SLO verdict per traffic shape into ``BENCH_SCENARIOS.json``,
+and the CI scenario sentinel gates them all.
+
+Scenario catalog (``SCENARIO_NAMES``):
+
+``steady``
+    The base stream in even phases — the control arm; matches the
+    ``--serving`` bench's shape.
+``diurnal``
+    A one-day load curve compressed into the replay: sinusoidal phase
+    sizes (peak ~3x trough) with idle gaps before the troughs, so the
+    batcher's deadline path and the admission tier see both regimes.
+``burst_storm``
+    Quiet trickle phases alternating with full-queue bursts — the shape
+    that exposes backpressure and queue-wait tails.
+``cold_entity_flood``
+    A steady warmup, then phases whose entity ids are remapped (seeded)
+    to the least-popular tail — device residency collapses and the
+    admission tier has to re-admit under traffic.
+``hot_swap_under_load``
+    The steady shape with concurrent hot-swap row updates during the
+    middle phases (a swapper thread contends with scoring through the
+    write locks) — the arm that proves swap pauses land in the p99
+    breakdown as ``swap_pause`` interference, not as unexplained time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import Counter
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.serving.replay import replay_requests
+from photon_ml_tpu.serving.scorer import ScoreRequest
+
+SCENARIO_NAMES = (
+    "steady",
+    "diurnal",
+    "burst_storm",
+    "cold_entity_flood",
+    "hot_swap_under_load",
+)
+
+# stable per-scenario seed offsets: the same (seed, name) always produces
+# the same phase layout and entity remapping
+_NAME_SEEDS = {name: 1000 + i for i, name in enumerate(SCENARIO_NAMES)}
+
+
+@dataclasses.dataclass
+class ScenarioPhase:
+    """One replay leg: a request slice, an optional idle gap before it,
+    and whether hot-swap updates run concurrently with it."""
+
+    requests: List[ScoreRequest]
+    pause_before_s: float = 0.0
+    swap: bool = False
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    seed: int
+    phases: List[ScenarioPhase]
+    description: str = ""
+
+    @property
+    def num_requests(self) -> int:
+        return sum(len(p.requests) for p in self.phases)
+
+
+def _cold_remap(
+    requests: Sequence[ScoreRequest], rng: np.random.Generator
+) -> List[ScoreRequest]:
+    """Rewrite entity ids to the least-popular half of the observed id
+    population (per RE type) — a flood of entities that are known to the
+    model but unlikely to be device-resident."""
+    freq: Dict[str, Counter] = {}
+    for req in requests:
+        for re_type, eid in req.entity_ids.items():
+            freq.setdefault(re_type, Counter())[eid] += 1
+    tails: Dict[str, List[str]] = {}
+    for re_type, counts in freq.items():
+        ranked = [e for e, _ in counts.most_common()]
+        tail = ranked[len(ranked) // 2:]
+        tails[re_type] = tail if tail else ranked
+    out: List[ScoreRequest] = []
+    for req in requests:
+        remapped = {
+            re_type: tails[re_type][int(rng.integers(len(tails[re_type])))]
+            for re_type in req.entity_ids
+        }
+        out.append(
+            ScoreRequest(
+                request_id=f"{req.request_id}-cold",
+                features=req.features,
+                entity_ids=remapped,
+                offset=req.offset,
+            )
+        )
+    return out
+
+
+def build_scenario(
+    name: str,
+    requests: Sequence[ScoreRequest],
+    seed: int = 0,
+    num_phases: int = 8,
+    pause_s: float = 0.01,
+) -> Scenario:
+    """Deterministically reshape ``requests`` into the named scenario.
+
+    ``pause_s`` scales the idle gaps (diurnal troughs, storm quiets);
+    smoke/CI callers shrink it, the committed bench uses the default.
+    """
+    if name not in SCENARIO_NAMES:
+        raise ValueError(
+            f"unknown scenario {name!r} (expected one of {SCENARIO_NAMES})"
+        )
+    requests = list(requests)
+    n = len(requests)
+    if n == 0:
+        raise ValueError("scenario needs a non-empty request stream")
+    num_phases = max(2, int(num_phases))
+    rng = np.random.default_rng(int(seed) + _NAME_SEEDS[name])
+    even = [
+        requests[(k * n) // num_phases : ((k + 1) * n) // num_phases]
+        for k in range(num_phases)
+    ]
+
+    if name == "steady":
+        phases = [ScenarioPhase(chunk) for chunk in even if chunk]
+        desc = "even phases, no idle gaps (control arm)"
+    elif name == "diurnal":
+        # sinusoidal weights, peak ~3x trough; idle gaps ahead of troughs
+        w = np.array(
+            [
+                1.0 + 0.5 * math.sin(2.0 * math.pi * k / num_phases)
+                for k in range(num_phases)
+            ]
+        )
+        bounds = np.floor(np.cumsum(w) / w.sum() * n).astype(int)
+        lo = 0
+        phases = []
+        w_min, w_max = float(w.min()), float(w.max())
+        for k, hi in enumerate(bounds):
+            chunk = requests[lo:int(hi)]
+            lo = int(hi)
+            if not chunk:
+                continue
+            # trough phases idle first: low weight -> long gap
+            frac = (w_max - float(w[k])) / max(w_max - w_min, 1e-9)
+            phases.append(ScenarioPhase(chunk, pause_before_s=pause_s * frac))
+        desc = "sinusoidal load curve, peak ~3x trough, idle troughs"
+    elif name == "burst_storm":
+        # odd phases are trickles, even phases dump a double share at once
+        phases = []
+        for k, chunk in enumerate(even):
+            if not chunk:
+                continue
+            if k % 2 == 0:
+                phases.append(ScenarioPhase(chunk, pause_before_s=pause_s))
+            else:
+                keep = chunk[: max(1, len(chunk) // 8)]
+                spill = chunk[len(keep):]
+                phases.append(ScenarioPhase(keep))
+                if spill:
+                    if k + 1 < num_phases:
+                        # the spilled share rides the NEXT storm
+                        even[k + 1] = spill + even[k + 1]
+                    else:
+                        # trailing trickle: its spill lands as a closing
+                        # burst so the stream is preserved exactly
+                        phases.append(
+                            ScenarioPhase(spill, pause_before_s=pause_s)
+                        )
+        desc = "idle gaps then full-queue bursts (backpressure shape)"
+    elif name == "cold_entity_flood":
+        warm = num_phases // 2
+        phases = [ScenarioPhase(chunk) for chunk in even[:warm] if chunk]
+        for chunk in even[warm:]:
+            if chunk:
+                phases.append(ScenarioPhase(_cold_remap(chunk, rng)))
+        desc = "steady warmup, then entity ids remapped to the cold tail"
+    else:  # hot_swap_under_load
+        phases = []
+        for k, chunk in enumerate(even):
+            if not chunk:
+                continue
+            swap = 0 < k < num_phases - 1  # swaps land mid-run, under load
+            phases.append(ScenarioPhase(chunk, swap=swap))
+        desc = "steady load with concurrent hot-swap row updates mid-run"
+    return Scenario(name=name, seed=int(seed), phases=phases, description=desc)
+
+
+def make_row_swap_fn(
+    scorers,
+    metrics,
+    rows_per_swap: int = 32,
+    scale: float = 0.01,
+    seed: int = 0,
+) -> Optional[Callable[[], None]]:
+    """A hot-swap driver for ``hot_swap_under_load``: each call rewrites
+    ``rows_per_swap`` random rows of one RE coordinate in place through
+    the lead scorer's ``update_random_effect_rows`` (fanning out to every
+    replica) and reports the measured pause via ``metrics.observe_swap``
+    — the real write-lock contention path, generation bumps included.
+    Returns None when the scorer exposes no updatable RE coordinate."""
+    scorers = list(scorers) if isinstance(scorers, (list, tuple)) else [scorers]
+    lead = scorers[0]
+    artifact = getattr(lead, "artifact", None)
+    if artifact is None:
+        return None
+    re_cids = [
+        cid for cid, t in sorted(artifact.tables.items()) if t.is_random_effect
+    ]
+    if not re_cids:
+        return None
+    rng = np.random.default_rng(seed + 77)
+    state = {"generation": getattr(metrics, "current_generation", 0)}
+
+    def _swap() -> None:
+        cid = re_cids[int(rng.integers(len(re_cids)))]
+        table = artifact.tables[cid]
+        n_rows, dim = table.weights.shape
+        k = min(rows_per_swap, n_rows)
+        rows = rng.choice(n_rows, size=k, replace=False)
+        values = (
+            np.asarray(table.weights[rows], dtype=np.float32)
+            + rng.standard_normal((k, dim)).astype(np.float32) * scale
+        )
+        t0 = time.perf_counter()
+        lead.update_random_effect_rows(cid, rows, values)
+        pause = time.perf_counter() - t0
+        state["generation"] += 1
+        if metrics is not None:
+            metrics.observe_swap(
+                generation=state["generation"], rows_updated=k,
+                blackout_s=pause,
+            )
+
+    return _swap
+
+
+def run_scenario(
+    scenario: Scenario,
+    scorers,
+    bucket_sizes: Sequence[int],
+    metrics,
+    plane=None,
+    slo=None,
+    admission=None,
+    continuous: bool = True,
+    max_wait_s: float = 0.002,
+    max_queue: Optional[int] = None,
+    swap_fn: Optional[Callable[[], None]] = None,
+    swap_interval_s: float = 0.01,
+) -> dict:
+    """Drive one scenario through ``replay_requests`` phase by phase and
+    return its result document: per-stage p50/p99 breakdown (from the
+    request plane), residency rate, throughput, and the SLO verdict.
+
+    The caller owns the metrics/plane/slo objects (fresh per scenario for
+    isolated verdicts) and the scorers/admission (shared across scenarios
+    for realistic warm state, or fresh for isolation)."""
+    results = []
+    t0 = time.perf_counter()
+    for phase in scenario.phases:
+        if phase.pause_before_s > 0:
+            time.sleep(phase.pause_before_s)
+        stop_swapper = None
+        swapper = None
+        if phase.swap and swap_fn is not None:
+            stop_swapper = threading.Event()
+
+            def _swap_loop(evt=stop_swapper):
+                while not evt.is_set():
+                    swap_fn()
+                    evt.wait(swap_interval_s)
+
+            swapper = threading.Thread(
+                target=_swap_loop, name="scenario-swapper", daemon=True
+            )
+            swapper.start()
+        try:
+            res, snapshot = replay_requests(
+                scorers,
+                phase.requests,
+                bucket_sizes=bucket_sizes,
+                metrics=metrics,
+                model_id=f"scenario-{scenario.name}",
+                continuous=continuous,
+                max_wait_s=max_wait_s,
+                max_queue=max_queue,
+                admission=admission,
+                plane=plane,
+            )
+            results.extend(res)
+        finally:
+            if stop_swapper is not None:
+                stop_swapper.set()
+                swapper.join()
+    wall = time.perf_counter() - t0
+
+    doc: dict = {
+        "name": scenario.name,
+        "description": scenario.description,
+        "seed": scenario.seed,
+        "num_phases": len(scenario.phases),
+        "num_requests": len(results),
+        "wall_seconds": round(wall, 6),
+        "requests_per_s": round(len(results) / wall, 3) if wall > 0 else 0.0,
+    }
+    for key in (
+        "latency_p50_s", "latency_p99_s", "batch_fill_ratio",
+        "device_resident_rate", "deferred_rate",
+    ):
+        if key in snapshot:
+            doc[key] = snapshot[key]
+    if "swaps" in snapshot:
+        doc["swaps"] = snapshot["swaps"]
+    if plane is not None:
+        report = plane.live_report()
+        report.pop("slo", None)
+        doc["request_plane"] = report
+    tracker = slo if slo is not None else getattr(plane, "_slo", None)
+    if tracker is not None:
+        status = tracker.status()
+        doc["slo"] = status
+        doc["slo_verdict"] = status["verdict"]
+    return doc

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import operator
+import time
 from itertools import chain
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -410,9 +411,16 @@ class GameScorer:
         self,
         requests: Sequence[ScoreRequest],
         bucket_size: Optional[int] = None,
+        stages: Optional[dict] = None,
     ) -> List[ScoreResult]:
         """Score up to ``bucket_size`` requests, padding the batch to exactly
-        that size (defaults to ``len(requests)``). Results keep request order."""
+        that size (defaults to ``len(requests)``). Results keep request order.
+
+        ``stages`` is the request plane's stage clock: when a dict is
+        passed (only for batches carrying a sampled request), monotonic
+        stage-boundary timestamps are stamped into it (featurize_done,
+        route_done, dispatch_done, device_done). ``None`` — the default —
+        costs nothing."""
         import jax.numpy as jnp
 
         n = len(requests)
@@ -423,17 +431,20 @@ class GameScorer:
             raise ValueError(f"{n} requests do not fit bucket size {bucket}")
 
         with span("serve/score_batch", n=n, bucket=bucket):
-            return self._score_batch_impl(requests, n, bucket)
+            return self._score_batch_impl(requests, n, bucket, stages)
 
     def _score_batch_impl(
         self,
         requests: Sequence[ScoreRequest],
         n: int,
         bucket: int,
+        stages: Optional[dict] = None,
     ) -> List[ScoreResult]:
         import jax.numpy as jnp
 
         shards, offsets = self._featurize(requests, bucket)
+        if stages is not None:
+            stages["featurize_done"] = time.perf_counter()
         slots: Dict[str, np.ndarray] = {}
         cold: List[List[str]] = [[] for _ in range(n)]
         for cid, _, re_type in self._re_specs:
@@ -471,6 +482,8 @@ class GameScorer:
             )
             slots[cid] = cid_slots
 
+        if stages is not None:
+            stages["route_done"] = time.perf_counter()
         batch = {
             "offsets": jnp.asarray(offsets),
             "shards": {
@@ -484,8 +497,15 @@ class GameScorer:
             "re": {cid: self._providers[cid].table for cid, _, _ in self._re_specs},
         }
         z, mean = self._score_fn(params, batch)
+        if stages is not None:
+            # jit dispatch is asynchronous: this boundary closes H2D +
+            # program dispatch; the host materialization below blocks on
+            # the device, closing the "device" stage
+            stages["dispatch_done"] = time.perf_counter()
         z = np.asarray(z)
         mean = np.asarray(mean)
+        if stages is not None:
+            stages["device_done"] = time.perf_counter()
         return [
             ScoreResult(
                 request_id=req.request_id,

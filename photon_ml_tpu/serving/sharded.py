@@ -31,6 +31,7 @@ from __future__ import annotations
 import contextlib
 import operator
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -604,6 +605,7 @@ class ShardedGameScorer:
         self,
         requests: Sequence[ScoreRequest],
         bucket_size: Optional[int] = None,
+        stages: Optional[dict] = None,
     ) -> List[ScoreResult]:
         n = len(requests)
         bucket = int(bucket_size) if bucket_size is not None else n
@@ -612,15 +614,21 @@ class ShardedGameScorer:
         if n > bucket:
             raise ValueError(f"{n} requests do not fit bucket size {bucket}")
         with span("serve/score_batch", n=n, bucket=bucket):
-            return self._score_batch_impl(requests, n, bucket)
+            return self._score_batch_impl(requests, n, bucket, stages)
 
     def _score_batch_impl(
-        self, requests: Sequence[ScoreRequest], n: int, bucket: int
+        self,
+        requests: Sequence[ScoreRequest],
+        n: int,
+        bucket: int,
+        stages: Optional[dict] = None,
     ) -> List[ScoreResult]:
         import jax.numpy as jnp
 
         with span("serve/featurize", n=n):
             shards, offsets = self._featurize(requests, bucket)
+        if stages is not None:
+            stages["featurize_done"] = time.perf_counter()
         re_shards: Dict[str, np.ndarray] = {}
         slots: Dict[str, np.ndarray] = {}
         cold: Dict[int, List[str]] = {}
@@ -676,6 +684,8 @@ class ShardedGameScorer:
                 for i in served_cold:
                     cold.setdefault(int(i), []).append(cid)
 
+        if stages is not None:
+            stages["route_done"] = time.perf_counter()
         batch = {
             "offsets": jnp.asarray(offsets),
             "shards": {
@@ -700,8 +710,15 @@ class ShardedGameScorer:
             }
             with span("serve/gather_score", n=n, bucket=bucket):
                 z, mean = self._score_fn(params, batch)
+                if stages is not None:
+                    # closes H2D + dispatch (includes any write_lock wait —
+                    # admission interference spans make that attributable);
+                    # the materialization below blocks on the device
+                    stages["dispatch_done"] = time.perf_counter()
                 z_list = np.asarray(z)[:n].tolist()
                 mean_list = np.asarray(mean)[:n].tolist()
+        if stages is not None:
+            stages["device_done"] = time.perf_counter()
         empty: Tuple[str, ...] = ()
         return [
             ScoreResult(

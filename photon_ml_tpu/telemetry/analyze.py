@@ -33,8 +33,10 @@ import dataclasses
 import json
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from photon_ml_tpu.telemetry.progress import convergence_report
-from photon_ml_tpu.telemetry.validate import validate_ledger
+from photon_ml_tpu.telemetry.validate import _REQUEST_STAGES, validate_ledger
 
 __all__ = [
     "RunReport",
@@ -42,6 +44,8 @@ __all__ = [
     "analyze_records",
     "classify_span",
     "format_report",
+    "format_request_report",
+    "request_report",
     "PHASES",
 ]
 
@@ -127,6 +131,9 @@ class RunReport:
     # convergence-plane reconstruction (telemetry.progress.convergence_report)
     # when the ledger carries "progress" records; None for perf-only ledgers
     progress: Optional[Dict[str, Any]] = None
+    # request-plane tail attribution (request_report) when the ledger
+    # carries sampled "request" lifecycle records; None otherwise
+    requests: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -234,6 +241,161 @@ def _span_tree_summary(spans: List[dict], max_depth: int = 2) -> Dict[str, dict]
     return dict(sorted(out.items()))
 
 
+def request_report(
+    records: Sequence[Dict[str, Any]], tail_q: float = 99.0
+) -> Optional[Dict[str, Any]]:
+    """Tail-latency attribution over sampled ``request`` lifecycle records.
+
+    Joins the request-plane's per-request stage durations into per-stage
+    p50/p99 distributions, then isolates the tail (requests at or above
+    the ``tail_q`` end-to-end percentile) and breaks its latency down by
+    stage — because the stage boundaries telescope, the per-stage tail
+    breakdown sums to the tail's end-to-end time (``coverage`` ~1.0), so
+    "where did the p99 go" has a complete answer. Interference overlap
+    (``swap_pause``, ``admission`` seconds inside request windows) is
+    aggregated alongside, and the worst bucket carries exemplar request
+    ids for flight-recorder-style drill-down. Returns None when the
+    records carry no request entries.
+    """
+    reqs = [
+        r
+        for r in records
+        if r.get("type") == "request" and isinstance(r.get("stages"), dict)
+    ]
+    if not reqs:
+        return None
+    totals = np.array([float(r.get("total_s", 0.0)) for r in reqs])
+    per_stage = {
+        s: np.array([float(r["stages"].get(s, 0.0)) for r in reqs])
+        for s in _REQUEST_STAGES
+    }
+
+    def _dist(a: np.ndarray) -> Dict[str, float]:
+        return {
+            "p50_s": round(float(np.percentile(a, 50)), 9),
+            "p99_s": round(float(np.percentile(a, 99)), 9),
+            "mean_s": round(float(a.mean()), 9),
+            "max_s": round(float(a.max()), 9),
+        }
+
+    stages = {s: _dist(a) for s, a in per_stage.items()}
+    e2e = _dist(totals)
+
+    # ---- the tail: requests at/above the e2e tail_q percentile ----------
+    threshold = float(np.percentile(totals, tail_q))
+    tail_idx = np.nonzero(totals >= threshold)[0]
+    tail_total = float(totals[tail_idx].mean())
+    breakdown = {
+        s: round(float(per_stage[s][tail_idx].mean()), 9)
+        for s in _REQUEST_STAGES
+    }
+    covered = sum(breakdown.values())
+    worst_stage = max(breakdown, key=lambda s: breakdown[s])
+
+    # worst bucket among tail requests, with exemplar ids for drill-down
+    by_bucket: Dict[int, List[int]] = {}
+    for i in tail_idx:
+        by_bucket.setdefault(int(reqs[int(i)].get("bucket", -1)), []).append(
+            int(i)
+        )
+    worst_bucket, worst_members = max(
+        by_bucket.items(), key=lambda kv: float(totals[kv[1]].mean())
+    )
+    exemplar_idx = sorted(worst_members, key=lambda i: -totals[i])[:3]
+
+    # ---- interference join ----------------------------------------------
+    interference: Dict[str, Dict[str, float]] = {}
+    for i, r in enumerate(reqs):
+        for key, v in (r.get("interference") or {}).items():
+            kind = key[:-2] if key.endswith("_s") else key
+            entry = interference.setdefault(
+                kind, {"requests": 0, "total_s": 0.0, "tail_s": 0.0}
+            )
+            entry["requests"] += 1
+            entry["total_s"] += float(v)
+            if totals[i] >= threshold:
+                entry["tail_s"] += float(v)
+    for entry in interference.values():
+        entry["total_s"] = round(entry["total_s"], 9)
+        entry["tail_s"] = round(entry["tail_s"], 9)
+
+    by_batcher: Dict[str, int] = {}
+    for r in reqs:
+        name = str(r.get("batcher", "?"))
+        by_batcher[name] = by_batcher.get(name, 0) + 1
+
+    return {
+        "num_records": len(reqs),
+        "stages": stages,
+        "e2e": e2e,
+        "tail": {
+            "quantile": tail_q / 100.0,
+            "threshold_s": round(threshold, 9),
+            "num_requests": int(tail_idx.size),
+            "mean_total_s": round(tail_total, 9),
+            "breakdown_s": breakdown,
+            "attribution_coverage": (
+                round(covered / tail_total, 6) if tail_total > 0 else 1.0
+            ),
+            "worst_stage": worst_stage,
+            "worst_bucket": worst_bucket,
+            "exemplars": [reqs[i].get("request_id") for i in exemplar_idx],
+        },
+        "interference": interference,
+        "by_batcher": by_batcher,
+    }
+
+
+def format_request_report(report: Dict[str, Any]) -> str:
+    """Human-readable tail-attribution table (``analyze_run --requests``
+    and the live ``/requests`` route's text form)."""
+    lines = [
+        f"request plane: {report['num_records']} sampled lifecycle record(s)"
+    ]
+    e2e = report.get("e2e") or {}
+    if e2e:
+        lines.append(
+            f"  end-to-end   p50 {e2e['p50_s'] * 1e3:9.3f}ms   "
+            f"p99 {e2e['p99_s'] * 1e3:9.3f}ms   "
+            f"max {e2e['max_s'] * 1e3:9.3f}ms"
+        )
+    lines.append(f"  {'stage':<12} {'p50 ms':>10} {'p99 ms':>10} {'tail ms':>10}")
+    tail = report.get("tail") or {}
+    breakdown = tail.get("breakdown_s") or {}
+    for stage, dist in (report.get("stages") or {}).items():
+        lines.append(
+            f"  {stage:<12} {dist['p50_s'] * 1e3:>10.3f} "
+            f"{dist['p99_s'] * 1e3:>10.3f} "
+            f"{breakdown.get(stage, 0.0) * 1e3:>10.3f}"
+        )
+    if tail:
+        lines.append(
+            f"  tail (>= p{tail['quantile'] * 100:.0f}): "
+            f"{tail['num_requests']} request(s) >= "
+            f"{tail['threshold_s'] * 1e3:.3f}ms, worst stage "
+            f"'{tail['worst_stage']}', attribution coverage "
+            f"{tail['attribution_coverage'] * 100:.2f}%"
+        )
+        lines.append(
+            f"  worst bucket {tail['worst_bucket']}: exemplar ids "
+            + ", ".join(str(x) for x in tail.get("exemplars") or [])
+        )
+    interference = report.get("interference") or {}
+    for kind, entry in sorted(interference.items()):
+        lines.append(
+            f"  interference '{kind}': {entry['requests']} request(s), "
+            f"{entry['total_s'] * 1e3:.3f}ms overlap "
+            f"({entry['tail_s'] * 1e3:.3f}ms on the tail)"
+        )
+    by_batcher = report.get("by_batcher") or {}
+    if by_batcher:
+        lines.append(
+            "  by batcher: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(by_batcher.items()))
+        )
+    return "\n".join(lines)
+
+
 def analyze_records(
     records: Sequence[Dict[str, Any]],
     source_path: Optional[str] = None,
@@ -246,6 +408,7 @@ def analyze_records(
     events = [r for r in records if r.get("type") == "event"]
     metric_recs = [r for r in records if r.get("type") == "metrics"]
     progress_recs = [r for r in records if r.get("type") == "progress"]
+    request_recs = [r for r in records if r.get("type") == "request"]
 
     label = next(
         (m.get("label", "run") for m in metas if m.get("phase") == "start"),
@@ -472,6 +635,7 @@ def analyze_records(
         progress=(
             convergence_report(progress_recs) if progress_recs else None
         ),
+        requests=request_report(request_recs) if request_recs else None,
     )
 
 
@@ -556,6 +720,15 @@ def format_report(report: RunReport) -> str:
             "coordinate(s)"
             + (f", {len(anomalies)} ANOMALY record(s)" if anomalies else "")
             + " — full report via analyze_run --progress"
+        )
+    if report.requests:
+        req = report.requests
+        tail = req.get("tail") or {}
+        lines.append(
+            f"  request plane: {req.get('num_records', 0)} sampled "
+            f"lifecycle record(s), tail worst stage "
+            f"'{tail.get('worst_stage', '?')}' — full attribution via "
+            "analyze_run --requests"
         )
     if report.warnings:
         lines.append("")

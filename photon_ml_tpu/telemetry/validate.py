@@ -29,7 +29,14 @@ _LEDGER_SCHEMAS: Dict[str, tuple] = {
     # convergence plane (telemetry/progress.py): one record per coordinate
     # update / validation probe / streamed block / watchdog anomaly
     "progress": ("kind",),
+    # request plane (serving/requestplane.py): one record per SAMPLED
+    # serving request — per-stage exclusive seconds + end-to-end latency
+    "request": ("request_id", "bucket", "stages", "total_s"),
 }
+
+# per-stage exclusive durations every request record's "stages" dict must
+# carry (they telescope: their sum IS total_s)
+_REQUEST_STAGES = ("queue", "featurize", "route", "dispatch", "device", "reply")
 
 # progress record kind -> required extra fields beyond "kind"
 _PROGRESS_SCHEMAS: Dict[str, tuple] = {
@@ -143,6 +150,24 @@ def validate_ledger(
                         f"{path}:{lineno}: progress/{kind} record missing "
                         f"{field!r}"
                     )
+        if rec_type == "request":
+            stages = rec.get("stages")
+            if not isinstance(stages, dict):
+                raise ValueError(
+                    f"{path}:{lineno}: request record 'stages' must be an "
+                    f"object, got {type(stages).__name__}"
+                )
+            for stage in _REQUEST_STAGES:
+                if not isinstance(stages.get(stage), (int, float)):
+                    raise ValueError(
+                        f"{path}:{lineno}: request record missing numeric "
+                        f"stage {stage!r}"
+                    )
+            if not isinstance(rec.get("total_s"), (int, float)):
+                raise ValueError(
+                    f"{path}:{lineno}: request record 'total_s' must be a "
+                    f"number"
+                )
         records.append(rec)
     if not records:
         raise ValueError(f"{path}: ledger is empty")

@@ -448,6 +448,41 @@ class TestMetrics:
         assert swaps["max_blackout_s"] == pytest.approx(0.02)
         assert swaps["last_staleness_s"] == pytest.approx(2.5)
 
+    def test_reservoir_percentile_empty_is_nan(self):
+        """An empty reservoir answers NaN shaped like q — scalar q gives a
+        scalar NaN, array q gives an all-NaN array — never an IndexError."""
+        from photon_ml_tpu.serving.metrics import _Reservoir
+
+        res = _Reservoir(capacity=8)
+        scalar = res.percentile(50.0)
+        assert np.isscalar(scalar) or np.ndim(scalar) == 0
+        assert np.isnan(scalar)
+        arr = res.percentile(np.array([50.0, 99.0]))
+        assert arr.shape == (2,)
+        assert np.isnan(arr).all()
+
+    def test_reservoir_percentile_single_sample(self):
+        """One observation: every quantile is that observation."""
+        from photon_ml_tpu.serving.metrics import _Reservoir
+
+        res = _Reservoir(capacity=8)
+        res.add(0.042)
+        assert res.percentile(0.0) == pytest.approx(0.042)
+        assert res.percentile(50.0) == pytest.approx(0.042)
+        assert res.percentile(99.0) == pytest.approx(0.042)
+
+    def test_reservoir_percentile_array_matches_scalar(self):
+        """Vector q answers elementwise-equal to the scalar calls."""
+        from photon_ml_tpu.serving.metrics import _Reservoir
+
+        res = _Reservoir(capacity=64)
+        res.add_many([0.001 * (i + 1) for i in range(30)])
+        qs = np.array([10.0, 50.0, 90.0, 99.0])
+        vec = res.percentile(qs)
+        assert vec.shape == qs.shape
+        for q, v in zip(qs, vec):
+            assert v == pytest.approx(res.percentile(float(q)))
+
 
 class TestBatcherDeadline:
     def test_poll_drains_on_deadline(self, glmix):
