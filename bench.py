@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import time
 
 import numpy as np
@@ -756,6 +757,12 @@ SRV_REPLAY_REPS = 1 if _SMOKE else 5
 EV_BUDGET = 192 if _SMOKE else 2048
 EV_ADMIT = 8 if _SMOKE else 64              # rows per fixed-shape admit step
 EV_CHUNK = 128                              # synchronous replay batch rows
+# multi-model tenancy arm: N variants on the shared scorer, each a delta
+# overlay touching MM_DELTA_ROWS entities, traffic split evenly via the
+# variant router across MM_TENANTS
+MM_VARIANTS = 4
+MM_DELTA_ROWS = 64 if _SMOKE else 512
+MM_TENANTS = ("alpha", "beta", "gamma", "delta")
 _SERVING_PATH = os.path.join(_REPO, "BENCH_SERVING.json")
 _SCENARIOS_PATH = os.path.join(_REPO, "BENCH_SCENARIOS.json")
 
@@ -970,6 +977,126 @@ def _serving_bench():
             - eviction_ab["oldest"]["device_resident_rate"], 4
         )
 
+        # --- multi-model tenancy arm: MM_VARIANTS variants (shared FE
+        # base + per-variant delta overlays) vs ONE model, both served
+        # through the SAME tenancy-plane machinery over the same warm
+        # scorers and the same seeded-shuffled arrival stream, reps
+        # interleaved arm over arm. Pinning everything but the variant
+        # count isolates what N variants actually cost — routing hash,
+        # per-variant batchers, overlay index probes — from constants
+        # both arms pay anyway (plane bookkeeping, CPU clock drift, and
+        # the memory-locality bonus a sequential unshuffled replay would
+        # hand whichever arm keeps the request list contiguous; arrival
+        # order in production has no such layout locality). The plain
+        # sealed path is reported alongside as a reference point.
+        # Acceptance: throughput_ratio >= 0.9 at 4 variants.
+        from photon_ml_tpu.incremental import build_delta
+        from photon_ml_tpu.serving import (
+            ServingMetrics,
+            TenancyPlane,
+            VariantRegistry,
+            VariantRouter,
+        )
+        from photon_ml_tpu.serving.tenancy import tag_request
+
+        registry = VariantRegistry(scorers)
+        vrng = np.random.default_rng(SEED + 11)
+        variant_ids = ["base"]
+        for vi in range(1, MM_VARIANTS):
+            vid = f"v{vi}"
+            registry.add_variant(vid)
+            picks = vrng.choice(N_SRV_ENT, size=MM_DELTA_ROWS, replace=False)
+            re_updates = {
+                "per_user": {
+                    f"u{e}": {
+                        int(j): float(x)
+                        for j, x in zip(
+                            vrng.integers(0, D_SRV_RE, 4),
+                            vrng.normal(0.0, 0.05, 4),
+                        )
+                    }
+                    for e in picks
+                }
+            }
+            registry.apply_delta(
+                vid, build_delta(re_updates, artifact, generation=1)
+            )
+            variant_ids.append(vid)
+        router = VariantRouter(seed=SEED)
+        for vid in variant_ids[1:]:
+            router.set_ramp(vid, 100.0 / MM_VARIANTS)
+        multi_plane = TenancyPlane(
+            registry,
+            router=router,
+            metrics=ServingMetrics(),
+            bucket_sizes=SRV_BUCKETS,
+            max_wait_s=SRV_DEADLINE_S,
+        )
+        single_plane = TenancyPlane(
+            registry,
+            router=VariantRouter(seed=SEED),
+            metrics=ServingMetrics(),
+            bucket_sizes=SRV_BUCKETS,
+            max_wait_s=SRV_DEADLINE_S,
+        )
+        stream = [
+            tag_request(req, MM_TENANTS[i % len(MM_TENANTS)])
+            for i, req in enumerate(requests)
+        ]
+        random.Random(SEED + 23).shuffle(stream)
+        # warm both arms' paths; the measured replays drain on full
+        # buckets only (poll_every=0) — sealed policy, equal batch shapes
+        single_plane.replay(stream[: SRV_BUCKETS[-1]], poll_every=0)
+        multi_plane.replay(stream[: SRV_BUCKETS[-1]], poll_every=0)
+        single_rps, multi_rps, sealed_rps = [], [], []
+
+        def _timed_replay(plane):
+            t0 = time.perf_counter()
+            out = plane.replay(stream, poll_every=0)
+            wall = time.perf_counter() - t0
+            if len(out) != len(stream):
+                raise RuntimeError(
+                    f"tenancy replay dropped requests: {len(out)} of "
+                    f"{len(stream)}"
+                )
+            return len(out) / wall if wall > 0 else 0.0
+
+        gc.collect()
+        gc.disable()
+        try:
+            for _ in range(SRV_REPLAY_REPS):
+                single_rps.append(_timed_replay(single_plane))
+                multi_rps.append(_timed_replay(multi_plane))
+                _, snap = replay_requests(
+                    scorers, requests, bucket_sizes=SRV_BUCKETS,
+                    metrics=ServingMetrics(), model_id="serving-bench",
+                    continuous=False,
+                )
+                sealed_rps.append(snap.get("replay_requests_per_s", 0.0))
+        finally:
+            gc.enable()
+        best_single = max(single_rps)
+        best_multi = max(multi_rps)
+        multimodel = {
+            "num_variants": MM_VARIANTS,
+            "tenants": list(MM_TENANTS),
+            "delta_rows_per_variant": MM_DELTA_ROWS,
+            "serving_mode": "sealed-microbatch",
+            "variant_shares": {
+                v: round(s, 4) for v, s in router.shares().items()
+            },
+            "variants": registry.stats(),
+            "single_model_requests_per_s": round(best_single, 1),
+            "multimodel_requests_per_s": round(best_multi, 1),
+            "sealed_reference_requests_per_s": round(max(sealed_rps), 1),
+            "rep_single_requests_per_s": [round(r, 1) for r in single_rps],
+            "rep_multi_requests_per_s": [round(r, 1) for r in multi_rps],
+            "rep_sealed_requests_per_s": [round(r, 1) for r in sealed_rps],
+            "throughput_ratio": round(
+                best_multi / best_single, 4
+            ) if best_single > 0 else 0.0,
+        }
+
         payload = {
             "metric": "serving_p99_latency_s",
             "value": snapshot.get("latency_p99_s", 0.0),
@@ -999,6 +1126,7 @@ def _serving_bench():
                 max(s.compile_count for s in scorers) - warm_compiles
             ),
             "eviction_ab": eviction_ab,
+            "multimodel": multimodel,
             "backend": jax.default_backend(),
             **{
                 k: snapshot[k]
@@ -1025,6 +1153,14 @@ def _serving_bench():
             },
             "serving_eviction",
         )
+        _append_history(
+            {
+                "metric": "multimodel_throughput_ratio",
+                "value": multimodel["throughput_ratio"],
+                "unit": f"{MM_VARIANTS}_variant_vs_single_model_rps",
+            },
+            "serving_multimodel",
+        )
     except Exception as e:  # noqa: BLE001 - one JSON line per exit path
         print(json.dumps({
             "metric": "serving_p99_latency_s",
@@ -1044,6 +1180,14 @@ SCN_SAMPLE_RATE = 1 if _SMOKE else 4
 SCN_SLO_LATENCY_S = 0.050                   # per-request latency objective
 SCN_SLO_LATENCY_OBJ = 0.99
 SCN_SLO_AVAIL_OBJ = 0.999
+SCN_NEARLINE_ROWS = 8 if _SMOKE else 64     # rows per nearline delta
+# emit cadence: the trainer thread's host work (delta build + fingerprint
+# + publish) contends on the GIL with the replay thread, so every tick
+# inflates the host stages (featurize/dispatch) for requests in flight.
+# Production runs the trainer out of process; in this single-process
+# bench the cadence is the lever that keeps swap-window tail inflation
+# bounded instead of continuous.
+SCN_NEARLINE_INTERVAL_S = 0.02 if _SMOKE else 0.2
 
 
 def _scenarios_bench():
@@ -1058,7 +1202,9 @@ def _scenarios_bench():
     request records drain into the bench telemetry ledger, so the
     summarizer's validate_ledger schema-checks them — the CI scenario
     sentinel runs this in smoke mode and gates on both artifacts."""
+    import shutil
     import sys
+    import tempfile
 
     try:
         import jax
@@ -1067,12 +1213,21 @@ def _scenarios_bench():
             jax.config.update("jax_platforms", "cpu")
         from photon_ml_tpu.serving import (
             AdmissionController,
+            DEFAULT_TENANTS,
             RequestPlane,
             SCENARIO_NAMES,
             SLOTracker,
             ServingMetrics,
             ShardedGameScorer,
+            TENANCY_SCENARIOS,
+            TenancyPlane,
+            TenantBudget,
+            TenantQuota,
+            VariantRegistry,
+            VariantRouter,
             build_scenario,
+            build_tenant_slos,
+            make_nearline_fn,
             run_scenario,
         )
         from photon_ml_tpu.serving.scenarios import make_row_swap_fn
@@ -1105,6 +1260,13 @@ def _scenarios_bench():
         admission.warmup()
         admission.start(interval_s=SRV_ADMIT_INTERVAL_S)
 
+        # one variant registry shared by the tenancy scenarios (the
+        # production regime: the candidate variant accumulates nearline
+        # generations across scenarios, on the same warm scorers)
+        registry = VariantRegistry(scorers)
+        registry.add_variant("candidate")
+        nearline_dir = tempfile.mkdtemp(prefix="bench-nearline-")
+
         import gc
 
         scenario_docs = []
@@ -1122,21 +1284,87 @@ def _scenarios_bench():
                     latency_objective=SCN_SLO_LATENCY_OBJ,
                     availability_objective=SCN_SLO_AVAIL_OBJ,
                 )
+                tenant_slos = (
+                    build_tenant_slos(
+                        DEFAULT_TENANTS,
+                        latency_threshold_s=SCN_SLO_LATENCY_S,
+                        latency_objective=SCN_SLO_LATENCY_OBJ,
+                        availability_objective=SCN_SLO_AVAIL_OBJ,
+                    )
+                    if name in TENANCY_SCENARIOS
+                    else None
+                )
                 plane = RequestPlane(
                     sample_rate=SCN_SAMPLE_RATE,
                     seed=SEED,
                     ledger=ledger,
                     capacity=max(4096, len(requests)),
                     slo=slo,
+                    tenant_slos=tenant_slos,
                 )
                 scenario = build_scenario(
                     name, requests, seed=SEED,
                     num_phases=SCN_PHASES, pause_s=SCN_PAUSE_S,
+                    tenants=DEFAULT_TENANTS,
                 )
                 swap_fn = None
                 if name == "hot_swap_under_load":
                     swap_fn = make_row_swap_fn(
                         scorers, metrics, seed=SEED
+                    )
+                tenancy = None
+                nearline_fn = None
+                if name in TENANCY_SCENARIOS:
+                    quota = None
+                    if name == "tenant_isolation":
+                        # budgets are denominated in each tenant's TOTAL
+                        # offered volume, burst-dominated: replay wall
+                        # time is whatever the host gives us, so a
+                        # per-second rate would make shedding a function
+                        # of CPU speed. With 1.25x headroom over the fair
+                        # total, non-flooding tenants never touch their
+                        # cap while the flooder (FLOOD_FACTOR extra
+                        # copies over the mid phases, ~2x fair) must shed.
+                        fair_total = max(
+                            1, N_SRV_REQ // len(DEFAULT_TENANTS)
+                        )
+                        quota = TenantQuota({
+                            t: TenantBudget(
+                                rate=max(1.0, 0.05 * fair_total),
+                                burst=max(2, int(1.25 * fair_total)),
+                            )
+                            for t in DEFAULT_TENANTS
+                        })
+                    router = VariantRouter(seed=SEED)
+                    if name == "nearline_loop":
+                        # the nearline-trained candidate takes half the
+                        # traffic while its deltas land
+                        router.set_ramp("candidate", 50.0)
+                        nearline_fn = make_nearline_fn(
+                            registry,
+                            ["candidate"],
+                            {"per_user": [
+                                f"u{i}"
+                                for i in range(min(N_SRV_ENT, 4096))
+                            ]},
+                            rows_per_delta=SCN_NEARLINE_ROWS,
+                            seed=SEED,
+                            watch_dir=nearline_dir,
+                        )
+                        # warm tick OUTSIDE the measured window: the
+                        # first apply compiles the row-update scatter
+                        # for this delta shape — a one-time stall that
+                        # would otherwise land on one mid-phase bucket
+                        # and torch every tenant's 50 ms latency budget
+                        nearline_fn()
+                    tenancy = TenancyPlane(
+                        registry,
+                        router=router,
+                        plane=plane,
+                        quota=quota,
+                        metrics=metrics,
+                        bucket_sizes=SRV_BUCKETS,
+                        max_wait_s=SRV_DEADLINE_S,
                     )
                 doc = run_scenario(
                     scenario,
@@ -1150,11 +1378,15 @@ def _scenarios_bench():
                     max_wait_s=SRV_DEADLINE_S,
                     max_queue=SRV_MAX_QUEUE,
                     swap_fn=swap_fn,
+                    tenancy=tenancy,
+                    nearline_fn=nearline_fn,
+                    nearline_interval_s=SCN_NEARLINE_INTERVAL_S,
                 )
                 scenario_docs.append(doc)
         finally:
             gc.enable()
             admission.stop()
+            shutil.rmtree(nearline_dir, ignore_errors=True)
 
         ok = sum(
             1 for d in scenario_docs if d.get("slo_verdict") == "ok"
@@ -1175,9 +1407,20 @@ def _scenarios_bench():
             "num_shards": SRV_SHARDS,
             "device_budget_rows": SRV_BUDGET,
             "bucket_sizes": list(SRV_BUCKETS),
+            "tenants": list(DEFAULT_TENANTS),
+            "tenancy_scenarios": list(TENANCY_SCENARIOS),
             "backend": jax.default_backend(),
             "scenarios": scenario_docs,
         }
+        iso = next(
+            (
+                d for d in scenario_docs
+                if d.get("name") == "tenant_isolation"
+            ),
+            None,
+        )
+        if iso is not None:
+            payload["tenant_isolation_ok"] = bool(iso.get("isolation_ok"))
         payload["telemetry"] = summarize_telemetry()
         print(json.dumps(payload))
         if not _SMOKE or _env_flag("BENCH_SCENARIOS_WRITE"):

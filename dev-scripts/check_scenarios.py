@@ -8,12 +8,20 @@ stale or hand-mangled artifact cannot ship. The gate asserts the request
 plane's contract, not performance numbers (smoke shapes are tiny and CPU
 timing is noisy):
 
-* at least ``--min-scenarios`` scenario documents (default 4), each
-  carrying a per-stage p50/p99 breakdown over all six request stages, a
-  ``device_resident_rate``, and an SLO verdict;
+* at least ``--min-scenarios`` scenario documents (default 4) — the
+  scenario SET is variable (the catalog grows PR over PR), so the gate
+  validates whatever set the payload carries and ``--require-names``
+  pins the scenarios CI insists on (e.g. the tenancy trio);
+* each scenario carries a per-stage p50/p99 breakdown over all six
+  request stages, a ``device_resident_rate``, and an SLO verdict;
 * each scenario's tail attribution coverage >= ``--min-coverage``
   (default 0.95): the per-stage breakdown must explain the end-to-end
   tail latency, the property the telescoping stage boundaries guarantee;
+* a scenario that declares ``tenants`` (the tenancy trio) must carry a
+  per-tenant SLO verdict (``slo_verdict`` + full ``slo`` status) for
+  EVERY tenant, and ``tenant_isolation`` must carry its
+  ``isolation_ok`` boolean — per-tenant budgets are the whole point of
+  the tenancy plane, so a doc that lost them is malformed;
 * with ``--ledger``, the bench telemetry ledger passes
   ``validate_ledger`` (schema check for every record kind, the sampled
   ``request`` records included) and actually carries request records.
@@ -24,7 +32,8 @@ Usage:
     BENCH_SMOKE=1 python bench.py --scenarios > /tmp/fresh-scenarios.json
     python dev-scripts/check_scenarios.py /tmp/fresh-scenarios.json \
         [--ledger /tmp/scenarios-ledger.jsonl] [--min-scenarios 4] \
-        [--min-coverage 0.95]
+        [--min-coverage 0.95] \
+        [--require-names tenant_isolation,ramped_rollout,nearline_loop]
 """
 import argparse
 import json
@@ -52,7 +61,33 @@ def _last_json_line(path):
     return json.loads(lines[-1])
 
 
-def check_payload(payload, min_scenarios, min_coverage):
+def _check_tenancy(doc, name, problems):
+    """Per-tenant SLO contract for scenarios that declare tenants."""
+    tenants = doc.get("tenants")
+    if not isinstance(tenants, dict) or not tenants:
+        problems.append(f"{name}: declares tenancy but no 'tenants' map")
+        return
+    for tenant, info in sorted(tenants.items()):
+        if not isinstance(info, dict) or not info.get("slo_verdict"):
+            problems.append(f"{name}: tenant '{tenant}' has no SLO verdict")
+            continue
+        slo = info.get("slo")
+        if not isinstance(slo, dict) or not all(
+            isinstance(slo.get(k), (int, float))
+            for k in ("burn_rate", "error_budget_remaining")
+        ):
+            problems.append(
+                f"{name}: tenant '{tenant}' SLO status lacks error-budget "
+                "accounting"
+            )
+    if name == "tenant_isolation":
+        if not isinstance(doc.get("isolation_ok"), bool):
+            problems.append(f"{name}: no isolation_ok verdict")
+        if not doc.get("flooding_tenant"):
+            problems.append(f"{name}: no flooding_tenant attribution")
+
+
+def check_payload(payload, min_scenarios, min_coverage, require_names=()):
     """Return the list of violated invariants (empty = sound)."""
     problems = []
     if payload.get("error"):
@@ -64,6 +99,10 @@ def check_payload(payload, min_scenarios, min_coverage):
         problems.append(
             f"only {len(scenarios)} scenario(s), need >= {min_scenarios}"
         )
+    present = {d.get("name") for d in scenarios}
+    for required in require_names:
+        if required not in present:
+            problems.append(f"required scenario '{required}' is missing")
     for doc in scenarios:
         name = doc.get("name", "?")
         if not doc.get("num_requests"):
@@ -95,6 +134,8 @@ def check_payload(payload, min_scenarios, min_coverage):
             problems.append(f"{name}: no device_resident_rate")
         if not doc.get("slo_verdict"):
             problems.append(f"{name}: no SLO verdict")
+        if "tenants" in doc:
+            _check_tenancy(doc, name, problems)
     return problems
 
 
@@ -134,6 +175,11 @@ def main(argv=None) -> int:
         "--min-coverage", type=float, default=0.95,
         help="minimum tail attribution coverage per scenario (default 0.95)",
     )
+    ap.add_argument(
+        "--require-names", default="",
+        help="comma-separated scenario names that MUST be present (the "
+             "scenario set is otherwise variable)",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -142,7 +188,12 @@ def main(argv=None) -> int:
         print(f"scenario-sentinel: cannot read payload ({e})")
         return 1
 
-    problems = check_payload(payload, args.min_scenarios, args.min_coverage)
+    require_names = tuple(
+        n.strip() for n in args.require_names.split(",") if n.strip()
+    )
+    problems = check_payload(
+        payload, args.min_scenarios, args.min_coverage, require_names
+    )
     if args.ledger:
         problems += check_ledger(args.ledger)
 

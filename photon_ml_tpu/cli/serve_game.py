@@ -166,6 +166,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--slo-availability-objective", type=float, default=0.999,
                    help="fraction of requests that must not error "
                         "(default 0.999)")
+    p.add_argument("--tenants", default=None,
+                   help="comma-separated tenant names: the replayed stream "
+                        "is tagged round-robin across them and, with "
+                        "--slo-latency-ms, each tenant gets an INDEPENDENT "
+                        "SLO error budget (tenant-labeled serving.slo.* "
+                        "series in /metrics, per-tenant burn in /healthz "
+                        "and /varz)")
     add_telemetry_args(p)
     return p.parse_args(argv)
 
@@ -378,7 +385,35 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
             availability_objective=args.slo_availability_objective,
             registry=get_registry(),
         )
-    if args.request_sample_rate > 0 or slo is not None:
+    tenants = [
+        t.strip() for t in (args.tenants or "").split(",") if t.strip()
+    ]
+    tenant_slos = None
+    if tenants:
+        if args.slo_latency_ms is not None:
+            from photon_ml_tpu.serving import build_tenant_slos
+            from photon_ml_tpu.telemetry.metrics import get_registry
+
+            tenant_slos = build_tenant_slos(
+                tenants,
+                registry=get_registry(),
+                latency_threshold_s=args.slo_latency_ms / 1e3,
+                latency_objective=args.slo_latency_objective,
+                availability_objective=args.slo_availability_objective,
+            )
+            logger.info(
+                "per-tenant SLO budgets for %s", ", ".join(tenants)
+            )
+        else:
+            logger.warning(
+                "--tenants without --slo-latency-ms: requests are tagged "
+                "but no per-tenant SLO budgets are tracked"
+            )
+    if (
+        args.request_sample_rate > 0
+        or slo is not None
+        or tenant_slos is not None
+    ):
         from photon_ml_tpu.serving import RequestPlane
 
         plane = RequestPlane(
@@ -386,6 +421,7 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
             seed=args.request_sample_seed,
             ledger=telemetry.ledger if telemetry is not None else None,
             slo=slo,
+            tenant_slos=tenant_slos,
         )
         logger.info(
             "request plane: sampling ~1/%d requests (seed %d)%s",
@@ -394,6 +430,7 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
         )
     active["request_sample_rate"] = args.request_sample_rate
     active["slo_latency_ms"] = args.slo_latency_ms
+    active["tenants"] = tenants or None
 
     if args.export_artifact_dir:
         from photon_ml_tpu.serving import save_artifact
@@ -434,6 +471,19 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
                 doc["slo"] = sh
                 if not sh.get("healthy", True):
                     degraded.append(sh.get("degraded", "slo budget exhausted"))
+            # per-tenant burn: ONE tenant's exhausted budget degrades
+            # health with the tenant named, while the others stay readable
+            if tenant_slos:
+                tdoc = {}
+                for t, tracker in sorted(tenant_slos.items()):
+                    th = tracker.health()
+                    tdoc[t] = th
+                    if not th.get("healthy", True):
+                        degraded.append(
+                            f"tenant {t}: "
+                            + th.get("degraded", "slo budget exhausted")
+                        )
+                doc["tenant_slo"] = tdoc
             if degraded:
                 doc["healthy"] = False
                 doc["degraded"] = "; ".join(degraded)
@@ -443,6 +493,11 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
             doc = dict(active)
             if slo is not None:
                 doc["slo"] = slo.status()
+            if tenant_slos:
+                doc["tenant_slo"] = {
+                    t: tracker.status()
+                    for t, tracker in sorted(tenant_slos.items())
+                }
             return doc
 
         extra = {}
@@ -526,6 +581,18 @@ def _serve_stream(
         with timer.time("build requests"):
             requests = requests_from_game_data(
                 data, artifact, uids=uids, max_requests=args.max_requests
+            )
+        tenants = active.get("tenants") or []
+        if tenants:
+            from photon_ml_tpu.serving.tenancy import tag_request
+
+            requests = [
+                tag_request(r, tenants[i % len(tenants)])
+                for i, r in enumerate(requests)
+            ]
+            logger.info(
+                "tagged requests round-robin across %d tenant(s): %s",
+                len(tenants), ", ".join(tenants),
             )
         logger.info("replaying %d requests", len(requests))
 

@@ -19,6 +19,7 @@ from photon_ml_tpu.incremental.delta import (
     discover_deltas,
     fingerprint_dir,
     load_delta,
+    rebase_delta,
     save_delta,
     verify_chain,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "fingerprint_dir",
     "incremental_update",
     "load_delta",
+    "rebase_delta",
     "save_delta",
     "verify_chain",
 ]

@@ -272,6 +272,43 @@ class OverlayIndexMap(IndexMap):
     def __len__(self) -> int:
         return len(self._base) + len(self._added)
 
+    def get_indices(self, names) -> np.ndarray:
+        """Vectorized lookup: probe the (small) overlay dict first, then
+        hand the misses to the base map's own vectorized path in one call —
+        the serving route step resolves whole buckets through this, so the
+        per-name generator fallback of the base class would put a Python
+        loop on the hot path."""
+        added = self._added
+        if not added:
+            return np.asarray(self._base.get_indices(names), dtype=np.int64)
+        out = np.fromiter(
+            (added.get(n, -1) for n in names),
+            dtype=np.int64,
+            count=len(names),
+        )
+        miss = out < 0
+        if miss.any():
+            missing = [n for n, m in zip(names, miss) if m]
+            out[miss] = np.asarray(
+                self._base.get_indices(missing), dtype=np.int64
+            )
+        return out
+
+
+def rebase_delta(
+    delta: DeltaArtifact, base_fingerprint: Optional[str]
+) -> DeltaArtifact:
+    """Retarget a delta onto a different chain head (a copy; the input is
+    untouched). The multi-variant case: one nearline trainer emits a delta
+    against the shared base artifact, and each variant rebases it onto its
+    OWN chain head before applying, so every variant's hash chain stays
+    unbroken without retraining per variant. The content ``fingerprint``
+    is cleared — a rebased delta is new content and must be re-saved (or
+    applied in memory) to earn one."""
+    return dataclasses.replace(
+        delta, base_fingerprint=base_fingerprint, fingerprint=None
+    )
+
 
 def apply_delta(artifact, delta: DeltaArtifact):
     """Fold a delta into a ``ServingArtifact`` → a NEW artifact (host-side;

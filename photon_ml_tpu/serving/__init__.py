@@ -50,8 +50,14 @@ map onto that design:
   over a rolling window with error-budget burn-rate accounting
   (``/healthz`` degraded reason + ``serving.slo.*`` gauges).
 - :mod:`photon_ml_tpu.serving.scenarios` — seeded traffic-shape scenarios
-  (steady, diurnal, burst storm, cold-entity flood, hot-swap under load)
+  (steady, diurnal, burst storm, cold-entity flood, hot-swap under load,
+  plus the tenancy trio: tenant isolation, ramped rollout, nearline loop)
   driving ``replay_requests`` for the ``bench.py --scenarios`` harness.
+- :mod:`photon_ml_tpu.serving.tenancy` — the tenancy plane: N GLMix model
+  variants as fingerprint-chained delta overlays on ONE shared sharded
+  scorer, seeded deterministic variant routing with hot ramp percentages,
+  per-tenant admission quotas with priority-aware shedding, and per-tenant
+  SLO error budgets (tenant-labeled ``serving.slo.*`` series).
 """
 
 from photon_ml_tpu.serving.artifact import (
@@ -79,9 +85,22 @@ from photon_ml_tpu.serving.metrics import ServingMetrics
 from photon_ml_tpu.serving.replay import replay_requests, requests_from_game_data
 from photon_ml_tpu.serving.requestplane import REQUEST_STAGES, RequestPlane
 from photon_ml_tpu.serving.scenarios import (
+    DEFAULT_TENANTS,
     SCENARIO_NAMES,
+    TENANCY_SCENARIOS,
     build_scenario,
     run_scenario,
+)
+from photon_ml_tpu.serving.tenancy import (
+    TenancyPlane,
+    TenantBudget,
+    TenantQuota,
+    VariantRegistry,
+    VariantRouter,
+    VariantScorer,
+    build_tenant_slos,
+    make_nearline_fn,
+    tag_requests,
 )
 from photon_ml_tpu.serving.slo import SLOTracker
 from photon_ml_tpu.serving.routing import (
@@ -99,12 +118,23 @@ from photon_ml_tpu.serving.sharded import (
 __all__ = [
     "AdmissionController",
     "ContinuousBatcher",
+    "DEFAULT_TENANTS",
     "REQUEST_STAGES",
     "RequestPlane",
     "SCENARIO_NAMES",
     "SLOTracker",
+    "TENANCY_SCENARIOS",
+    "TenancyPlane",
+    "TenantBudget",
+    "TenantQuota",
+    "VariantRegistry",
+    "VariantRouter",
+    "VariantScorer",
     "build_scenario",
+    "build_tenant_slos",
+    "make_nearline_fn",
     "run_scenario",
+    "tag_requests",
     "CoordinateRouting",
     "CoordinatedHotSwap",
     "DeltaWatcher",

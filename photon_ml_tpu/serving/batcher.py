@@ -83,6 +83,21 @@ class MicroBatcher:
             out.extend(self._drain(self.max_bucket))
         return out
 
+    def submit_many(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+        """Enqueue a pre-collected run of requests in one call (the
+        tenancy plane's bulk replay path). Same drain policy as
+        :meth:`submit` — full max-size batches drain as they accumulate —
+        but one clock read and one Python frame for the whole run instead
+        of one per request."""
+        if not requests:
+            return []
+        now = self._clock()
+        self._pending.extend((r, now) for r in requests)
+        out: List[ScoreResult] = []
+        while len(self._pending) >= self.max_bucket:
+            out.extend(self._drain(self.max_bucket))
+        return out
+
     def flush(self) -> List[ScoreResult]:
         """Score everything still pending (smallest buckets that fit)."""
         out: List[ScoreResult] = []
@@ -161,7 +176,15 @@ class MicroBatcher:
                 self._metrics.observe_queue_waits(dequeued - enqueued)
                 self._metrics.observe_latencies(latencies, bucket_size=bucket)
             if plane is not None:
-                plane.observe_complete(latencies)
+                if getattr(plane, "wants_request_ids", False):
+                    # multi-tenant attribution: the id list is built only
+                    # when the plane carries per-tenant SLO trackers
+                    plane.observe_complete(
+                        latencies,
+                        request_ids=[req.request_id for req, _ in batch],
+                    )
+                else:
+                    plane.observe_complete(latencies)
                 if sampled:
                     plane.record_batch(
                         "sealed", bucket, n,

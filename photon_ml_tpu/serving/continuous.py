@@ -348,7 +348,12 @@ class ContinuousBatcher:
             self._inflight -= n
             self._cond.notify_all()
         if plane is not None and error is not None:
-            plane.observe_errors(n)
+            if getattr(plane, "wants_request_ids", False):
+                plane.observe_errors(
+                    n, request_ids=[req.request_id for req, _, _ in batch]
+                )
+            else:
+                plane.observe_errors(n)
         if error is None and (self._metrics is not None or plane is not None):
             enqueued = np.fromiter(
                 (t for _, t, _ in batch), dtype=np.float64, count=n
@@ -362,7 +367,15 @@ class ContinuousBatcher:
                 self._metrics.observe_queue_waits(dequeued - enqueued)
                 self._metrics.observe_latencies(latencies, bucket_size=bucket)
             if plane is not None:
-                plane.observe_complete(latencies)
+                if getattr(plane, "wants_request_ids", False):
+                    # multi-tenant attribution: the id list is built only
+                    # when the plane carries per-tenant SLO trackers
+                    plane.observe_complete(
+                        latencies,
+                        request_ids=[req.request_id for req, _, _ in batch],
+                    )
+                else:
+                    plane.observe_complete(latencies)
                 if sampled:
                     plane.record_batch(
                         "continuous", bucket, n,

@@ -51,6 +51,25 @@ def _prom_value(value: Any) -> str:
     return repr(v) if not float(v).is_integer() else str(int(v))
 
 
+def _split_name(name: str) -> tuple:
+    """Registry name → (family, label block). ``MetricsRegistry.scoped``
+    stores labeled metrics as ``base{k="v",...}``; everything else is an
+    unlabeled family."""
+    if name.endswith("}") and "{" in name:
+        base, labels = name.split("{", 1)
+        return base, "{" + labels
+    return name, ""
+
+
+def _sample(pname: str, labels: str, extra: str = "") -> str:
+    """One sample name: the Prometheus family name plus the stored label
+    block, with an optional extra label (``quantile="0.5"``) merged in."""
+    inner = labels[1:-1] if labels else ""
+    if extra:
+        inner = f"{inner},{extra}" if inner else extra
+    return f"{pname}{{{inner}}}" if inner else pname
+
+
 def prometheus_text(snapshot: Dict[str, Any], prefix: str = _PROM_PREFIX) -> str:
     """Render a MetricsRegistry snapshot as Prometheus text exposition
     (format version 0.0.4).
@@ -60,31 +79,61 @@ def prometheus_text(snapshot: Dict[str, Any], prefix: str = _PROM_PREFIX) -> str
     * histograms → a ``summary``: ``<name>{quantile="0.5|0.95|0.99"}``,
       ``<name>_count``, and a ``<name>_max`` gauge (the registry keeps
       digests, not sums, so no ``_sum`` sample is emitted).
+
+    Names carrying a label block (written through
+    ``MetricsRegistry.scoped``, e.g. ``serving.requests{tenant="a"}``)
+    render as labeled samples of one family: the ``# TYPE`` header is
+    emitted once per family and only the family name is sanitized, so
+    per-tenant series group under one metric the way Prometheus expects.
     """
     lines = []
-    for name, value in sorted((snapshot.get("counters") or {}).items()):
-        pname = _prom_name(name, prefix)
+
+    def families(section):
+        fams: Dict[str, list] = {}
+        for name, value in sorted((snapshot.get(section) or {}).items()):
+            base, labels = _split_name(name)
+            fams.setdefault(base, []).append((labels, value))
+        return sorted(fams.items())
+
+    for base, samples in families("counters"):
+        pname = _prom_name(base, prefix)
         lines.append(f"# TYPE {pname} counter")
-        lines.append(f"{pname} {_prom_value(value)}")
-    for name, g in sorted((snapshot.get("gauges") or {}).items()):
-        pname = _prom_name(name, prefix)
+        for labels, value in samples:
+            lines.append(f"{_sample(pname, labels)} {_prom_value(value)}")
+    for base, samples in families("gauges"):
+        pname = _prom_name(base, prefix)
         lines.append(f"# TYPE {pname} gauge")
-        lines.append(f"{pname} {_prom_value(g['last'])}")
+        for labels, g in samples:
+            lines.append(f"{_sample(pname, labels)} {_prom_value(g['last'])}")
         lines.append(f"# TYPE {pname}_peak gauge")
-        lines.append(f"{pname}_peak {_prom_value(g['peak'])}")
-    for name, h in sorted((snapshot.get("histograms") or {}).items()):
-        pname = _prom_name(name, prefix)
+        for labels, g in samples:
+            lines.append(
+                f"{_sample(pname + '_peak', labels)} {_prom_value(g['peak'])}"
+            )
+    for base, samples in families("histograms"):
+        pname = _prom_name(base, prefix)
         lines.append(f"# TYPE {pname} summary")
-        for q in ("p50", "p95", "p99"):
-            if q in h:
-                quantile = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}[q]
-                lines.append(
-                    f'{pname}{{quantile="{quantile}"}} {_prom_value(h[q])}'
+        max_lines = []
+        for labels, h in samples:
+            for q in ("p50", "p95", "p99"):
+                if q in h:
+                    quantile = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}[q]
+                    qlabel = 'quantile="%s"' % quantile
+                    lines.append(
+                        f"{_sample(pname, labels, qlabel)}"
+                        f" {_prom_value(h[q])}"
+                    )
+            lines.append(
+                f"{_sample(pname + '_count', labels)} "
+                f"{_prom_value(h.get('count', 0))}"
+            )
+            if "max" in h:
+                max_lines.append(
+                    f"{_sample(pname + '_max', labels)} {_prom_value(h['max'])}"
                 )
-        lines.append(f"{pname}_count {_prom_value(h.get('count', 0))}")
-        if "max" in h:
+        if max_lines:
             lines.append(f"# TYPE {pname}_max gauge")
-            lines.append(f"{pname}_max {_prom_value(h['max'])}")
+            lines.extend(max_lines)
     return "\n".join(lines) + "\n"
 
 

@@ -73,6 +73,17 @@ REQUEST_STAGES = (
 # the request's submit→reply window)
 INTERFERENCE_KINDS = ("swap_pause", "admission")
 
+# tenant prefix separator inside request ids: the tenancy plane submits
+# requests as "<tenant>!<request_id>" so per-tenant SLO attribution needs
+# no extra per-request field anywhere in the batcher/scorer path
+TENANT_SEP = "!"
+
+
+def tenant_of_request_id(request_id: str) -> Optional[str]:
+    """The tenant a request id carries (``None`` for untagged ids)."""
+    sep = request_id.find(TENANT_SEP)
+    return request_id[:sep] if sep > 0 else None
+
 
 def sample_hash(request_id: str, seed: int) -> int:
     """Deterministic 32-bit hash of a request id under a seed. Stateless —
@@ -105,6 +116,8 @@ class RequestPlane:
         slo=None,
         clock: Callable[[], float] = time.perf_counter,
         interference_capacity: int = 512,
+        tenant_slos: Optional[Dict[str, object]] = None,
+        tenant_of: Optional[Callable[[str], Optional[str]]] = None,
     ):
         if sample_rate < 0:
             raise ValueError(f"sample_rate must be >= 0, got {sample_rate}")
@@ -113,6 +126,14 @@ class RequestPlane:
         self._ledger = ledger
         self._slo = slo
         self._clock = clock
+        # per-tenant SLO trackers (tenancy plane): completions are
+        # attributed by resolving each request id through ``tenant_of``
+        # (default: the "<tenant>!" id prefix). Empty/None = single-tenant
+        # process; the batchers then never materialize id lists.
+        self.tenant_slos: Dict[str, object] = dict(tenant_slos or {})
+        self._tenant_of = tenant_of or tenant_of_request_id
+        self.tenant_requests: Dict[str, int] = {}
+        self.tenant_errors: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._records: Deque[dict] = deque(maxlen=max(1, int(capacity)))
         self._interference: Deque[Tuple[str, float, float]] = deque(
@@ -173,22 +194,59 @@ class RequestPlane:
 
     # ------------------------------------------------------------ recording
 
-    def observe_complete(self, latencies, errors: int = 0) -> None:
+    @property
+    def wants_request_ids(self) -> bool:
+        """Whether the batchers should hand ``observe_complete`` /
+        ``observe_errors`` the batch's request ids (only multi-tenant
+        attribution needs them; the single-tenant path skips the list)."""
+        return bool(self.tenant_slos)
+
+    def observe_complete(
+        self, latencies, errors: int = 0, request_ids=None
+    ) -> None:
         """Per-batch completion feed (EVERY request, sampled or not): keeps
         the SLO tracker and the aggregate counters honest at O(1) per
-        batch. ``latencies`` is an array-like of seconds."""
+        batch. ``latencies`` is an array-like of seconds. ``request_ids``
+        (aligned with ``latencies``; only handed over when
+        :attr:`wants_request_ids`) routes each completion to its tenant's
+        SLO tracker as well."""
         n = len(latencies)
         self.requests_total += n
         self.errors_total += int(errors)
         if self._slo is not None:
             self._slo.observe_many(latencies, errors=errors)
+        if self.tenant_slos and request_ids is not None:
+            by: Dict[str, List[float]] = {}
+            for rid, lat in zip(request_ids, latencies):
+                tenant = self._tenant_of(rid)
+                if tenant is not None and tenant in self.tenant_slos:
+                    by.setdefault(tenant, []).append(float(lat))
+            for tenant, lats in by.items():
+                self.tenant_requests[tenant] = (
+                    self.tenant_requests.get(tenant, 0) + len(lats)
+                )
+                self.tenant_slos[tenant].observe_many(lats)
 
-    def observe_errors(self, n: int) -> None:
+    def observe_errors(self, n: int, request_ids=None) -> None:
         """Requests that failed before producing a latency (scorer error
         resolved through their handles)."""
         self.errors_total += int(n)
         if self._slo is not None:
             self._slo.observe_many((), errors=n)
+        if self.tenant_slos and request_ids is not None:
+            for rid in request_ids:
+                tenant = self._tenant_of(rid)
+                if tenant is not None and tenant in self.tenant_slos:
+                    self.observe_tenant_errors(tenant, 1)
+
+    def observe_tenant_errors(self, tenant: str, n: int) -> None:
+        """Charge ``n`` failed/shed requests to ONE tenant's error budget
+        (quota sheds land here — on the shedding tenant, never on the
+        global SLO or on other tenants)."""
+        slo = self.tenant_slos.get(tenant)
+        if slo is not None:
+            slo.observe_many((), errors=n)
+        self.tenant_errors[tenant] = self.tenant_errors.get(tenant, 0) + int(n)
 
     def record_batch(
         self,
@@ -282,4 +340,13 @@ class RequestPlane:
             doc.update(report)
         if self._slo is not None:
             doc["slo"] = self._slo.status()
+        if self.tenant_slos:
+            doc["tenants"] = {
+                tenant: {
+                    "requests": self.tenant_requests.get(tenant, 0),
+                    "errors": self.tenant_errors.get(tenant, 0),
+                    "slo": slo.status(),
+                }
+                for tenant, slo in sorted(self.tenant_slos.items())
+            }
         return doc

@@ -180,18 +180,42 @@ class CoordinateRouting:
 
     # ------------------------------------------------- importance tracking
 
-    def note_requests(self, entity_rows: np.ndarray) -> None:
+    @property
+    def wants_feature_norms(self) -> bool:
+        """Whether the scorer's route step should compute per-request
+        feature-vector norms for :meth:`note_requests` (only the
+        importance policy consumes them; the default path skips the
+        O(B·k) norm entirely)."""
+        return self._freq is not None
+
+    def note_requests(
+        self,
+        entity_rows: np.ndarray,
+        feature_norms: Optional[np.ndarray] = None,
+    ) -> None:
         """Fold one request batch into the EWMA frequency plane (called by
         the scorer's route step; no-op under the default policy). Every
         ``FREQ_DECAY_EVERY`` batches the whole plane halves, so frequency
         is an exponential window over recent traffic, not an all-time
-        count that would pin formerly-hot rows forever."""
+        count that would pin formerly-hot rows forever.
+
+        ``feature_norms`` (aligned with ``entity_rows``) weights each
+        request by its feature-vector magnitude ``||x||`` instead of 1.0:
+        combined with the per-row coefficient norm (:meth:`note_row_norms`)
+        the importance score becomes ``EWMA(Σ||x||) × ||w_r||`` — a
+        Cauchy–Schwarz bound on the row's cumulative score delta vs the
+        FE-only fallback, not just its hit count. Callers without norms
+        fall back to pure frequency."""
         if self._freq is None:
             return
         rows = np.asarray(entity_rows, dtype=np.int64).ravel()
-        rows = rows[(rows >= 0) & (rows < self._freq.size)]
-        if rows.size:
-            np.add.at(self._freq, rows, 1.0)
+        keep = (rows >= 0) & (rows < self._freq.size)
+        if keep.any():
+            if feature_norms is not None:
+                norms = np.asarray(feature_norms, dtype=np.float64).ravel()
+                np.add.at(self._freq, rows[keep], norms[keep])
+            else:
+                np.add.at(self._freq, rows[keep], 1.0)
         self._freq_batches += 1
         if self._freq_batches >= self.FREQ_DECAY_EVERY:
             self._freq_batches = 0
@@ -356,6 +380,12 @@ class CoordinateRouting:
                 return
             extra = n_rows - self._slot_of.size
             if extra > 0:
+                # over-allocate in chunks: a nearline loop claiming a few
+                # dozen fresh overlay rows per applied delta would
+                # otherwise memcpy the whole placement array every tick.
+                # Rows past n_rows stay unroutable (no id maps to them)
+                # and carry the non-resident defaults.
+                extra = max(extra, min(4096, self._slot_of.size))
                 # build the grown arrays fully, then install: lock-free
                 # route() readers only ever see a complete placement array
                 shard_of = np.concatenate(

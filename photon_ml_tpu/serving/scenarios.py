@@ -33,6 +33,26 @@ Scenario catalog (``SCENARIO_NAMES``):
     middle phases (a swapper thread contends with scoring through the
     write locks) — the arm that proves swap pauses land in the p99
     breakdown as ``swap_pause`` interference, not as unexplained time.
+
+Tenancy scenarios (``TENANCY_SCENARIOS``, run through a
+:class:`~photon_ml_tpu.serving.tenancy.TenancyPlane` instead of plain
+replay; their requests are tenant-tagged and their result docs carry
+per-tenant SLO verdicts):
+
+``tenant_isolation``
+    Round-robin multi-tenant traffic, with the FIRST tenant flooding at
+    several times its contracted rate during the middle phases. The
+    quota must shed the flood onto the flooder's own error budget while
+    every other tenant's p99 and budget hold — the noisy-neighbour gate.
+``ramped_rollout``
+    Steady multi-tenant traffic while a candidate variant's ramp walks
+    1% -> 50% -> 100% across phases, hot, without draining the server;
+    variant routing stays sticky per request id as the boundary moves.
+``nearline_loop``
+    The end-to-end loop: a nearline trainer emits fingerprint-chained
+    per-variant deltas (save -> discover -> chain-check -> apply) while
+    the scorer hot-swaps them per variant under replayed multi-tenant
+    traffic.
 """
 
 from __future__ import annotations
@@ -55,7 +75,23 @@ SCENARIO_NAMES = (
     "burst_storm",
     "cold_entity_flood",
     "hot_swap_under_load",
+    "tenant_isolation",
+    "ramped_rollout",
+    "nearline_loop",
 )
+
+# the scenarios that need a TenancyPlane (multi-tenant, variant-routed)
+TENANCY_SCENARIOS = (
+    "tenant_isolation",
+    "ramped_rollout",
+    "nearline_loop",
+)
+
+DEFAULT_TENANTS = ("alpha", "beta", "gamma")
+
+# how much harder the flooding tenant pushes than its round-robin share
+# in ``tenant_isolation``
+FLOOD_FACTOR = 3
 
 # stable per-scenario seed offsets: the same (seed, name) always produces
 # the same phase layout and entity remapping
@@ -65,11 +101,16 @@ _NAME_SEEDS = {name: 1000 + i for i, name in enumerate(SCENARIO_NAMES)}
 @dataclasses.dataclass
 class ScenarioPhase:
     """One replay leg: a request slice, an optional idle gap before it,
-    and whether hot-swap updates run concurrently with it."""
+    and whether hot-swap updates run concurrently with it. Tenancy
+    phases may additionally move a variant ramp before replaying
+    (``ramp_percent``) or run the nearline emit->swap loop concurrently
+    (``nearline``)."""
 
     requests: List[ScoreRequest]
     pause_before_s: float = 0.0
     swap: bool = False
+    ramp_percent: Optional[float] = None
+    nearline: bool = False
 
 
 @dataclasses.dataclass
@@ -78,6 +119,10 @@ class Scenario:
     seed: int
     phases: List[ScenarioPhase]
     description: str = ""
+    # tenancy scenarios: the tenants the stream is tagged with, and the
+    # variant whose ramp the phases' ``ramp_percent`` steps drive
+    tenants: tuple = ()
+    ramp_variant: Optional[str] = None
 
     @property
     def num_requests(self) -> int:
@@ -116,17 +161,36 @@ def _cold_remap(
     return out
 
 
+def _tag(request: ScoreRequest, tenant: str) -> ScoreRequest:
+    """Tenant-tag one request (see ``requestplane.TENANT_SEP``)."""
+    from photon_ml_tpu.serving.requestplane import TENANT_SEP
+
+    return dataclasses.replace(
+        request, request_id=f"{tenant}{TENANT_SEP}{request.request_id}"
+    )
+
+
+# ramp walk for ``ramped_rollout``: interpolated onto num_phases, always
+# starting dark and ending fully ramped
+_RAMP_STEPS = (0.0, 1.0, 5.0, 10.0, 25.0, 50.0, 75.0, 100.0)
+
+
 def build_scenario(
     name: str,
     requests: Sequence[ScoreRequest],
     seed: int = 0,
     num_phases: int = 8,
     pause_s: float = 0.01,
+    tenants: Sequence[str] = DEFAULT_TENANTS,
+    ramp_variant: str = "candidate",
 ) -> Scenario:
     """Deterministically reshape ``requests`` into the named scenario.
 
     ``pause_s`` scales the idle gaps (diurnal troughs, storm quiets);
     smoke/CI callers shrink it, the committed bench uses the default.
+    ``tenants``/``ramp_variant`` apply only to the tenancy scenarios:
+    the stream is tagged round-robin across ``tenants``, and the
+    ``ramped_rollout`` phases drive ``ramp_variant``'s ramp.
     """
     if name not in SCENARIO_NAMES:
         raise ValueError(
@@ -138,6 +202,16 @@ def build_scenario(
         raise ValueError("scenario needs a non-empty request stream")
     num_phases = max(2, int(num_phases))
     rng = np.random.default_rng(int(seed) + _NAME_SEEDS[name])
+    if name in TENANCY_SCENARIOS:
+        tenants = tuple(tenants)
+        if len(tenants) < 2:
+            raise ValueError(
+                f"tenancy scenario {name!r} needs >= 2 tenants, got {tenants}"
+            )
+        requests = [
+            _tag(req, tenants[i % len(tenants)])
+            for i, req in enumerate(requests)
+        ]
     even = [
         requests[(k * n) // num_phases : ((k + 1) * n) // num_phases]
         for k in range(num_phases)
@@ -197,7 +271,7 @@ def build_scenario(
             if chunk:
                 phases.append(ScenarioPhase(_cold_remap(chunk, rng)))
         desc = "steady warmup, then entity ids remapped to the cold tail"
-    else:  # hot_swap_under_load
+    elif name == "hot_swap_under_load":
         phases = []
         for k, chunk in enumerate(even):
             if not chunk:
@@ -205,7 +279,68 @@ def build_scenario(
             swap = 0 < k < num_phases - 1  # swaps land mid-run, under load
             phases.append(ScenarioPhase(chunk, swap=swap))
         desc = "steady load with concurrent hot-swap row updates mid-run"
-    return Scenario(name=name, seed=int(seed), phases=phases, description=desc)
+    elif name == "tenant_isolation":
+        flooder = tenants[0]
+        phases = []
+        for k, chunk in enumerate(even):
+            if not chunk:
+                continue
+            if num_phases // 3 <= k < (2 * num_phases) // 3:
+                # the flooder replays its share FLOOD_FACTOR extra times
+                # on top of everyone's normal traffic, same instant
+                flood = [
+                    _tag(
+                        dataclasses.replace(
+                            req, request_id=f"{req.request_id}-f{j}"
+                        ),
+                        flooder,
+                    )
+                    for j in range(FLOOD_FACTOR)
+                    for req in chunk
+                ]
+                chunk = chunk + flood
+            phases.append(ScenarioPhase(chunk))
+        desc = (
+            f"tenant {flooder!r} floods {FLOOD_FACTOR + 1}x mid-run; other "
+            "tenants' latency and error budgets must hold"
+        )
+    elif name == "ramped_rollout":
+        steps = np.interp(
+            np.linspace(0.0, 1.0, num_phases),
+            np.linspace(0.0, 1.0, len(_RAMP_STEPS)),
+            _RAMP_STEPS,
+        )
+        phases = []
+        for k, chunk in enumerate(even):
+            if not chunk:
+                continue
+            phases.append(
+                ScenarioPhase(chunk, ramp_percent=float(steps[k]))
+            )
+        desc = (
+            f"variant {ramp_variant!r} ramps "
+            f"{'->'.join(f'{s:g}%' for s in _RAMP_STEPS)} under steady "
+            "multi-tenant load"
+        )
+    else:  # nearline_loop
+        phases = []
+        for k, chunk in enumerate(even):
+            if not chunk:
+                continue
+            nearline = 0 < k < num_phases - 1  # deltas land mid-run
+            phases.append(ScenarioPhase(chunk, nearline=nearline))
+        desc = (
+            "nearline trainer emits chained per-variant deltas; the "
+            "scorer discovers and hot-swaps them under replayed traffic"
+        )
+    return Scenario(
+        name=name,
+        seed=int(seed),
+        phases=phases,
+        description=desc,
+        tenants=tuple(tenants) if name in TENANCY_SCENARIOS else (),
+        ramp_variant=ramp_variant if name in TENANCY_SCENARIOS else None,
+    )
 
 
 def make_row_swap_fn(
@@ -270,6 +405,9 @@ def run_scenario(
     max_queue: Optional[int] = None,
     swap_fn: Optional[Callable[[], None]] = None,
     swap_interval_s: float = 0.01,
+    tenancy=None,
+    nearline_fn: Optional[Callable[[], object]] = None,
+    nearline_interval_s: float = 0.02,
 ) -> dict:
     """Drive one scenario through ``replay_requests`` phase by phase and
     return its result document: per-stage p50/p99 breakdown (from the
@@ -277,45 +415,93 @@ def run_scenario(
 
     The caller owns the metrics/plane/slo objects (fresh per scenario for
     isolated verdicts) and the scorers/admission (shared across scenarios
-    for realistic warm state, or fresh for isolation)."""
+    for realistic warm state, or fresh for isolation).
+
+    Tenancy scenarios additionally take ``tenancy`` (a
+    :class:`~photon_ml_tpu.serving.tenancy.TenancyPlane`; phases then
+    replay through it — quota, router, per-variant batchers — instead of
+    plain replay) and, for ``nearline_loop``, ``nearline_fn`` (one
+    nearline trainer tick: emit + swap one delta generation per variant),
+    which runs concurrently with every ``nearline`` phase the way
+    ``swap_fn`` does for hot-swap phases. The result doc then carries
+    per-tenant requests/sheds/SLO verdicts, observed variant shares, and
+    the nearline swap ledger."""
+    if tenancy is None and scenario.tenants:
+        raise ValueError(
+            f"scenario {scenario.name!r} declares tenants "
+            f"{scenario.tenants} and needs a TenancyPlane (tenancy=...)"
+        )
     results = []
+    nearline_reports: List[object] = []
     t0 = time.perf_counter()
     for phase in scenario.phases:
         if phase.pause_before_s > 0:
             time.sleep(phase.pause_before_s)
+        if phase.ramp_percent is not None and tenancy is not None:
+            # hot ramp move: no drain, no pause — the router boundary
+            # shifts and the very next routed request sees it
+            tenancy.router.set_ramp(
+                scenario.ramp_variant, phase.ramp_percent
+            )
         stop_swapper = None
         swapper = None
-        if phase.swap and swap_fn is not None:
+        background = swap_fn if phase.swap else None
+        interval = swap_interval_s
+        if phase.nearline and nearline_fn is not None:
+
+            def _nearline_tick():
+                nearline_reports.extend(nearline_fn() or ())
+
+            background = _nearline_tick
+            interval = nearline_interval_s
+        if background is not None:
             stop_swapper = threading.Event()
 
-            def _swap_loop(evt=stop_swapper):
+            def _swap_loop(evt=stop_swapper, fn=background, wait=interval):
                 while not evt.is_set():
-                    swap_fn()
-                    evt.wait(swap_interval_s)
+                    fn()
+                    evt.wait(wait)
 
             swapper = threading.Thread(
                 target=_swap_loop, name="scenario-swapper", daemon=True
             )
             swapper.start()
         try:
-            res, snapshot = replay_requests(
-                scorers,
-                phase.requests,
-                bucket_sizes=bucket_sizes,
-                metrics=metrics,
-                model_id=f"scenario-{scenario.name}",
-                continuous=continuous,
-                max_wait_s=max_wait_s,
-                max_queue=max_queue,
-                admission=admission,
-                plane=plane,
-            )
+            if tenancy is not None:
+                res = tenancy.replay(phase.requests)
+                snapshot = None
+            else:
+                res, snapshot = replay_requests(
+                    scorers,
+                    phase.requests,
+                    bucket_sizes=bucket_sizes,
+                    metrics=metrics,
+                    model_id=f"scenario-{scenario.name}",
+                    continuous=continuous,
+                    max_wait_s=max_wait_s,
+                    max_queue=max_queue,
+                    admission=admission,
+                    plane=plane,
+                )
             results.extend(res)
         finally:
             if stop_swapper is not None:
                 stop_swapper.set()
                 swapper.join()
     wall = time.perf_counter() - t0
+    if tenancy is not None:
+        # the tenancy path batches in-process; build the same snapshot
+        # replay_requests would have, from the shared metrics object
+        lead = tenancy.registry.lead
+        snapshot = metrics.snapshot(
+            cache_stats=lead.cache_stats(),
+            compile_count=lead.compile_count,
+            residency=(
+                lead.residency_stats()
+                if hasattr(lead, "residency_stats")
+                else None
+            ),
+        )
 
     doc: dict = {
         "name": scenario.name,
@@ -343,4 +529,47 @@ def run_scenario(
         status = tracker.status()
         doc["slo"] = status
         doc["slo_verdict"] = status["verdict"]
+    if tenancy is not None:
+        doc["tenants"] = {}
+        flooder = scenario.tenants[0] if scenario.tenants else None
+        for tenant, tslo in sorted(tenancy.plane.tenant_slos.items()):
+            status = tslo.status()
+            doc["tenants"][tenant] = {
+                "requests": tenancy.plane.tenant_requests.get(tenant, 0),
+                "errors": tenancy.plane.tenant_errors.get(tenant, 0),
+                "slo": status,
+                "slo_verdict": status["verdict"],
+            }
+        if tenancy.quota is not None:
+            qstats = tenancy.quota.stats()["tenants"]
+            doc["tenant_shed"] = {
+                t: s["shed"] for t, s in qstats.items() if s["shed"]
+            }
+        doc["variant_shares"] = {
+            v: round(s, 6) for v, s in tenancy.router.shares().items()
+        }
+        doc["variants"] = tenancy.registry.stats()
+        if scenario.name == "tenant_isolation" and flooder is not None:
+            # the gate: every NON-flooding tenant's budget must hold
+            doc["isolation_ok"] = all(
+                info["slo_verdict"] == "ok"
+                for tenant, info in doc["tenants"].items()
+                if tenant != flooder
+            )
+            doc["flooding_tenant"] = flooder
+        if nearline_reports:
+            doc["nearline"] = {
+                "deltas_applied": sum(
+                    1 for r in nearline_reports if not r.rolled_back
+                ),
+                "rollbacks": sum(
+                    1 for r in nearline_reports if r.rolled_back
+                ),
+                "generations": {
+                    vid: tenancy.registry.state(vid).generation
+                    for vid in sorted(
+                        {r.variant_id for r in nearline_reports}
+                    )
+                },
+            }
     return doc

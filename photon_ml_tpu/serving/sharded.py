@@ -74,6 +74,13 @@ def _donated_scatter():
     return _SCATTER_FN
 
 
+def _pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n: hot-swap scatter writes pad to these
+    buckets so the donated scatter's compiled-program count stays
+    logarithmic in the largest write, not linear in distinct delta sizes."""
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
 def serving_mesh(num_devices: Optional[int] = None):
     """1-D serving mesh over the available devices (the shard axis of the
     stacked RE tables is laid out over it). Degenerates to a single-device
@@ -242,13 +249,25 @@ class ShardedReTable:
                 # slots are exactly the ones reused here, so the new
                 # content below overwrites them with no separate zeroing
                 # pass — and publish() runs only after EVERY replica holds
-                # the bytes
+                # the bytes. Writes are padded to power-of-two shapes
+                # (pads aim zeros at shard 0's cold slot, the admission
+                # tier's idiom): the donated scatter compiles per shape,
+                # and a nearline loop applying variable-size deltas every
+                # tick would otherwise trace a fresh program under
+                # routing.lock + write_lock — a multi-hundred-ms stall
+                # for every concurrent scoring thread
                 a_shards, a_slots, _ = routing.allocate(new_rows.size)
+                n = int(new_rows.size)
+                k = _pow2_bucket(n)
+                shards = np.zeros(k, dtype=np.int32)
+                slots = np.full(k, routing.cold_slot, dtype=np.int32)
+                shards[:n] = a_shards
+                slots[:n] = a_slots
+                content = np.zeros((k, values.shape[1]), dtype=np.float32)
                 for lock, table in replicas:
                     with lock:
-                        table.write_slots(
-                            a_shards, a_slots, table.host_rows(new_rows)
-                        )
+                        content[:n] = table.host_rows(new_rows)
+                        table.write_slots(shards, slots, content)
                 routing.publish(new_rows, a_shards, a_slots)
                 res_slots = routing._slot_of[rows]
             # only still-resident rows get the in-place write: a row of
@@ -256,9 +275,14 @@ class ShardedReTable:
             # re-admission (its override already carries the new content)
             resident = res_slots >= 0
             if resident.any():
-                w_shards = routing._shard_of[rows[resident]]
-                w_slots = res_slots[resident]
-                w_values = values[resident]
+                n = int(resident.sum())
+                k = _pow2_bucket(n)
+                w_shards = np.zeros(k, dtype=np.int32)
+                w_slots = np.full(k, routing.cold_slot, dtype=np.int32)
+                w_shards[:n] = routing._shard_of[rows[resident]]
+                w_slots[:n] = res_slots[resident]
+                w_values = np.zeros((k, values.shape[1]), dtype=np.float32)
+                w_values[:n] = values[resident]
                 for lock, table in replicas:
                     with lock:
                         table.write_slots(w_shards, w_slots, w_values)
@@ -606,7 +630,15 @@ class ShardedGameScorer:
         requests: Sequence[ScoreRequest],
         bucket_size: Optional[int] = None,
         stages: Optional[dict] = None,
+        view: Optional[Tuple[ServingArtifact, Dict[str, object]]] = None,
     ) -> List[ScoreResult]:
+        """Score one bucket. ``view`` is the multi-model hook: an
+        ``(artifact, fe_params)`` pair that overrides WHICH entity indexes
+        resolve rows and WHICH fixed-effect vectors the jitted program
+        reads — same shapes, same compiled program, same shared RE tables.
+        The tenancy plane's :class:`VariantRegistry` builds one view per
+        variant; ``view=None`` is the plain single-model path, bitwise
+        unchanged."""
         n = len(requests)
         bucket = int(bucket_size) if bucket_size is not None else n
         if n == 0:
@@ -614,7 +646,7 @@ class ShardedGameScorer:
         if n > bucket:
             raise ValueError(f"{n} requests do not fit bucket size {bucket}")
         with span("serve/score_batch", n=n, bucket=bucket):
-            return self._score_batch_impl(requests, n, bucket, stages)
+            return self._score_batch_impl(requests, n, bucket, stages, view)
 
     def _score_batch_impl(
         self,
@@ -622,9 +654,12 @@ class ShardedGameScorer:
         n: int,
         bucket: int,
         stages: Optional[dict] = None,
+        view: Optional[Tuple[ServingArtifact, Dict[str, object]]] = None,
     ) -> List[ScoreResult]:
         import jax.numpy as jnp
 
+        artifact = self._artifact if view is None else view[0]
+        fe_params = self._fe_params if view is None else view[1]
         with span("serve/featurize", n=n):
             shards, offsets = self._featurize(requests, bucket)
         if stages is not None:
@@ -633,8 +668,8 @@ class ShardedGameScorer:
         slots: Dict[str, np.ndarray] = {}
         cold: Dict[int, List[str]] = {}
         with span("serve/route", n=n):
-            for cid, _, re_type in self._re_specs:
-                table = self._artifact.tables[cid]
+            for cid, feature_shard, re_type in self._re_specs:
+                table = artifact.tables[cid]
                 entity_rows = np.full(bucket, -1, dtype=np.int64)
                 # mirror of GameScorer's route: ids stay C-level, and
                 # the common every-request-carries-an-id case hands the
@@ -664,8 +699,18 @@ class ShardedGameScorer:
                     entity_rows[:n]
                 )
                 # importance plane: fold this batch into the EWMA request
-                # frequencies (no-op under the default eviction policy)
-                routing.note_requests(entity_rows[:n])
+                # frequencies (no-op under the default eviction policy).
+                # Under the importance policy each request also deposits
+                # its feature-vector norm, so importance bounds the row's
+                # cumulative score-delta-vs-FE-only, not just its hit count.
+                if routing.wants_feature_norms:
+                    vals = shards[feature_shard][0]
+                    routing.note_requests(
+                        entity_rows[:n],
+                        feature_norms=np.linalg.norm(vals[:n], axis=1),
+                    )
+                else:
+                    routing.note_requests(entity_rows[:n])
                 if deferred.size and self._admission is not None:
                     self._admission.note_deferred(cid, deferred)
                 # pad rows (and this batch's FE-only rows) gather the zero
@@ -702,7 +747,7 @@ class ShardedGameScorer:
         # invalidate the captured array
         with self.write_lock:
             params = {
-                "fe": self._fe_params,
+                "fe": fe_params,
                 "re": {
                     cid: self._providers[cid].table
                     for cid, _, _ in self._re_specs

@@ -27,6 +27,7 @@ from photon_ml_tpu.telemetry.span import (
 )
 from photon_ml_tpu.telemetry.metrics import (
     MetricsRegistry,
+    ScopedMetrics,
     get_registry,
     jit_trace_counts,
     note_jit_trace,
@@ -71,6 +72,7 @@ __all__ = [
     "get_tracer",
     "span",
     "MetricsRegistry",
+    "ScopedMetrics",
     "get_registry",
     "jit_trace_counts",
     "note_jit_trace",

@@ -26,11 +26,88 @@ from typing import Any, Dict, Optional
 
 __all__ = [
     "MetricsRegistry",
+    "ScopedMetrics",
     "get_registry",
     "note_jit_trace",
     "jit_trace_counts",
     "record_memory_watermarks",
 ]
+
+
+def _parse_labels(labels) -> Dict[str, str]:
+    """Label spec → dict: accepts a mapping or a ``"k=v"`` /
+    ``"k=v,k2=v2"`` string (the ``scoped("tenant=a")`` shorthand)."""
+    if isinstance(labels, str):
+        out: Dict[str, str] = {}
+        for part in labels.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    f"label spec {labels!r}: expected 'key=value' parts"
+                )
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip()
+        if not out:
+            raise ValueError("label spec must name at least one label")
+        return out
+    return {str(k): str(v) for k, v in dict(labels).items()}
+
+
+def _format_labels(labels: Dict[str, str]) -> str:
+    """Canonical Prometheus label suffix ``{k="v",...}``: keys sorted so
+    the same label set always produces the same metric name, values
+    escaped per the exposition format."""
+    parts = []
+    for k in sorted(labels):
+        v = (
+            str(labels[k])
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+class ScopedMetrics:
+    """Label-scoped view onto a :class:`MetricsRegistry`: every metric
+    name written through it carries a fixed Prometheus label set
+    (``serving.requests{tenant="a"}``). The underlying storage is the
+    parent registry — scoped names land in the same counters/gauges/
+    histograms dicts, render as proper labeled samples in ``/metrics``
+    (see ``serving/introspect.py``), and never collide with the unlabeled
+    base names. Views are cheap and stateless; build one per tenant."""
+
+    def __init__(self, parent: "MetricsRegistry", labels):
+        self._parent = parent
+        self.labels = _parse_labels(labels)
+        if not self.labels:
+            raise ValueError("ScopedMetrics needs at least one label")
+        self._suffix = _format_labels(self.labels)
+
+    def scoped_name(self, name: str) -> str:
+        """The labeled storage name a metric renders under."""
+        return name + self._suffix
+
+    def scoped(self, labels) -> "ScopedMetrics":
+        """A further-scoped view (merged labels; new keys win)."""
+        merged = dict(self.labels)
+        merged.update(_parse_labels(labels))
+        return ScopedMetrics(self._parent, merged)
+
+    def count(self, name: str, value: float = 1.0) -> None:
+        self._parent.count(self.scoped_name(name), value)
+
+    def gauge(self, name: str, value: float) -> None:
+        self._parent.gauge(self.scoped_name(name), value)
+
+    def observe(self, name: str, value: float) -> None:
+        self._parent.observe(self.scoped_name(name), value)
+
+    def counter_value(self, name: str) -> float:
+        return self._parent.counter_value(self.scoped_name(name))
 
 
 def _new_reservoir(seed: int):
@@ -82,6 +159,13 @@ class MetricsRegistry:
             self._gauge_peaks.clear()
             self._hists.clear()
             self._next_seed = 0
+
+    def scoped(self, labels) -> ScopedMetrics:
+        """A label-scoped view of this registry: ``scoped("tenant=a")``
+        (or a mapping) returns a :class:`ScopedMetrics` whose writes land
+        under Prometheus-labeled names. Existing unlabeled names are
+        untouched."""
+        return ScopedMetrics(self, labels)
 
     # ------------------------------------------------------------ readers
     def counter_value(self, name: str) -> float:

@@ -45,6 +45,7 @@ from photon_ml_tpu.incremental import (
     fingerprint_dir,
     incremental_update,
     load_delta,
+    rebase_delta,
     save_delta,
     verify_chain,
 )
@@ -253,6 +254,72 @@ class TestDeltaArtifact:
         assert overlay.get_index("v0") == n
         assert overlay.get_feature_name(n + 1) == "v1"
         assert overlay.get_index("u0") == base.get_index("u0")
+
+    def test_independent_chains_share_one_base(self, nearline, tmp_path):
+        """The multi-variant shape: TWO independent delta chains rooted at
+        the SAME base fingerprint (one per served variant). Each chain
+        verifies and compacts on its own; splicing a link from one chain
+        into the other is refused."""
+        base_fp = fingerprint_dir(nearline["artifact_dir"])
+        art = nearline["artifact"]
+        upd = nearline["update"].re_updates
+
+        def _scaled(s):
+            return {
+                cid: {
+                    eid: {k: v * s for k, v in m.items()}
+                    for eid, m in ents.items()
+                }
+                for cid, ents in upd.items()
+            }
+
+        def _chain(scale, root):
+            d1 = build_delta(
+                _scaled(scale), art, base_fingerprint=base_fp, generation=1
+            )
+            d1 = save_delta(d1, os.path.join(root, delta_dir_name(1)))
+            d2 = build_delta(
+                _scaled(scale * 3), art,
+                base_fingerprint=d1.fingerprint, generation=2,
+            )
+            d2 = save_delta(d2, os.path.join(root, delta_dir_name(2)))
+            return root, [d1, d2]
+
+        dir_a, chain_a = _chain(0.5, str(tmp_path / "variant-a"))
+        dir_b, chain_b = _chain(-1.0, str(tmp_path / "variant-b"))
+        assert chain_a[0].fingerprint != chain_b[0].fingerprint
+        verify_chain(base_fp, chain_a)
+        verify_chain(base_fp, chain_b)
+        with pytest.raises(ValueError, match="chain broken"):
+            verify_chain(base_fp, [chain_a[0], chain_b[1]])
+        # each chain compacts to its OWN artifact == its in-memory fold
+        for chain, root in ((chain_a, dir_a), (chain_b, dir_b)):
+            folded = apply_delta(apply_delta(art, chain[0]), chain[1])
+            out = os.path.join(root, "compacted")
+            compact(
+                nearline["artifact_dir"],
+                [os.path.join(root, delta_dir_name(g)) for g in (1, 2)],
+                out,
+            )
+            reloaded = load_artifact(out)
+            for cid, table in folded.tables.items():
+                np.testing.assert_allclose(
+                    np.asarray(reloaded.tables[cid].weights),
+                    np.asarray(table.weights), atol=1e-7,
+                )
+
+    def test_rebase_retargets_chain_head(self, nearline):
+        """``rebase_delta`` moves a base-rooted delta onto a variant's own
+        chain head: the copy verifies there, the input is untouched, and
+        the content fingerprint is cleared (new content, unsaved)."""
+        delta = nearline["delta"]
+        moved = rebase_delta(delta, "a" * 16)
+        assert moved.base_fingerprint == "a" * 16
+        assert moved.fingerprint is None
+        assert delta.base_fingerprint != "a" * 16  # input untouched
+        verify_chain("a" * 16, [moved])
+        with pytest.raises(ValueError, match="chain broken"):
+            verify_chain("a" * 16, [delta])
 
     def test_discover_deltas_sorted(self, nearline, tmp_path):
         d = str(tmp_path / "watch")

@@ -439,7 +439,14 @@ class TestScenarios:
         for name in SCENARIO_NAMES:
             a = build_scenario(name, requests, seed=7, num_phases=6)
             b = build_scenario(name, requests, seed=7, num_phases=6)
-            assert a.num_requests == len(requests), name
+            if name == "tenant_isolation":
+                # the flooding tenant replays its mid-run share on top
+                # of the full stream, so this scenario carries MORE
+                # requests than the input; every other shape preserves
+                # the stream exactly
+                assert a.num_requests > len(requests), name
+            else:
+                assert a.num_requests == len(requests), name
             assert [len(p.requests) for p in a.phases] == [
                 len(p.requests) for p in b.phases
             ], name

@@ -741,6 +741,34 @@ class TestServeGameCli:
         with open(metrics2) as f:
             assert json.load(f)["num_requests"] == 50
 
+    def test_tenants_flag_tracks_per_tenant_slo(
+        self, ratings_model_dir, tmp_path
+    ):
+        """--tenants + --slo-latency-ms: the replayed stream is tagged
+        round-robin and each tenant's SLO tracker writes its own
+        tenant-labeled serving.slo.* series into the process registry."""
+        from photon_ml_tpu.cli.serve_game import main as serve_main
+        from photon_ml_tpu.serving import prometheus_text
+        from photon_ml_tpu.telemetry.metrics import get_registry
+
+        metrics_file = str(tmp_path / "metrics.json")
+        rc = serve_main([
+            "--model-dir", ratings_model_dir,
+            "--data-dirs", os.path.join(RATINGS, "test"),
+            "--metrics-output", metrics_file,
+            "--max-requests", "64",
+            "--bucket-sizes", "4,16",
+            "--cache-capacity", "64",
+            "--tenants", "alpha,beta",
+            "--slo-latency-ms", "1000",
+        ])
+        assert rc == 0
+        with open(metrics_file) as f:
+            assert json.load(f)["num_requests"] == 64
+        text = prometheus_text(get_registry().snapshot())
+        assert 'tenant="alpha"' in text
+        assert 'tenant="beta"' in text
+
     def test_export_only_invocation(self, ratings_model_dir, tmp_path):
         from photon_ml_tpu.cli.serve_game import main as serve_main
 
