@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Real multi-controller cluster runs (one process per host/pod worker).
+
+The emulated mesh (`train_game --hosts N`) spawns its workers locally; on
+a real pod slice each controller runs its OWN process, so the roles are
+started explicitly instead:
+
+  # on the coordinator host (also the trainer):
+  python dev-scripts/run_multihost.py coordinator \
+      --hosts 4 --bind 0.0.0.0 --port 7341 \
+      -- --train-data-dirs gs://.../train --coordinate-config game.json \
+         --task LOGISTIC_REGRESSION --streaming --block-rows 65536 \
+         --output-dir out/
+
+  # on each worker host h = 0..3:
+  python dev-scripts/run_multihost.py worker \
+      --coordinator COORD_IP:7341 --host-id $h \
+      --train-data-dirs gs://.../train --coordinate-config game.json \
+      --task LOGISTIC_REGRESSION --feature-shard global --block-rows 65536
+
+The coordinator role runs the full train_game CLI with the cluster plane
+pre-bound to --bind/--port (it waits for --hosts hellos before the first
+pass); the worker role is a thin wrapper over
+``python -m photon_ml_tpu.parallel.cluster.worker``. Every host must see
+the same training files so the deterministic block plans agree — the
+hello handshake rejects skew.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _coordinator(args, train_args) -> int:
+    # Monkeypatch the coordinator's bind point: ClusterCoordinator binds
+    # 127.0.0.1:0 by default (the emulated mesh); a real run needs a
+    # routable address the workers were told about.
+    import socket
+
+    from photon_ml_tpu.parallel.cluster import coordinator as coord_mod
+
+    orig_init = coord_mod.ClusterCoordinator.__init__
+
+    def patched_init(self, *a, **kw):
+        kw["bind_host"] = args.bind
+        orig_init(self, *a, **kw)
+        if args.port:
+            # rebind to the announced fixed port
+            self._server.close()
+            self._server = socket.create_server((args.bind, args.port))
+            self.address = self._server.getsockname()[:2]
+
+    coord_mod.ClusterCoordinator.__init__ = patched_init
+
+    # ClusterPlane.launch spawns local subprocesses; with remote workers we
+    # skip the spawn and only wait for hellos.
+    from photon_ml_tpu.parallel.cluster import launcher as launcher_mod
+
+    orig_launch = launcher_mod.ClusterPlane.launch.__func__
+
+    def patched_launch(cls, num_hosts, num_blocks, **kw):
+        coordinator = coord_mod.ClusterCoordinator(num_hosts, num_blocks)
+        print(
+            f"[run_multihost] waiting for {num_hosts} workers on "
+            f"{coordinator.address[0]}:{coordinator.address[1]}",
+            flush=True,
+        )
+        coordinator.wait_for_workers(timeout_s=args.startup_timeout_s)
+        return cls(coordinator, procs=[], log_paths=[])
+
+    launcher_mod.ClusterPlane.launch = classmethod(patched_launch)
+
+    from photon_ml_tpu.cli.train_game import main as train_main
+
+    return train_main(train_args + ["--hosts", str(args.hosts)])
+
+
+def _worker(worker_args) -> int:
+    from photon_ml_tpu.parallel.cluster.worker import main as worker_main
+
+    return worker_main(worker_args)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in ("coordinator", "worker"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    role, rest = argv[0], argv[1:]
+    if role == "worker":
+        # everything after the role goes to the worker module, with
+        # --coordinator accepted as an alias for --coordinator-address
+        rest = [
+            "--coordinator-address" if a == "--coordinator" else a
+            for a in rest
+        ]
+        return _worker(rest)
+    p = argparse.ArgumentParser(prog="run_multihost.py coordinator")
+    p.add_argument("--hosts", type=int, required=True)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0,
+                   help="fixed coordinator port (0 = ephemeral; use fixed "
+                        "so workers can be started first)")
+    p.add_argument("--startup-timeout-s", type=float, default=600.0)
+    if "--" not in rest:
+        p.error("separate train_game args with '--'")
+    split = rest.index("--")
+    args = p.parse_args(rest[:split])
+    return _coordinator(args, rest[split + 1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

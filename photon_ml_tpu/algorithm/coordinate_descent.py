@@ -270,6 +270,10 @@ class CoordinateDescent:
         if schedule:
             tracker.record_schedule(outer, cid, schedule)
             coord.last_schedule_decisions = None
+        cluster_events = getattr(coord, "last_cluster_events", None)
+        if cluster_events:
+            tracker.record_cluster(outer, cid, cluster_events)
+            coord.last_cluster_events = None
         skipped = getattr(coord, "last_skipped_blocks", None)
         if skipped:
             for s in skipped:

@@ -173,6 +173,27 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "SLO error budget (tenant-labeled serving.slo.* "
                         "series in /metrics, per-tenant burn in /healthz "
                         "and /varz)")
+    p.add_argument("--variants", default=None,
+                   help="comma-separated candidate variant names: serve "
+                        "through the full tenancy plane (quota -> seeded "
+                        "router -> one per-variant batcher over the shared "
+                        "sharded scorer) instead of the plain replay path; "
+                        "each variant starts undiverged from the base "
+                        "(sharded mode only)")
+    p.add_argument("--variant-ramp", type=float, default=None,
+                   help="percent of traffic routed to EACH --variants "
+                        "entry (default: an even split with the base, "
+                        "100/(n+1)); ramps must sum to <= 100")
+    p.add_argument("--variant-seed", type=int, default=0,
+                   help="router hash seed: the same (tenant, request id, "
+                        "seed) always routes identically (default 0)")
+    p.add_argument("--tenant-rate", type=float, default=None,
+                   help="with --tenants and --variants: per-tenant token "
+                        "refill rate (requests/s) for quota admission; "
+                        "over-budget tenants shed alone")
+    p.add_argument("--tenant-burst", type=float, default=None,
+                   help="per-tenant token bucket burst capacity (with "
+                        "--tenant-rate; default: the rate)")
     add_telemetry_args(p)
     return p.parse_args(argv)
 
@@ -347,6 +368,98 @@ def _auto_tune_serving(args, artifact, requests, active, logger):
     return dict(winner.config), result.to_dict()
 
 
+def _serve_tenancy(
+    args, logger, active, tenants, scorers, admission, bucket_sizes,
+    requests, metrics, plane,
+) -> dict:
+    """Replay through the full tenancy plane: per-tenant quota admission,
+    seeded variant routing, and one sealed batcher per variant over the
+    shared sharded scorer. Every ``--variants`` entry starts undiverged
+    (bitwise the base) — this is the rollout topology; deltas diverge
+    variants later via the registry. Returns the metrics snapshot with a
+    ``tenancy`` status block (variants, router ramps, quota, tenant SLOs)."""
+    import time as _time
+
+    from photon_ml_tpu.serving import (
+        TenancyPlane,
+        TenantBudget,
+        TenantQuota,
+        VariantRegistry,
+        VariantRouter,
+    )
+    from photon_ml_tpu.telemetry.metrics import get_registry
+
+    registry = VariantRegistry(scorers[0])
+    router = VariantRouter(seed=active["variant_seed"])
+    names = active["variants"]
+    ramp = (
+        active["variant_ramp"]
+        if active["variant_ramp"] is not None
+        else 100.0 / (len(names) + 1)
+    )
+    for name in names:
+        registry.add_variant(name)
+        router.set_ramp(name, ramp)
+    quota = None
+    if tenants and args.tenant_rate is not None:
+        burst = (
+            args.tenant_burst
+            if args.tenant_burst is not None
+            else args.tenant_rate
+        )
+        quota = TenantQuota({
+            t: TenantBudget(rate=args.tenant_rate, burst=burst)
+            for t in tenants
+        })
+    tenancy = TenancyPlane(
+        registry,
+        router=router,
+        plane=plane,
+        quota=quota,
+        metrics=metrics,
+        bucket_sizes=tuple(bucket_sizes),
+        max_wait_s=active["batch_deadline_ms"] / 1e3,
+        metrics_registry=get_registry(),
+    )
+    logger.info(
+        "tenancy plane: base + %d variant(s) at %.1f%% each%s",
+        len(names), ramp, ", per-tenant quota" if quota is not None else "",
+    )
+    started_admission = False
+    if admission is not None and admission._thread is None:
+        admission.start()
+        started_admission = True
+    try:
+        t0 = _time.perf_counter()
+        results = tenancy.replay(requests, poll_every=64)
+        wall = _time.perf_counter() - t0
+    finally:
+        if started_admission:
+            admission.stop()
+    lead = scorers[0]
+    residency = None
+    if hasattr(lead, "residency_stats"):
+        residency = lead.residency_stats() or None
+    snapshot = metrics.snapshot(
+        cache_stats=lead.cache_stats() or None,
+        compile_count=lead.compile_count,
+        residency=residency,
+        admission=admission.stats() if admission is not None else None,
+    )
+    snapshot["replay_wall_seconds"] = round(wall, 6)
+    if wall > 0:
+        snapshot["replay_requests_per_s"] = round(len(requests) / wall, 3)
+    snapshot["num_results"] = len(results)
+    if plane is not None:
+        report = plane.live_report()
+        slo_doc = report.pop("slo", None)
+        snapshot["request_plane"] = report
+        if slo_doc is not None:
+            snapshot["slo"] = slo_doc
+    snapshot["tenancy"] = tenancy.status()
+    return snapshot
+
+
 def run(args: argparse.Namespace) -> Optional[dict]:
     from photon_ml_tpu.event import EventEmitter
 
@@ -431,6 +544,25 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
     active["request_sample_rate"] = args.request_sample_rate
     active["slo_latency_ms"] = args.slo_latency_ms
     active["tenants"] = tenants or None
+
+    variants = [
+        v.strip() for v in (args.variants or "").split(",") if v.strip()
+    ]
+    if variants:
+        if active["mode"] == "cached":
+            raise SystemExit(
+                "--variants needs variant views over the sharded scorer; "
+                "drop --cache-capacity"
+            )
+        if args.watch_deltas or args.auto_tune:
+            raise SystemExit(
+                "--variants replaces the plain replay path; it is not "
+                "combinable with --watch-deltas or --auto-tune (apply "
+                "per-variant deltas through the variant registry instead)"
+            )
+    active["variants"] = variants or None
+    active["variant_ramp"] = args.variant_ramp
+    active["variant_seed"] = args.variant_seed
 
     if args.export_artifact_dir:
         from photon_ml_tpu.serving import save_artifact
@@ -686,60 +818,74 @@ def _serve_stream(
 
         metrics = ServingMetrics()
         manager = None
-        if args.watch_deltas:
-            from photon_ml_tpu.incremental import fingerprint_dir
-            from photon_ml_tpu.serving import (
-                CoordinatedHotSwap,
-                HotSwapManager,
-            )
-
-            fingerprint = (
-                fingerprint_dir(args.artifact_dir)
-                if args.artifact_dir else None
-            )
-            managers = [
-                HotSwapManager(
-                    s,
-                    fingerprint=fingerprint,
-                    # only the lead manager records swap metrics/events;
-                    # replica swaps are the same delta fanned out
-                    metrics=metrics if i == 0 else None,
-                    emitter=emitter if i == 0 else None,
-                    model_id=model_id,
+        if active.get("variants"):
+            if len(scorers) > 1:
+                logger.warning(
+                    "--variants serves through ONE shared scorer; ignoring "
+                    "%d extra replica(s)", len(scorers) - 1,
                 )
-                for i, s in enumerate(scorers)
-            ]
-            manager = (
-                managers[0] if len(managers) == 1
-                else CoordinatedHotSwap(managers)
-            )
-            state["manager"] = manager
-            logger.info(
-                "watching %s for delta artifacts (poll every %d requests)",
-                args.watch_deltas, args.watch_chunk,
-            )
-        with timer.time("replay"):
-            results, snapshot = replay_requests(
-                scorers if continuous else scorers[0], requests,
-                bucket_sizes=bucket_sizes,
-                metrics=metrics,
-                emitter=emitter,
-                model_id=model_id,
-                swap_manager=manager,
-                watch_dir=args.watch_deltas,
-                poll_every=args.watch_chunk,
-                continuous=continuous,
-                max_wait_s=active["batch_deadline_ms"] / 1e3,
-                max_queue=active["max_queue"],
-                admission=admission,
-                plane=plane,
-            )
-        if manager is not None:
-            logger.info(
-                "served through generation %d (%d swap(s))",
-                manager.generation,
-                len(snapshot.get("swap_reports", [])),
-            )
+                scorers = scorers[:1]
+            active["mode"] = "sharded-tenancy"
+            with timer.time("replay"):
+                snapshot = _serve_tenancy(
+                    args, logger, active, tenants, scorers, admission,
+                    bucket_sizes, requests, metrics, plane,
+                )
+        else:
+            if args.watch_deltas:
+                from photon_ml_tpu.incremental import fingerprint_dir
+                from photon_ml_tpu.serving import (
+                    CoordinatedHotSwap,
+                    HotSwapManager,
+                )
+
+                fingerprint = (
+                    fingerprint_dir(args.artifact_dir)
+                    if args.artifact_dir else None
+                )
+                managers = [
+                    HotSwapManager(
+                        s,
+                        fingerprint=fingerprint,
+                        # only the lead manager records swap metrics/events;
+                        # replica swaps are the same delta fanned out
+                        metrics=metrics if i == 0 else None,
+                        emitter=emitter if i == 0 else None,
+                        model_id=model_id,
+                    )
+                    for i, s in enumerate(scorers)
+                ]
+                manager = (
+                    managers[0] if len(managers) == 1
+                    else CoordinatedHotSwap(managers)
+                )
+                state["manager"] = manager
+                logger.info(
+                    "watching %s for delta artifacts (poll every %d "
+                    "requests)", args.watch_deltas, args.watch_chunk,
+                )
+            with timer.time("replay"):
+                results, snapshot = replay_requests(
+                    scorers if continuous else scorers[0], requests,
+                    bucket_sizes=bucket_sizes,
+                    metrics=metrics,
+                    emitter=emitter,
+                    model_id=model_id,
+                    swap_manager=manager,
+                    watch_dir=args.watch_deltas,
+                    poll_every=args.watch_chunk,
+                    continuous=continuous,
+                    max_wait_s=active["batch_deadline_ms"] / 1e3,
+                    max_queue=active["max_queue"],
+                    admission=admission,
+                    plane=plane,
+                )
+            if manager is not None:
+                logger.info(
+                    "served through generation %d (%d swap(s))",
+                    manager.generation,
+                    len(snapshot.get("swap_reports", [])),
+                )
 
         snapshot["model_id"] = model_id
         snapshot["bucket_sizes"] = list(bucket_sizes)

@@ -247,6 +247,29 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "held-out metric in far fewer block visits on "
                         "skewed data; off is bitwise-identical to the "
                         "historical shuffle order")
+    p.add_argument("--hosts", type=int, default=0, metavar="N",
+                   help="cluster: run the streamed fixed-effect solve "
+                        "data-parallel across N coordinated worker "
+                        "processes (the emulated multi-host mesh; see "
+                        "dev-scripts/run_multihost.py for real "
+                        "multi-controller runs). Each full-batch pass "
+                        "partitions the blocks across hosts by "
+                        "gap-balanced assignment and allreduces the "
+                        "partial (value, grad) sums; a killed host's "
+                        "blocks are reassigned to survivors instead of "
+                        "aborting. Requires --streaming with the default "
+                        "--stream-mode full and exactly one fixed-effect "
+                        "coordinate; random-effect coordinates still run "
+                        "on this host (entity-partitioned)")
+    p.add_argument("--cluster-block-latency-ms", type=float, default=0.0,
+                   metavar="MS",
+                   help="cluster: emulated per-block device latency in each "
+                        "worker (benchmarking scaling on one box; 0 = off)")
+    p.add_argument("--cluster-kill-host", default=None, metavar="HOST:BLOCKS",
+                   help="cluster chaos drill: worker HOST kills itself after "
+                        "streaming BLOCKS blocks; training must finish "
+                        "anyway with its blocks reassigned (recovery lands "
+                        "in the --progress-out ledger)")
     p.add_argument("--progress-out", default=None, metavar="PROGRESS.jsonl",
                    help="write the convergence-plane ledger here: one JSONL "
                         "record per coordinate update (objective, grad norm, "
@@ -290,6 +313,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             "--stream-mode stochastic (full-batch mode must visit every "
             "block per pass to stay exact)"
         )
+    if args.hosts < 0:
+        p.error("--hosts must be >= 0")
+    if args.hosts > 0 and (not args.streaming or args.stream_mode != "full"):
+        p.error(
+            "--hosts requires --streaming with --stream-mode full (the "
+            "distributed pass sums exact per-host partials)"
+        )
+    if args.cluster_kill_host is not None:
+        if args.hosts < 2:
+            p.error("--cluster-kill-host needs --hosts >= 2 (someone must "
+                    "survive to take over the blocks)")
+        try:
+            h, n = args.cluster_kill_host.split(":")
+            int(h), int(n)
+        except ValueError:
+            p.error("--cluster-kill-host must be HOST:BLOCKS, e.g. 1:4")
     if args.staleness < 0:
         p.error("--staleness must be >= 0")
     if args.parallel_data < 0 or args.parallel_feat < 1:
@@ -570,6 +609,7 @@ def run(args: argparse.Namespace) -> GameFit:
     t_start = time.perf_counter()
     progress = None
     introspect = None
+    cluster = None
     try:
         if args.progress_out or args.introspect_port is not None:
             from photon_ml_tpu.telemetry import ConvergenceTracker
@@ -654,6 +694,54 @@ def run(args: argparse.Namespace) -> GameFit:
                 source.plan.total_rows, source.plan.num_blocks,
                 args.block_rows, cache_dir or "off", source.decode_workers,
             )
+            if args.hosts > 0:
+                from photon_ml_tpu.estimators.game import (
+                    FixedEffectCoordinateConfiguration as _FECfg,
+                )
+                from photon_ml_tpu.parallel.cluster import ClusterPlane
+
+                fe_shards = [
+                    cfg.feature_shard
+                    for cfg in coordinates.values()
+                    if isinstance(cfg, _FECfg)
+                ]
+                if len(fe_shards) != 1:
+                    raise ValueError(
+                        "--hosts requires exactly one fixed-effect "
+                        f"coordinate, config has {len(fe_shards)}"
+                    )
+                kill_host = None
+                if args.cluster_kill_host is not None:
+                    h, n = args.cluster_kill_host.split(":")
+                    kill_host = (int(h), int(n))
+                with timer.time("launch cluster"):
+                    cluster = ClusterPlane.launch(
+                        num_hosts=args.hosts,
+                        num_blocks=source.plan.num_blocks,
+                        train_dirs=train_dirs,
+                        coordinate_config=args.coordinate_config,
+                        task=args.task,
+                        feature_shard=fe_shards[0],
+                        block_rows=args.block_rows,
+                        input_columns_names=args.input_columns_names,
+                        on_block_error=args.on_block_error,
+                        prefetch_depth=args.prefetch_depth,
+                        block_cache_dir=(
+                            os.path.join(cache_dir, "cluster")
+                            if cache_dir
+                            else None
+                        ),
+                        block_latency_s=(
+                            args.cluster_block_latency_ms / 1000.0
+                            if args.cluster_block_latency_ms > 0
+                            else None
+                        ),
+                        kill_host=kill_host,
+                    )
+                logger.info(
+                    "cluster: %d worker host(s) connected on %s:%d",
+                    args.hosts, *cluster.coordinator.address,
+                )
         else:
             with timer.time("read training data"):
                 data, index_maps, _ = read_game_data(
@@ -888,6 +976,7 @@ def run(args: argparse.Namespace) -> GameFit:
                     mode=args.stream_mode,
                     gap_schedule=args.gap_schedule,
                     progress=progress,
+                    cluster=cluster,
                 )
                 all_fits = [fit]
                 all_fit_overrides = [{}]
@@ -1037,6 +1126,8 @@ def run(args: argparse.Namespace) -> GameFit:
             logger.info("timing %-28s %.3fs", name, seconds)
         return best
     finally:
+        if cluster is not None:
+            cluster.close()
         # the introspection hold runs first, so an operator can still read
         # /healthz (503 after a divergence abort) and /progress before the
         # plane tears down

@@ -612,6 +612,7 @@ class GameEstimator:
         seed: int = 0,
         gap_schedule: bool = False,
         progress: Optional[object] = None,
+        cluster: Optional[object] = None,
     ) -> GameFit:
         """Out-of-core ``fit``: fixed-effect coordinates stream fixed-shape
         blocks from a :class:`~photon_ml_tpu.streaming.StreamingSource`
@@ -631,6 +632,15 @@ class GameEstimator:
         gate it on held-out metric parity before trusting it.
         ``gap_schedule=True`` (stochastic only) replaces the blind shuffle
         with duality-gap-guided block selection (docs/SCALING.md).
+
+        ``cluster`` (a ``parallel.cluster.ClusterPlane`` or bare
+        ``ClusterCoordinator``) runs the fixed-effect solve data-parallel
+        across hosts: every streamed pass becomes a distributed allreduce
+        over the workers' assigned block shares, while random-effect
+        coordinates stay entity-partitioned on this host (per-entity
+        solves never cross hosts — the GAME structure makes RE
+        embarrassingly parallel). Requires ``mode='full'`` and exactly one
+        fixed-effect coordinate (one cluster drives one block plan).
         """
         from photon_ml_tpu.streaming.coordinate import (
             StreamingFixedEffectCoordinate,
@@ -657,6 +667,17 @@ class GameEstimator:
                     f"streaming coordinate {cid!r}: normalization requires "
                     "a streamed feature-stats pass (not implemented); use "
                     "--normalization-type NONE or train in-memory"
+                )
+        if cluster is not None:
+            if mode != "full":
+                raise ValueError(
+                    "cluster training requires mode='full' (the distributed "
+                    "pass sums exact per-host partials)"
+                )
+            if len(fe_cfgs) != 1:
+                raise ValueError(
+                    "cluster training requires exactly one fixed-effect "
+                    f"coordinate, config has {sorted(fe_cfgs) or 'none'}"
                 )
         re_shards = sorted({
             cfg.feature_shard
@@ -692,6 +713,7 @@ class GameEstimator:
                     # convergence plane: per-block loss/grad/gap probes run
                     # only when a tracker is attached (bitwise contract)
                     collect_block_stats=progress is not None,
+                    cluster=cluster,
                 )
             else:
                 coordinates[cid] = self._build_coordinate(cid, cfg, data)

@@ -1,0 +1,29 @@
+"""Cluster plane: multi-host streaming data-parallel coordinate descent.
+
+The composition ROADMAP item 1 asks for: PR 10's block-sharded streaming
+solver run data-parallel across hosts, PR 13's gap ledger generalized into
+cross-host block assignment, and PR 14's failure plane extended with a
+host-failure protocol (heartbeat + socket-EOF detection, block
+reassignment instead of job abort). See docs/SCALING.md "Multi-host
+cluster plane" for the allreduce semantics and the staleness bound.
+"""
+
+from .assigner import BlockAssigner
+from .coordinator import ClusterCoordinator, ClusterError
+from .launcher import ClusterPlane
+from .protocol import MessageSocket, ProtocolError, connect, recv_msg, send_msg
+from .worker import ClusterWorker, serve_worker_in_thread
+
+__all__ = [
+    "BlockAssigner",
+    "ClusterCoordinator",
+    "ClusterError",
+    "ClusterPlane",
+    "ClusterWorker",
+    "MessageSocket",
+    "ProtocolError",
+    "connect",
+    "recv_msg",
+    "send_msg",
+    "serve_worker_in_thread",
+]

@@ -1,0 +1,352 @@
+"""Coordinator side of the cluster plane.
+
+One coordinator process (the trainer) drives N worker processes. Each
+full-batch pass is: partition the live blocks across live hosts
+(:class:`~photon_ml_tpu.parallel.cluster.assigner.BlockAssigner`), send
+each host its ``pass`` message with the current weights, sum the partial
+``(f, g)`` replies — the allreduce — and hand the sum back to the solver,
+which finalizes regularization on the coordinator exactly as the
+single-host path does. The reply sum is mathematically the same full-batch
+value/gradient as one host streaming every block; only floating-point
+summation order differs, so parity with single-host is gated on held-out
+AUC (≤ 1e-3), not bitwise trajectories.
+
+Failure protocol (rides PR 14's resilience plane):
+
+* a worker that DIES closes its socket — the reader thread sees EOF and
+  enqueues a death sentinel;
+* a worker that WEDGES stops heartbeating — the pass loop notices
+  ``last_seen`` exceeding the heartbeat timeout;
+* either way the coordinator calls ``assigner.mark_host_failed``, records
+  ``record_failure("cluster_host_lost", ...)`` into the failure ring (and
+  through the attached sink into the progress ledger), and re-sends the
+  dead host's unfinished blocks to the survivors as a fresh fragment of
+  the SAME pass — the pass completes, the epoch barrier holds, nothing
+  aborts. Only when zero hosts survive does the pass raise
+  :class:`ClusterError`.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...resilience.failures import record_failure
+from ...telemetry.metrics import get_registry
+from .assigner import BlockAssigner
+from .protocol import MessageSocket, recv_msg, send_msg
+
+HEARTBEAT_TIMEOUT_ENV = "PHOTON_CLUSTER_HEARTBEAT_TIMEOUT_S"
+_DEFAULT_HEARTBEAT_TIMEOUT_S = 30.0
+
+
+class ClusterError(RuntimeError):
+    """The cluster cannot make progress (no live hosts, bad handshake)."""
+
+
+class _WorkerHandle:
+    def __init__(self, host: int, msock: MessageSocket):
+        self.host = host
+        self.msock = msock
+        self.alive = True
+        self.last_seen = time.monotonic()
+
+
+class ClusterCoordinator:
+    """Accepts worker connections, drives distributed passes, survives
+    worker death mid-pass."""
+
+    def __init__(
+        self,
+        num_hosts: int,
+        num_blocks: int,
+        decay: float = 0.6,
+        heartbeat_timeout_s: Optional[float] = None,
+        bind_host: str = "127.0.0.1",
+    ):
+        if num_hosts < 1:
+            raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+        self.num_hosts = int(num_hosts)
+        self.num_blocks = int(num_blocks)
+        self.assigner = BlockAssigner(
+            num_blocks, hosts=range(self.num_hosts), decay=decay
+        )
+        if heartbeat_timeout_s is None:
+            heartbeat_timeout_s = float(
+                os.environ.get(
+                    HEARTBEAT_TIMEOUT_ENV, _DEFAULT_HEARTBEAT_TIMEOUT_S
+                )
+            )
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        # Bind in __init__ so the port is known before workers spawn.
+        self._server = socket.create_server((bind_host, 0))
+        self.address: Tuple[str, int] = self._server.getsockname()[:2]
+        self.workers: Dict[int, _WorkerHandle] = {}
+        self._inbox: "queue.Queue[Tuple[int, Optional[dict]]]" = queue.Queue()
+        self._reader_threads: List[threading.Thread] = []
+        self._pass_id = 0
+        self._next_frag = 0
+        self._events: List[dict] = []
+        self._closed = False
+
+    # -- membership --------------------------------------------------------
+
+    def wait_for_workers(self, timeout_s: float = 300.0) -> None:
+        """Accept ``num_hosts`` hellos; reject config-skewed workers whose
+        locally planned block count disagrees with ours."""
+        deadline = time.monotonic() + timeout_s
+        self._server.settimeout(5.0)
+        while len(self.workers) < self.num_hosts:
+            if time.monotonic() > deadline:
+                raise ClusterError(
+                    f"only {len(self.workers)}/{self.num_hosts} workers "
+                    f"connected within {timeout_s:.0f}s"
+                )
+            try:
+                sock, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hello = recv_msg(sock)
+            if hello.get("type") != "hello":
+                sock.close()
+                raise ClusterError(f"expected hello, got {hello!r}")
+            host = int(hello["host"])
+            if hello.get("num_blocks") != self.num_blocks:
+                send_msg(sock, {"type": "stop"})
+                sock.close()
+                raise ClusterError(
+                    f"host {host} planned {hello.get('num_blocks')} blocks, "
+                    f"coordinator planned {self.num_blocks}: the workers "
+                    "must see the same files and --block-rows"
+                )
+            if host in self.workers:
+                sock.close()
+                raise ClusterError(f"duplicate hello from host {host}")
+            handle = _WorkerHandle(host, MessageSocket(sock))
+            self.workers[host] = handle
+            t = threading.Thread(
+                target=self._reader, args=(handle,), daemon=True,
+                name=f"cluster-reader-{host}",
+            )
+            t.start()
+            self._reader_threads.append(t)
+
+    def _reader(self, handle: _WorkerHandle) -> None:
+        try:
+            while True:
+                msg = handle.msock.recv()
+                handle.last_seen = time.monotonic()
+                if msg.get("type") == "heartbeat":
+                    continue
+                self._inbox.put((handle.host, msg))
+        except (EOFError, OSError):
+            self._inbox.put((handle.host, None))
+
+    # -- failure -----------------------------------------------------------
+
+    def _lose_host(self, host: int, why: str) -> None:
+        handle = self.workers.get(host)
+        if handle is None or not handle.alive:
+            return
+        handle.alive = False
+        handle.msock.close()
+        self.assigner.mark_host_failed(host)
+        record_failure(
+            "cluster_host_lost",
+            site=f"cluster.host{host}",
+            detail=why,
+            host=host,
+        )
+        get_registry().count("cluster.host_failures")
+        self._events.append({"event": "host_lost", "host": host, "why": why})
+
+    def _live(self) -> List[_WorkerHandle]:
+        return [h for h in self.workers.values() if h.alive]
+
+    def _check_heartbeats(self) -> List[int]:
+        now = time.monotonic()
+        stale = [
+            h.host
+            for h in self._live()
+            if now - h.last_seen > self.heartbeat_timeout_s
+        ]
+        for host in stale:
+            self._lose_host(host, "heartbeat timeout")
+        return stale
+
+    # -- control plane -----------------------------------------------------
+
+    def _send(self, handle: _WorkerHandle, msg: dict) -> bool:
+        try:
+            handle.msock.send(msg)
+            return True
+        except OSError:
+            self._lose_host(handle.host, "send failed")
+            return False
+
+    def set_residual(self, residual: Optional[np.ndarray]) -> None:
+        """Broadcast the CD residual plane for the next solve (once per
+        outer iteration, not per pass)."""
+        payload = None if residual is None else np.asarray(residual)
+        for handle in list(self._live()):
+            self._send(handle, {"type": "residual", "residual": payload})
+
+    # -- the distributed pass ----------------------------------------------
+
+    def distributed_pass(
+        self, w: np.ndarray
+    ) -> Tuple[float, np.ndarray, Dict[int, float], List[dict]]:
+        """One full-batch pass over every live block, data-parallel.
+
+        Returns ``(f_sum, g_sum, gaps, block_stats)`` — the UNregularized
+        sums; the solver's ``finalize`` adds the L2 term on the
+        coordinator, exactly as the single-host path does.
+        """
+        self._pass_id += 1
+        pass_id = self._pass_id
+        if not self._live():
+            raise ClusterError("no live hosts")
+        assignment = self.assigner.assign()
+        w = np.asarray(w)
+        self._next_frag = 0
+        # pending: (host, frag) -> blocks in flight
+        pending: Dict[Tuple[int, int], List[int]] = {}
+        dropped: List[int] = []
+        for host, blocks in assignment.items():
+            if not blocks:
+                continue
+            handle = self.workers[host]
+            frag = self._next_frag
+            if self._send(
+                handle,
+                {
+                    "type": "pass",
+                    "pass_id": pass_id,
+                    "frag": frag,
+                    "w": w,
+                    "blocks": blocks,
+                },
+            ):
+                pending[(host, frag)] = blocks
+                self._next_frag += 1
+            else:
+                # died on send; requeue once the healthy sends are out
+                dropped.extend(blocks)
+        if dropped:
+            self._requeue(pass_id, dropped, pending, w)
+        f_sum = 0.0
+        g_sum = np.zeros_like(w, dtype=np.float64)
+        gaps: Dict[int, float] = {}
+        block_stats: List[dict] = []
+        while pending:
+            try:
+                host, msg = self._inbox.get(timeout=1.0)
+            except queue.Empty:
+                for dead in self._check_heartbeats():
+                    self._recover(dead, pass_id, pending, w)
+                continue
+            if msg is None:
+                self._lose_host(host, "connection closed")
+                self._recover(host, pass_id, pending, w)
+                continue
+            if msg.get("type") != "partial" or msg.get("pass_id") != pass_id:
+                continue  # stray reply from an abandoned fragment
+            key = (host, msg["frag"])
+            if key not in pending:
+                continue
+            del pending[key]
+            f_sum += float(msg["f"])
+            g_sum += np.asarray(msg["g"], dtype=np.float64)
+            for st in msg.get("block_stats", ()):
+                gaps[int(st["block"])] = float(st.get("gap", 0.0))
+                block_stats.append(dict(st, host=host))
+        self.assigner.update(gaps)
+        return f_sum, g_sum, gaps, block_stats
+
+    def _recover(
+        self,
+        dead_host: int,
+        pass_id: int,
+        pending: Dict[Tuple[int, int], List[int]],
+        w: np.ndarray,
+    ) -> None:
+        """Re-send a dead host's unfinished blocks to the survivors as new
+        fragments of the same pass."""
+        lost: List[int] = []
+        for key in [k for k in pending if k[0] == dead_host]:
+            lost.extend(pending.pop(key))
+        if not lost:
+            return
+        if not self._live():
+            raise ClusterError(
+                f"host {dead_host} died and no hosts survive to take over "
+                f"blocks {lost}"
+            )
+        self._requeue(pass_id, lost, pending, w)
+
+    def _requeue(
+        self,
+        pass_id: int,
+        blocks: List[int],
+        pending: Dict[Tuple[int, int], List[int]],
+        w: np.ndarray,
+    ) -> None:
+        if not self._live():
+            raise ClusterError("no live hosts to requeue blocks on")
+        targets = self.assigner.reassign(blocks)
+        get_registry().count("cluster.blocks_reassigned", len(blocks))
+        self._events.append(
+            {
+                "event": "blocks_reassigned",
+                "blocks": sorted(blocks),
+                "targets": {str(h): b for h, b in targets.items()},
+            }
+        )
+        for host, blks in targets.items():
+            handle = self.workers[host]
+            frag = self._next_frag
+            if self._send(
+                handle,
+                {
+                    "type": "pass",
+                    "pass_id": pass_id,
+                    "frag": frag,
+                    "w": np.asarray(w),
+                    "blocks": blks,
+                },
+            ):
+                pending[(host, frag)] = blks
+                self._next_frag += 1
+            else:
+                # that survivor died too; recurse onto whoever is left
+                self._requeue(pass_id, blks, pending, w)
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def drain_events(self) -> List[dict]:
+        out = self._events + self.assigner.drain_decisions()
+        self._events = []
+        return out
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for handle in self.workers.values():
+            if handle.alive:
+                try:
+                    handle.msock.send({"type": "stop"})
+                except OSError:
+                    pass
+                handle.msock.close()
+        try:
+            self._server.close()
+        except OSError:
+            pass

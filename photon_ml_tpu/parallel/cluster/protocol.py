@@ -1,0 +1,106 @@
+"""Length-prefixed message framing for the cluster plane.
+
+Why not ``jax.distributed`` collectives: a psum wedges forever when one
+participant dies, and the cluster plane's whole point is to SURVIVE a
+killed host mid-epoch (the reference inherited this from Spark — a lost
+executor's partitions are recomputed, the treeAggregate just re-runs).
+So the allreduce/control plane is a small coordinator/worker TCP protocol
+carrying numpy payloads: the same driver-aggregate-broadcast shape as the
+reference's ``treeAggregate`` + broadcast, with sockets as the failure
+detector (a killed process closes its socket; a wedged one stops
+heartbeating).
+
+Framing is an 8-byte big-endian length prefix followed by a pickled
+payload. Pickle is acceptable here because both ends are processes WE
+spawned on a trusted interconnect (localhost for the emulated mesh, the
+pod's DCN for a real one) — never expose these sockets to untrusted
+peers.
+
+Message vocabulary (dicts keyed by ``"type"``):
+
+* ``hello``      worker -> coordinator: ``host`` id, ``num_blocks`` of its
+                 locally planned stream (coordinator verifies the plans
+                 agree — a config-skewed worker is rejected at the door).
+* ``residual``   coordinator -> workers: the CD residual plane for the
+                 next solve (per outer iteration, not per pass).
+* ``pass``       coordinator -> worker: ``pass_id``, ``frag``, ``w``, and
+                 the ``blocks`` this host streams for this pass.
+* ``partial``    worker -> coordinator: echo of ``pass_id``/``frag`` plus
+                 the host's partial ``f``/``g`` sums and per-block stats.
+* ``heartbeat``  worker -> coordinator: liveness, sent from a dedicated
+                 thread so a long jit compile never reads as death.
+* ``stop``       coordinator -> workers: drain and exit 0.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Optional, Tuple
+
+_HEADER = struct.Struct("!Q")
+# Guard against a corrupt/hostile length prefix allocating the world.
+MAX_MESSAGE_BYTES = 1 << 33
+
+
+class ProtocolError(RuntimeError):
+    """A malformed frame (bad length, truncated payload)."""
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        read = sock.recv_into(view[got:], n - got)
+        if read == 0:
+            raise EOFError("peer closed the connection")
+        got += read
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if length > MAX_MESSAGE_BYTES:
+        raise ProtocolError(f"frame of {length} bytes exceeds cap")
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class MessageSocket:
+    """A framed socket with a send lock, so the heartbeat thread and the
+    main loop can interleave sends without tearing frames."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+
+    def send(self, obj: Any) -> None:
+        with self._send_lock:
+            send_msg(self.sock, obj)
+
+    def recv(self) -> Any:
+        return recv_msg(self.sock)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def connect(address: Tuple[str, int], timeout: Optional[float] = None) -> MessageSocket:
+    sock = socket.create_connection(address, timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return MessageSocket(sock)

@@ -42,6 +42,7 @@ from photon_ml_tpu.streaming.gapsched import GapScheduler
 from photon_ml_tpu.streaming.prefetch import BlockPrefetcher, PrefetchStats
 from photon_ml_tpu.streaming.solver import (
     BlockStatsProbe,
+    StreamPrograms,
     StreamSolveInfo,
     _note_trace,
     solve_streaming,
@@ -153,6 +154,17 @@ class StreamingFixedEffectCoordinate(Coordinate):
     last_skipped_blocks: Optional[list] = dataclasses.field(
         default=None, repr=False
     )
+    # cluster plane: when set (a ClusterPlane or ClusterCoordinator,
+    # parallel/cluster), full-batch solves delegate every streamed pass to
+    # the distributed allreduce — this host streams nothing itself; the
+    # workers stream their assigned block shares and the solver consumes
+    # the summed (f, g) through the pass_fn seam. Full-batch only: the
+    # stochastic trajectory is order-dependent, so there is no cross-host
+    # decomposition that preserves it.
+    cluster: Optional[object] = dataclasses.field(default=None, repr=False)
+    last_cluster_events: Optional[list] = dataclasses.field(
+        default=None, repr=False
+    )
     _gap_scheduler: Optional[GapScheduler] = dataclasses.field(
         default=None, repr=False
     )
@@ -177,6 +189,17 @@ class StreamingFixedEffectCoordinate(Coordinate):
                 "gap_schedule requires stochastic streaming mode (full-batch"
                 " mode must visit every block per pass to stay exact)"
             )
+        if self.cluster is not None:
+            if self.mode != "full":
+                raise ValueError(
+                    "cluster training requires full-batch streaming mode: "
+                    "the distributed pass sums exact per-host partials"
+                )
+            if self.cluster.num_blocks != self.source.plan.num_blocks:
+                raise ValueError(
+                    f"cluster planned {self.cluster.num_blocks} blocks but "
+                    f"this source streams {self.source.plan.num_blocks}"
+                )
 
     # -- shapes -----------------------------------------------------------
 
@@ -233,7 +256,11 @@ class StreamingFixedEffectCoordinate(Coordinate):
         info = StreamSolveInfo()
         probe = (
             BlockStatsProbe()
-            if self.collect_block_stats and self.mode == "full"
+            if (
+                self.collect_block_stats
+                and self.mode == "full"
+                and self.cluster is None  # workers report stats instead
+            )
             else None
         )
         with span(
@@ -243,7 +270,9 @@ class StreamingFixedEffectCoordinate(Coordinate):
             streaming=self.mode,
             blocks=plan.num_blocks,
         ):
-            if self.mode == "full":
+            if self.cluster is not None:
+                result = self._solve_cluster(w0, residual_scores, info)
+            elif self.mode == "full":
                 result = solve_streaming(
                     self.objective(),
                     w0,
@@ -305,6 +334,61 @@ class StreamingFixedEffectCoordinate(Coordinate):
         return GeneralizedLinearModel(
             coefficients=Coefficients(means=result.w), task=self.task
         )
+
+    def _solve_cluster(self, w0, residual_scores, info):
+        """Full-batch solve with every streamed pass delegated to the
+        cluster's distributed allreduce (parallel/cluster).
+
+        The workers return UNregularized partial (f, g) sums; finalize runs
+        here, on the coordinator, exactly as the single-host ``_full_pass``
+        does — so the L-BFGS trajectory matches single-host up to
+        floating-point reassociation of the per-host sums (parity is gated
+        on held-out AUC, not bitwise). Per-pass worker block stats land in
+        ``last_block_stats`` and reassignment/rebalance events in
+        ``last_cluster_events`` for the progress ledger.
+        """
+        programs = StreamPrograms.for_objective(self.objective())
+        self.cluster.set_residual(
+            None if residual_scores is None else np.asarray(residual_scores)
+        )
+        last_stats: list = []
+
+        def pass_fn(w_at, l2):
+            f_sum, g_sum, _, block_stats = self.cluster.distributed_pass(
+                np.asarray(w_at)
+            )
+            info.blocks += len(block_stats)
+            last_stats[:] = block_stats
+            return programs.finalize(
+                jnp.asarray(f_sum, dtype=w_at.dtype),
+                jnp.asarray(g_sum, dtype=w_at.dtype),
+                w_at,
+                l2,
+            )
+
+        result = solve_streaming(
+            self.objective(),
+            w0,
+            make_blocks=None,
+            configuration=self.configuration,
+            info=info,
+            pass_fn=pass_fn,
+        )
+        if last_stats:
+            self.last_block_stats = [
+                {
+                    "block": st["block"],
+                    "partial_loss": st["partial_loss"],
+                    "partial_grad_norm": st["partial_grad_norm"],
+                    "gap_estimate": st["gap"],
+                    "host": st.get("host", -1),
+                }
+                for st in sorted(last_stats, key=lambda s: s["block"])
+            ]
+        events = self.cluster.drain_events()
+        if events:
+            self.last_cluster_events = events
+        return result
 
     def update_model(
         self, model: Optional[GeneralizedLinearModel], residual_scores: np.ndarray

@@ -249,11 +249,12 @@ def _full_pass(
 def solve_streaming(
     objective: GlmObjective,
     w0,
-    make_blocks: BlockFn,
+    make_blocks: Optional[BlockFn],
     configuration: GlmOptimizationConfiguration,
     l2_weight: Optional[float] = None,
     info: Optional[StreamSolveInfo] = None,
     probe: Optional[BlockStatsProbe] = None,
+    pass_fn: Optional[Callable] = None,
 ) -> SolveResult:
     """Exact full-batch L-BFGS with the dataset streamed per pass.
 
@@ -262,7 +263,18 @@ def solve_streaming(
     all blocks visited per pass the trajectory optimizes the identical
     full-batch objective as the in-memory solver and converges to the same
     optimum within solver tolerance.
+
+    ``pass_fn`` replaces the local streamed accumulation with an external
+    one — the cluster plane's distributed allreduce pass
+    (``parallel/cluster``): called as ``pass_fn(w, l2)`` and expected to
+    return the same ``(f_reg, g_reg, ||g_reg||)`` triple as
+    ``StreamPrograms.finalize``, i.e. the EXACT full-batch regularized
+    value and gradient at ``w``. The L-BFGS trajectory above the pass is
+    then identical to single-host up to floating-point reassociation of
+    the per-host partial sums.
     """
+    if make_blocks is None and pass_fn is None:
+        raise ValueError("solve_streaming needs make_blocks or pass_fn")
     cfg = configuration.optimizer_config
     if cfg.optimizer is OptimizerType.TRON:
         raise ValueError(
@@ -283,7 +295,13 @@ def solve_streaming(
     )
     programs = StreamPrograms.for_objective(objective)
 
-    f, g, g_norm = _full_pass(programs, w, make_blocks, dim, l2, info, probe)
+    def _pass(w_at):
+        if pass_fn is not None:
+            info.passes += 1
+            return pass_fn(w_at, l2)
+        return _full_pass(programs, w_at, make_blocks, dim, l2, info, probe)
+
+    f, g, g_norm = _pass(w)
     abs_f_tol, abs_g_tol = absolute_tolerances(f, g_norm, cfg.tolerance)
     abs_f_tol = float(abs_f_tol)
     abs_g_tol = float(abs_g_tol)
@@ -312,9 +330,7 @@ def solve_streaming(
         for _ in range(max(1, cfg.max_line_search_iterations)):
             info.line_search_trials += 1
             w_try = programs.step(w, d, jnp.asarray(t, dtype=w.dtype))
-            f_try, g_try, g_try_norm = _full_pass(
-                programs, w_try, make_blocks, dim, l2, info, probe
-            )
+            f_try, g_try, g_try_norm = _pass(w_try)
             if float(f_try) <= f_host + 1e-4 * t * dphi0_f:
                 accepted = (w_try, f_try, g_try, g_try_norm)
                 break

@@ -252,6 +252,31 @@ class ConvergenceTracker:
                     "progress.schedule_records", len(decisions)
                 )
 
+    def record_cluster(
+        self, outer: int, coordinate: str, events: List[Dict[str, Any]]
+    ) -> None:
+        """Cluster-plane events of a distributed streamed solve
+        (``ClusterCoordinator.drain_events()``): per-pass block rebalances,
+        host losses, and reassignments. Host losses are degraded-but-
+        recovered signals — they land in the ledger and counters but do
+        not flip health (the job survived by design)."""
+        with self._lock:
+            if self._closed:
+                return
+            for ev in events:
+                rec: Dict[str, Any] = {
+                    "kind": "cluster",
+                    "outer": int(outer),
+                    "coordinate": str(coordinate),
+                    "event": str(ev.get("event", "unknown")),
+                }
+                for key, val in ev.items():
+                    if key != "event":
+                        rec[key] = val
+                self._emit(rec)
+            if events:
+                self.registry.count("progress.cluster_records", len(events))
+
     def record_resilience(
         self,
         failure_kind: str,

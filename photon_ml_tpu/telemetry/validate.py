@@ -51,6 +51,9 @@ _PROGRESS_SCHEMAS: Dict[str, tuple] = {
     # failure plane (resilience/): recovered or degraded events — retry
     # exhaustion, skipped blocks, supervised-thread crashes
     "resilience": ("failure_kind", "site"),
+    # cluster plane (parallel/cluster): block rebalance / host-loss /
+    # reassignment events of a distributed solve
+    "cluster": ("outer", "coordinate", "event"),
 }
 
 

@@ -1,0 +1,471 @@
+"""Cluster plane (photon_ml_tpu.parallel.cluster).
+
+The reference Photon-ML runs fixed-effect optimization data-parallel over
+Spark executors; this plane is that topology on the streaming runtime: a
+coordinator partitions the streamed blocks across hosts per pass
+(gap-balanced LPT over PR 13's ledger scores), every host accumulates its
+partial ``(f, g)`` over its slice, and the coordinator's float64 sum +
+single ``finalize`` IS the allreduce — so the distributed trajectory
+matches single-host up to fp reassociation. These tests pin:
+
+- the assigner's partition algebra (bootstrap round-robin, gap-weighted
+  balance, failure exclusion, decision dedupe);
+- the wire protocol's framing (roundtrip, EOF-as-death);
+- end-to-end parity: a 2-host thread-hosted cluster fit lands within fp
+  noise of the same in-process single-host fit;
+- the host-failure drill: a chaos-killed worker's blocks are reassigned
+  mid-pass and the fit still completes (events + counters recorded).
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.parallel.cluster import (
+    BlockAssigner,
+    ClusterCoordinator,
+    ClusterWorker,
+    MessageSocket,
+    serve_worker_in_thread,
+)
+from photon_ml_tpu.resilience import clear_failures, reset_faults
+from photon_ml_tpu.telemetry.metrics import get_registry
+from photon_ml_tpu.types import TaskType
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    reset_faults()
+    clear_failures()
+    yield
+    reset_faults()
+    clear_failures()
+
+
+# ================================================================= assigner
+
+
+class TestBlockAssigner:
+    def test_uniform_bootstrap_is_round_robin_balanced(self):
+        a = BlockAssigner(16, hosts=range(4))
+        got = a.assign()
+        assert sorted(got) == [0, 1, 2, 3]
+        assert all(len(b) == 4 for b in got.values())
+        covered = sorted(b for blks in got.values() for b in blks)
+        assert covered == list(range(16))
+        # deterministic: same ledger, same partition
+        assert BlockAssigner(16, hosts=range(4)).assign() == got
+
+    def test_blocks_stream_in_index_order_per_host(self):
+        a = BlockAssigner(12, hosts=(0, 1, 2))
+        for blks in a.assign().values():
+            assert blks == sorted(blks)
+
+    def test_gap_weighted_lpt_balances_score_mass(self):
+        a = BlockAssigner(8, hosts=(0, 1))
+        # one hot block: LPT must not stack more mass next to it
+        a.update({0: 100.0, **{b: 1.0 for b in range(1, 8)}})
+        got = a.assign()
+        eff = a.effective_scores()
+        shares = {h: eff[blks].sum() for h, blks in got.items()}
+        hot_host = next(h for h, blks in got.items() if 0 in blks)
+        other = 1 - hot_host
+        # the hot host gets the hot block and nothing else
+        assert got[hot_host] == [0]
+        assert len(got[other]) == 7
+        assert shares[hot_host] >= shares[other]
+
+    def test_unmeasured_blocks_decay_toward_zero_weight(self):
+        a = BlockAssigner(4, hosts=(0,), decay=0.5)
+        a.update({0: 8.0, 1: 8.0})  # blocks 2, 3 never measured
+        a.update({0: 8.0, 1: 8.0})
+        eff = a.effective_scores()
+        assert eff[0] == eff[1] == 8.0
+        assert eff[2] == eff[3] == pytest.approx(0.25)  # 1.0 * 0.5**2
+
+    def test_rebalance_decision_only_on_partition_change(self):
+        a = BlockAssigner(8, hosts=(0, 1))
+        a.assign()
+        a.assign()  # identical ledger -> identical partition -> no event
+        events = [d["event"] for d in a.drain_decisions()]
+        assert events == ["rebalance"]
+        a.update({b: float(b + 1) for b in range(8)})
+        a.assign()
+        assert [d["event"] for d in a.drain_decisions()] == ["rebalance"]
+
+    def test_mark_host_failed_removes_from_rotation(self):
+        a = BlockAssigner(9, hosts=(0, 1, 2))
+        a.assign()
+        a.mark_host_failed(1)
+        got = a.assign()
+        assert sorted(got) == [0, 2]
+        covered = sorted(b for blks in got.values() for b in blks)
+        assert covered == list(range(9))
+        events = [d["event"] for d in a.drain_decisions()]
+        assert events == ["rebalance", "host_failed", "rebalance"]
+
+    def test_reassign_splits_over_survivors_and_records(self):
+        a = BlockAssigner(8, hosts=(0, 1, 2))
+        a.mark_host_failed(0)
+        targets = a.reassign([1, 5, 7])
+        assert set(targets) <= {1, 2}
+        assert sorted(b for blks in targets.values() for b in blks) == [
+            1, 5, 7,
+        ]
+        reassigns = [
+            d for d in a.drain_decisions() if d["event"] == "reassign"
+        ]
+        assert len(reassigns) == 1 and reassigns[0]["blocks"] == [1, 5, 7]
+
+    def test_excluded_blocks_leave_the_rotation(self):
+        a = BlockAssigner(6, hosts=(0, 1))
+        a.mark_blocks_failed([2, 4])
+        covered = sorted(b for blks in a.assign().values() for b in blks)
+        assert covered == [0, 1, 3, 5]
+
+    def test_no_live_hosts_raises(self):
+        a = BlockAssigner(4, hosts=(0,))
+        a.mark_host_failed(0)
+        with pytest.raises(RuntimeError, match="no live hosts"):
+            a.assign()
+        with pytest.raises(RuntimeError, match="every host failed"):
+            a.reassign([0, 1])
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="num_blocks"):
+            BlockAssigner(0, hosts=(0,))
+        with pytest.raises(ValueError, match="host"):
+            BlockAssigner(4, hosts=())
+        with pytest.raises(ValueError, match="decay"):
+            BlockAssigner(4, hosts=(0,), decay=0.0)
+
+
+# ================================================================= protocol
+
+
+class TestProtocol:
+    def _pair(self):
+        import socket
+
+        a, b = socket.socketpair()
+        return MessageSocket(a), MessageSocket(b)
+
+    def test_roundtrip_preserves_arrays(self):
+        tx, rx = self._pair()
+        try:
+            g = np.arange(1000, dtype=np.float64)
+            tx.send({"type": "partial", "f": 1.5, "g": g})
+            got = rx.recv()
+            assert got["type"] == "partial" and got["f"] == 1.5
+            np.testing.assert_array_equal(got["g"], g)
+        finally:
+            tx.close()
+            rx.close()
+
+    def test_peer_close_is_eof_not_garbage(self):
+        tx, rx = self._pair()
+        tx.send({"type": "hello"})
+        tx.close()
+        assert rx.recv() == {"type": "hello"}
+        with pytest.raises(EOFError):
+            rx.recv()
+        rx.close()
+
+    def test_interleaved_sends_frame_cleanly(self):
+        # heartbeats race data sends on the same socket; the send lock
+        # must keep frames atomic
+        import threading
+
+        tx, rx = self._pair()
+        try:
+            msgs = [{"type": "heartbeat", "host": i} for i in range(50)]
+            threads = [
+                threading.Thread(target=tx.send, args=(m,)) for m in msgs
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            got = [rx.recv() for _ in range(50)]
+            assert sorted(m["host"] for m in got) == list(range(50))
+        finally:
+            tx.close()
+            rx.close()
+
+
+# ============================================================== end to end
+
+FILE_ROWS = (110, 90)
+N_ROWS = sum(FILE_ROWS)
+D = 8
+BLOCK_ROWS = 64  # 200 rows -> 4 blocks, final one ragged
+
+SHARDS = None  # populated by the fixture import below
+
+
+@pytest.fixture(scope="module")
+def cluster_dataset(tmp_path_factory):
+    from photon_ml_tpu.io.data_reader import (
+        FeatureShardConfiguration,
+        build_index_maps,
+        write_training_examples,
+    )
+
+    shards = {
+        "global": FeatureShardConfiguration(
+            feature_bags=("features",), add_intercept=True
+        ),
+    }
+    rng = np.random.default_rng(71)
+    root = tmp_path_factory.mktemp("cluster_stream")
+    X = rng.normal(size=(N_ROWS, D)).astype(np.float32)
+    w = rng.normal(size=D).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-(X @ w))) > rng.random(N_ROWS)).astype(
+        np.float32
+    )
+    paths, row = [], 0
+    for fi, n in enumerate(FILE_ROWS):
+        recs = [
+            {
+                "uid": f"r{i}",
+                "label": float(y[i]),
+                "weight": 1.0,
+                "features": [
+                    ("g", str(j), float(X[i, j])) for j in range(D)
+                ],
+            }
+            for i in range(row, row + n)
+        ]
+        p = str(root / f"part-{fi:05d}.avro")
+        write_training_examples(p, recs)
+        paths.append(p)
+        row += n
+    return {
+        "paths": paths,
+        "shards": shards,
+        "index_maps": build_index_maps(paths, shards),
+    }
+
+
+def _open_source(ds):
+    from photon_ml_tpu.streaming import StreamingSource
+
+    return StreamingSource.open(
+        ds["paths"], ds["shards"], index_maps=ds["index_maps"],
+        block_rows=BLOCK_ROWS,
+    )
+
+
+def _estimator():
+    from photon_ml_tpu.estimators.game import (
+        FixedEffectCoordinateConfiguration,
+        GameEstimator,
+    )
+    from photon_ml_tpu.opt import (
+        GlmOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.types import RegularizationType
+
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinates={
+            "fixed": FixedEffectCoordinateConfiguration(
+                "global",
+                GlmOptimizationConfiguration(
+                    regularization=RegularizationContext(
+                        RegularizationType.L2
+                    ),
+                    regularization_weight=0.5,
+                ),
+            )
+        },
+        num_outer_iterations=1,
+    )
+
+
+def _plane(ds, hosts=2, chaos_kill_after=None):
+    num_blocks = _open_source(ds).plan.num_blocks
+    coord = ClusterCoordinator(hosts, num_blocks, heartbeat_timeout_s=60.0)
+    for h in range(hosts):
+        serve_worker_in_thread(
+            ClusterWorker(
+                host_id=h,
+                source=_open_source(ds),
+                shard_id="global",
+                task=TaskType.LOGISTIC_REGRESSION,
+                chaos_kill_after=(
+                    chaos_kill_after if h == hosts - 1 else None
+                ),
+            ),
+            coord.address,
+        )
+    coord.wait_for_workers(timeout_s=60.0)
+    return coord
+
+
+def _fe_weights(fit):
+    return np.asarray(fit.model.models["fixed"].coefficients.means)
+
+
+class TestClusterFitParity:
+    def test_two_host_fit_matches_single_host_within_fp_noise(
+        self, cluster_dataset
+    ):
+        from photon_ml_tpu.telemetry import ConvergenceTracker
+
+        solo = _estimator().fit_streaming(
+            _open_source(cluster_dataset), prefetch_depth=2
+        )
+        tracker = ConvergenceTracker(abort_on_divergence=False)
+        plane = _plane(cluster_dataset, hosts=2)
+        try:
+            clustered = _estimator().fit_streaming(
+                _open_source(cluster_dataset),
+                prefetch_depth=2,
+                cluster=plane,
+                progress=tracker,
+            )
+        finally:
+            plane.shutdown()
+        tracker.finish()
+        w_solo, w_cluster = _fe_weights(solo), _fe_weights(clustered)
+        # same trajectory up to fp reassociation of the partial sums —
+        # parity is allclose, not bitwise (docs/SCALING.md)
+        np.testing.assert_allclose(w_cluster, w_solo, atol=2e-3)
+        cluster_recs = [
+            r for r in tracker.records if r.get("kind") == "cluster"
+        ]
+        assert any(r["event"] == "rebalance" for r in cluster_recs)
+        # the workers' probe stats reach the same ledger seam the
+        # single-host probe feeds
+        block_recs = [
+            r for r in tracker.records if r.get("kind") == "block"
+        ]
+        assert {r["block"] for r in block_recs} == set(range(4))
+        assert all("gap_estimate" in r for r in block_recs)
+
+    def test_workers_report_host_attributed_block_stats(
+        self, cluster_dataset
+    ):
+        dim = _open_source(cluster_dataset).plan.shard_dims["global"]
+        plane = _plane(cluster_dataset, hosts=2)
+        try:
+            _, _, gaps, stats = plane.distributed_pass(
+                np.zeros(dim, dtype=np.float32)
+            )
+        finally:
+            plane.shutdown()
+        assert {s["block"] for s in stats} == set(range(4))
+        assert {s["host"] for s in stats} == {0, 1}
+        assert sorted(gaps) == list(range(4))
+        assert all(
+            {"partial_loss", "partial_grad_norm", "gap"} <= set(s)
+            for s in stats
+        )
+
+    def test_cluster_requires_full_batch_mode(self, cluster_dataset):
+        plane = _plane(cluster_dataset, hosts=2)
+        try:
+            with pytest.raises(ValueError, match="full"):
+                _estimator().fit_streaming(
+                    _open_source(cluster_dataset),
+                    mode="stochastic",
+                    cluster=plane,
+                )
+        finally:
+            plane.shutdown()
+
+    def test_cluster_rejects_block_plan_skew(self, cluster_dataset):
+        plane = _plane(cluster_dataset, hosts=2)
+        try:
+            from photon_ml_tpu.streaming import StreamingSource
+
+            skewed = StreamingSource.open(
+                cluster_dataset["paths"], cluster_dataset["shards"],
+                index_maps=cluster_dataset["index_maps"],
+                block_rows=BLOCK_ROWS // 2,  # different plan
+            )
+            with pytest.raises(ValueError, match="blocks"):
+                _estimator().fit_streaming(skewed, cluster=plane)
+        finally:
+            plane.shutdown()
+
+
+class TestKilledHostRecovery:
+    def test_fit_survives_chaos_killed_host(self, cluster_dataset):
+        reg = get_registry()
+        hf0 = reg.counter_value("cluster.host_failures")
+        br0 = reg.counter_value("cluster.blocks_reassigned")
+
+        solo = _estimator().fit_streaming(
+            _open_source(cluster_dataset), prefetch_depth=2
+        )
+        # host 1 dies after 3 blocks: mid-pass-2 with 2 blocks/host/pass
+        plane = _plane(cluster_dataset, hosts=2, chaos_kill_after=3)
+        try:
+            fit = _estimator().fit_streaming(
+                _open_source(cluster_dataset),
+                prefetch_depth=2,
+                cluster=plane,
+            )
+            # post-failure passes partition over the survivor only
+            dim = _open_source(cluster_dataset).plan.shard_dims["global"]
+            _, _, _, stats = plane.distributed_pass(
+                np.zeros(dim, dtype=np.float32)
+            )
+        finally:
+            plane.shutdown()
+
+        # completed, and on the surviving host's math the answer is the
+        # same fit
+        np.testing.assert_allclose(
+            _fe_weights(fit), _fe_weights(solo), atol=2e-3
+        )
+        assert {s["host"] for s in stats} == {0}
+        assert reg.counter_value("cluster.host_failures") == hf0 + 1
+        assert reg.counter_value("cluster.blocks_reassigned") > br0
+
+    def test_cluster_events_land_in_progress_ledger(self, cluster_dataset):
+        from photon_ml_tpu.telemetry import ConvergenceTracker
+
+        tracker = ConvergenceTracker(abort_on_divergence=False)
+        plane = _plane(cluster_dataset, hosts=2, chaos_kill_after=3)
+        try:
+            _estimator().fit_streaming(
+                _open_source(cluster_dataset),
+                prefetch_depth=2,
+                cluster=plane,
+                progress=tracker,
+            )
+        finally:
+            plane.shutdown()
+        tracker.finish()
+        recs = [r for r in tracker.records if r.get("kind") == "cluster"]
+        assert recs, "cluster events must reach the progress ledger"
+        kinds = {r["event"] for r in recs}
+        assert "host_lost" in kinds and "blocks_reassigned" in kinds
+        assert all(r["coordinate"] == "fixed" for r in recs)
+
+
+class TestCoordinatorHandshake:
+    def test_block_plan_skew_rejected_at_hello(self, cluster_dataset):
+        import threading
+
+        num_blocks = _open_source(cluster_dataset).plan.num_blocks
+        coord = ClusterCoordinator(1, num_blocks + 1)
+        worker = ClusterWorker(
+            host_id=0,
+            source=_open_source(cluster_dataset),
+            shard_id="global",
+            task=TaskType.LOGISTIC_REGRESSION,
+        )
+        t = threading.Thread(
+            target=lambda: serve_worker_in_thread(worker, coord.address),
+            daemon=True,
+        )
+        t.start()
+        from photon_ml_tpu.parallel.cluster import ClusterError
+
+        with pytest.raises(ClusterError):
+            coord.wait_for_workers(timeout_s=5.0)
+        coord.shutdown()

@@ -52,6 +52,7 @@ from photon_ml_tpu.telemetry.metrics import get_registry
 # modules is what registers the sites; the coverage test below fails if a
 # new site appears without a chaos test arming it here.
 import photon_ml_tpu.checkpoint  # noqa: F401  train.checkpoint.publish
+import photon_ml_tpu.parallel.cluster.worker  # noqa: F401  cluster.worker_block
 import photon_ml_tpu.serving.admission  # noqa: F401  serve.admission.*
 import photon_ml_tpu.serving.hotswap  # noqa: F401  serve.delta.load
 import photon_ml_tpu.streaming.blockcache  # noqa: F401  stream.blockcache.*
@@ -66,6 +67,7 @@ COVERED_SITES = {
     "serve.admission.stage",
     "serve.delta.load",
     "train.checkpoint.publish",
+    "cluster.worker_block",
 }
 
 
@@ -540,6 +542,78 @@ class TestGapSchedulerExclusion:
             assert np.array_equal(oa, ob)
             a.update({int(x): 1.0 for x in oa})
             b.update({int(x): 1.0 for x in ob})
+
+
+class TestClusterWorkerChaos:
+    """Arming ``cluster.worker_block``: the injected fault kills a whole
+    WORKER (coarse failure semantics — see cluster/worker.py), and the
+    recovery is cluster-level: the coordinator reassigns the dead host's
+    blocks to the survivor and the pass still sums every block."""
+
+    def _plane(self, stream_dataset, hosts=2):
+        from photon_ml_tpu.parallel.cluster import (
+            ClusterCoordinator,
+            ClusterWorker,
+            serve_worker_in_thread,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        num_blocks = _open_source(stream_dataset).plan.num_blocks
+        coord = ClusterCoordinator(
+            hosts, num_blocks, heartbeat_timeout_s=60.0
+        )
+        for h in range(hosts):
+            serve_worker_in_thread(
+                ClusterWorker(
+                    host_id=h,
+                    source=_open_source(stream_dataset),
+                    shard_id="global",
+                    task=TaskType.LOGISTIC_REGRESSION,
+                ),
+                coord.address,
+            )
+        coord.wait_for_workers(timeout_s=60.0)
+        return coord
+
+    def test_armed_fault_kills_host_pass_completes_on_survivor(
+        self, stream_dataset
+    ):
+        dim = _open_source(stream_dataset).plan.shard_dims["global"]
+        w = np.zeros(dim, dtype=np.float32)
+
+        healthy = self._plane(stream_dataset)
+        try:
+            f_ref, g_ref, _, stats_ref = healthy.distributed_pass(w)
+        finally:
+            healthy.shutdown()
+
+        # the 3rd per-block fault_point call across the two thread-hosted
+        # workers trips fatally: one host dies mid-pass, the other survives
+        configure_faults("cluster.worker_block=once:3!fatal")
+        lost_before = _counter("resilience.failures.cluster_host_lost")
+        reassigned_before = _counter("cluster.blocks_reassigned")
+        chaos = self._plane(stream_dataset)
+        try:
+            f_got, g_got, _, stats_got = chaos.distributed_pass(w)
+            events = [e["event"] for e in chaos.drain_events()]
+        finally:
+            chaos.shutdown()
+
+        assert fault_stats()["cluster.worker_block"]["trips"] == 1
+        assert "cluster_host_lost" in _failure_kinds()
+        assert _counter("resilience.failures.cluster_host_lost") == (
+            lost_before + 1
+        )
+        assert _counter("cluster.blocks_reassigned") > reassigned_before
+        assert "host_lost" in events and "blocks_reassigned" in events
+        # every block still summed exactly once; only fp reassociation
+        # (different host partition) separates the totals
+        assert len(stats_got) == len(stats_ref)
+        assert {s["block"] for s in stats_got} == {
+            s["block"] for s in stats_ref
+        }
+        np.testing.assert_allclose(f_got, f_ref, rtol=1e-6)
+        np.testing.assert_allclose(g_got, g_ref, rtol=1e-5, atol=1e-6)
 
 
 class TestStreamingEstimatorChaos:

@@ -769,6 +769,67 @@ class TestServeGameCli:
         assert 'tenant="alpha"' in text
         assert 'tenant="beta"' in text
 
+    def test_variants_flag_serves_through_tenancy_plane(
+        self, ratings_model_dir, tmp_path
+    ):
+        """--variants: the replay runs through the full tenancy plane —
+        per-tenant quota admission, the seeded variant router, and one
+        batcher per variant over the shared sharded scorer — and the
+        snapshot carries the tenancy status block."""
+        from photon_ml_tpu.cli.serve_game import main as serve_main
+
+        metrics_file = str(tmp_path / "metrics.json")
+        rc = serve_main([
+            "--model-dir", ratings_model_dir,
+            "--data-dirs", os.path.join(RATINGS, "test"),
+            "--metrics-output", metrics_file,
+            "--max-requests", "128",
+            "--bucket-sizes", "4,16",
+            "--tenants", "alpha,beta",
+            "--slo-latency-ms", "1000",
+            "--variants", "candidate",
+            "--variant-ramp", "50",
+            "--tenant-rate", "1",
+            "--tenant-burst", "40",
+        ])
+        assert rc == 0
+        with open(metrics_file) as f:
+            snap = json.load(f)
+        assert snap["serving_mode"] == "sharded-tenancy"
+        ten = snap["tenancy"]
+        # both variants exist and both actually served traffic
+        assert set(ten["variants"]) == {"base", "candidate"}
+        assert ten["router"]["ramps"]["*"]["candidate"] == 50.0
+        assert ten["router"]["decisions"].get("candidate", 0) > 0
+        assert ten["router"]["decisions"].get("base", 0) > 0
+        # the candidate is undiverged: scores stay bitwise the base's
+        assert ten["variants"]["candidate"]["diverged"] is False
+        # quota: each tenant gets 64 of the 128; burst 40 sheds the rest,
+        # charged per tenant
+        quota = ten["quota"]["tenants"]
+        for tenant in ("alpha", "beta"):
+            assert quota[tenant]["admitted"] >= 40
+            assert quota[tenant]["shed"] > 0
+        # sheds never reach the scorer
+        assert snap["num_requests"] == sum(
+            quota[t]["admitted"] for t in ("alpha", "beta")
+        )
+        assert snap["num_results"] == snap["num_requests"]
+        # per-tenant SLO budgets rode along on the shared request plane
+        assert set(ten["tenants"]) == {"alpha", "beta"}
+
+    def test_variants_rejects_cached_mode(self, ratings_model_dir):
+        from photon_ml_tpu.cli.serve_game import main as serve_main
+
+        with pytest.raises(SystemExit, match="cache-capacity"):
+            serve_main([
+                "--model-dir", ratings_model_dir,
+                "--data-dirs", os.path.join(RATINGS, "test"),
+                "--max-requests", "8",
+                "--cache-capacity", "64",
+                "--variants", "candidate",
+            ])
+
     def test_export_only_invocation(self, ratings_model_dir, tmp_path):
         from photon_ml_tpu.cli.serve_game import main as serve_main
 

@@ -39,6 +39,7 @@ from photon_ml_tpu.serving import (
     TenancyPlane,
     TenantBudget,
     TenantQuota,
+    ValidationGate,
     VariantRegistry,
     VariantRouter,
     build_scenario,
@@ -158,6 +159,60 @@ class TestVariantRegistry:
         st = reg.state("v1")
         assert st.generation == 1 and st.rollbacks == 1
         assert _scores(scorer, reqs) == before  # base never moved
+
+    def test_gated_bad_delta_rejected_and_rolled_back(self):
+        """A registry built with a per-variant ValidationGate refuses a
+        delta that wrecks ranking: the swap report says rolled_back, the
+        variant's generation never advances, the base stays bitwise
+        untouched — and a benign delta still applies afterwards."""
+        art = _artifact()
+        reqs = _requests(64)
+        scorer = _scorer(art)
+        # labels = the base scorer's own top-half ranking, so baseline
+        # AUC is 1.0 by construction and the gate measures pure drift
+        base = scorer.score_batch(reqs, bucket_size=len(reqs))
+        scores = np.asarray([r.score for r in base], dtype=np.float32)
+        labels = (scores > np.median(scores)).astype(np.float32)
+        reg = VariantRegistry(
+            scorer,
+            gate=ValidationGate(
+                reqs, labels,
+                max_auc_regression=0.02,
+                bucket_size=len(reqs),
+            ),
+        )
+        reg.add_variant("candidate")
+        before = _scores(scorer, reqs)
+        # 12 entities is well inside the overlay-slot headroom (the
+        # shards hold 2x40 slots, 64 of them the resident base) yet a
+        # scale-50 perturbation on them wrecks ranking far past the gate
+        bad = build_delta(
+            _delta_for(
+                art, [f"u{i}" for i in range(12)], seed=5, scale=50.0
+            ),
+            art,
+            generation=1,
+        )
+        report = reg.apply_delta("candidate", bad)
+        assert report.rolled_back is True
+        assert report.baseline_metric == pytest.approx(1.0)
+        assert (
+            report.validation_metric
+            < report.baseline_metric - 0.02
+        )
+        st = reg.state("candidate")
+        assert st.generation == 0 and st.rollbacks == 1
+        assert _scores(scorer, reqs) == before  # base never moved
+        # a benign delta on the same variant still clears the gate
+        good = build_delta(
+            _delta_for(art, ["u1"], seed=2, scale=0.01),
+            art,
+            generation=1,
+        )
+        report2 = reg.apply_delta("candidate", good)
+        assert not report2.rolled_back
+        assert reg.state("candidate").generation == 1
+        assert report2.validation_metric >= 1.0 - 0.02
 
     def test_chain_check_refuses_wrong_head(self):
         art = _artifact()
@@ -456,6 +511,53 @@ class TestTenancyScenarios:
         st = reg.state("candidate")
         assert st.generation == doc["nearline"]["generations"]["candidate"]
         assert st.fingerprint is not None
+
+    def test_nearline_bad_delta_rolls_back_in_scenario(self):
+        """The delta-apply path of the nearline_loop scenario runs
+        through the gate: a nearline trainer emitting deliberately-bad
+        generations (huge-scale row updates) gets every swap rolled
+        back, the scenario doc counts the rollbacks, and the variant's
+        chain head never advances."""
+        art = _artifact()
+        scorer = _scorer(art)
+        reqs = _requests(120)
+        gate_reqs = reqs[:48]
+        base = scorer.score_batch(gate_reqs, bucket_size=len(gate_reqs))
+        scores = np.asarray([r.score for r in base], dtype=np.float32)
+        labels = (scores > np.median(scores)).astype(np.float32)
+        reg = VariantRegistry(
+            scorer,
+            gate=ValidationGate(
+                gate_reqs, labels,
+                max_auc_regression=0.02,
+                bucket_size=len(gate_reqs),
+            ),
+        )
+        reg.add_variant("candidate")
+        tenancy, plane = self._scenario_stack(reg)
+        tenancy.router.set_ramp("candidate", 50.0)
+        scenario = build_scenario(
+            "nearline_loop", reqs, seed=0, num_phases=6, pause_s=0.0
+        )
+        nearline_fn = make_nearline_fn(
+            reg,
+            ["candidate"],
+            {"per_user": [f"u{i}" for i in range(12)]},
+            rows_per_delta=12,
+            scale=50.0,  # deliberately ranking-wrecking generations
+            seed=3,
+        )
+        doc = run_scenario(
+            scenario, [scorer], BUCKETS, ServingMetrics(),
+            plane=plane, tenancy=tenancy, nearline_fn=nearline_fn,
+        )
+        assert doc["num_requests"] == len(reqs)
+        assert doc["nearline"]["rollbacks"] > 0
+        assert doc["nearline"]["deltas_applied"] == 0
+        assert doc["nearline"]["generations"]["candidate"] == 0
+        st = reg.state("candidate")
+        assert st.generation == 0
+        assert st.rollbacks == doc["nearline"]["rollbacks"]
 
     def test_tenancy_scenario_requires_plane(self):
         scenario = build_scenario("tenant_isolation", _requests(24))
