@@ -923,13 +923,14 @@ def _serving_bench():
             for i in range(N_SRV_REQ)
         ]
 
-        def _eviction_arm(policy):
+        def _eviction_arm(policy, score_delta=True):
             s = ShardedGameScorer(
                 artifact,
                 max_nnz={"global": K_SRV_FE, "per_user": D_SRV_RE},
                 num_shards=SRV_SHARDS,
                 device_budget_rows=EV_BUDGET,
                 eviction_policy=policy,
+                score_delta=score_delta,
             )
             adm = AdmissionController([s], admit_batch=EV_ADMIT)
             s.attach_admission(adm)
@@ -971,10 +972,20 @@ def _serving_bench():
             "chunk_rows": EV_CHUNK,
             "oldest": _eviction_arm("oldest"),
             "importance": _eviction_arm("importance"),
+            # third arm: importance WITHOUT the |score - fe_only| EWMA
+            # fold-in — isolates what the score-delta signal itself buys
+            # over plain frequency x norm at the same budget
+            "importance_no_delta": _eviction_arm(
+                "importance", score_delta=False
+            ),
         }
         eviction_ab["resident_rate_gain"] = round(
             eviction_ab["importance"]["device_resident_rate"]
             - eviction_ab["oldest"]["device_resident_rate"], 4
+        )
+        eviction_ab["score_delta_gain"] = round(
+            eviction_ab["importance"]["device_resident_rate"]
+            - eviction_ab["importance_no_delta"]["device_resident_rate"], 4
         )
 
         # --- multi-model tenancy arm: MM_VARIANTS variants (shared FE
@@ -1214,6 +1225,7 @@ def _scenarios_bench():
         from photon_ml_tpu.serving import (
             AdmissionController,
             DEFAULT_TENANTS,
+            OverloadController,
             RequestPlane,
             SCENARIO_NAMES,
             SLOTracker,
@@ -1338,6 +1350,13 @@ def _scenarios_bench():
                     swap_fn = make_row_swap_fn(
                         scorers, metrics, seed=SEED
                     )
+                overload = None
+                if name not in TENANCY_SCENARIOS:
+                    # closed-loop overload control on the plain replay
+                    # path: burn-rate >= 1 shrinks batch deadlines and
+                    # sheds FE-only-able load until the budget refills
+                    overload = OverloadController(slo)
+                    overload.attach_scorer(lead)
                 tenancy = None
                 nearline_fn = None
                 if name in TENANCY_SCENARIOS:
@@ -1407,6 +1426,7 @@ def _scenarios_bench():
                     tenancy=tenancy,
                     nearline_fn=nearline_fn,
                     nearline_interval_s=SCN_NEARLINE_INTERVAL_S,
+                    overload=overload,
                 )
                 scenario_docs.append(doc)
         finally:

@@ -24,7 +24,14 @@ timing is noisy):
   the tenancy plane, so a doc that lost them is malformed;
 * with ``--ledger``, the bench telemetry ledger passes
   ``validate_ledger`` (schema check for every record kind, the sampled
-  ``request`` records included) and actually carries request records.
+  ``request`` records included) and actually carries request records;
+* with ``--require-slo-ok``, every scenario's verdict must be ``ok``
+  (the pauseless-swap + overload-control regime holds 8/8 — applied to
+  the committed artifact, where timing is not smoke-noisy);
+* with ``--max-swap-pause-s``, any scenario whose cumulative
+  ``swap_pause`` interference exceeds the cap fails — the
+  generation-flip swap's blackout is a pointer flip, so a fat number
+  here means the pauseless path regressed.
 
 Exit 0 = artifact sound; exit 1 names every violated invariant.
 
@@ -87,7 +94,14 @@ def _check_tenancy(doc, name, problems):
             problems.append(f"{name}: no flooding_tenant attribution")
 
 
-def check_payload(payload, min_scenarios, min_coverage, require_names=()):
+def check_payload(
+    payload,
+    min_scenarios,
+    min_coverage,
+    require_names=(),
+    require_slo_ok=False,
+    max_swap_pause_s=None,
+):
     """Return the list of violated invariants (empty = sound)."""
     problems = []
     if payload.get("error"):
@@ -134,6 +148,24 @@ def check_payload(payload, min_scenarios, min_coverage, require_names=()):
             problems.append(f"{name}: no device_resident_rate")
         if not doc.get("slo_verdict"):
             problems.append(f"{name}: no SLO verdict")
+        elif require_slo_ok and doc.get("slo_verdict") != "ok":
+            problems.append(
+                f"{name}: slo_verdict={doc['slo_verdict']!r}, gate "
+                "requires 'ok' for every scenario"
+            )
+        if max_swap_pause_s is not None:
+            interference = plane.get("interference") or {}
+            swap = interference.get("swap_pause") or {}
+            total = swap.get("total_s", 0.0)
+            if (
+                isinstance(total, (int, float))
+                and total > max_swap_pause_s
+            ):
+                problems.append(
+                    f"{name}: swap_pause total {total:.4f}s > "
+                    f"{max_swap_pause_s}s — the generation flip is "
+                    "supposed to make swaps pauseless"
+                )
         if "tenants" in doc:
             _check_tenancy(doc, name, problems)
     return problems
@@ -180,6 +212,16 @@ def main(argv=None) -> int:
         help="comma-separated scenario names that MUST be present (the "
              "scenario set is otherwise variable)",
     )
+    ap.add_argument(
+        "--require-slo-ok", action="store_true",
+        help="every scenario's slo_verdict must be 'ok' (the pauseless-"
+             "swap + overload-control regime holds 8/8)",
+    )
+    ap.add_argument(
+        "--max-swap-pause-s", type=float, default=None,
+        help="fail any scenario whose cumulative swap_pause interference "
+             "exceeds this many seconds (pauseless-flip regression gate)",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -192,7 +234,12 @@ def main(argv=None) -> int:
         n.strip() for n in args.require_names.split(",") if n.strip()
     )
     problems = check_payload(
-        payload, args.min_scenarios, args.min_coverage, require_names
+        payload,
+        args.min_scenarios,
+        args.min_coverage,
+        require_names,
+        require_slo_ok=args.require_slo_ok,
+        max_swap_pause_s=args.max_swap_pause_s,
     )
     if args.ledger:
         problems += check_ledger(args.ledger)

@@ -166,6 +166,27 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--slo-availability-objective", type=float, default=0.999,
                    help="fraction of requests that must not error "
                         "(default 0.999)")
+    p.add_argument("--overload-control", action="store_true",
+                   help="closed-loop overload control (needs "
+                        "--slo-latency-ms): when the error-budget burn "
+                        "rate crosses --overload-burn-high, batch "
+                        "deadlines shrink by --overload-shrink and "
+                        "requests scoreable FE-only (all RE entities "
+                        "absent/non-resident) are answered on the host "
+                        "without queueing; recovers below "
+                        "--overload-burn-low (serving.overload.* gauges, "
+                        "/varz overload doc)")
+    p.add_argument("--overload-burn-high", type=float, default=1.0,
+                   help="burn rate at/above which overload actuation "
+                        "engages (default 1.0 = budget burning faster "
+                        "than it accrues)")
+    p.add_argument("--overload-burn-low", type=float, default=0.5,
+                   help="burn rate at/below which overload actuation "
+                        "releases (default 0.5; the gap to "
+                        "--overload-burn-high is the hysteresis band)")
+    p.add_argument("--overload-shrink", type=float, default=0.5,
+                   help="batch-deadline multiplier while overloaded, in "
+                        "(0, 1] (default 0.5)")
     p.add_argument("--tenants", default=None,
                    help="comma-separated tenant names: the replayed stream "
                         "is tagged round-robin across them and, with "
@@ -498,6 +519,29 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
             availability_objective=args.slo_availability_objective,
             registry=get_registry(),
         )
+    overload = None
+    if args.overload_control:
+        if slo is None:
+            raise SystemExit(
+                "--overload-control needs --slo-latency-ms: the controller "
+                "actuates on the SLO burn rate"
+            )
+        from photon_ml_tpu.serving import OverloadController
+        from photon_ml_tpu.telemetry.metrics import get_registry
+
+        overload = OverloadController(
+            slo,
+            shrink_factor=args.overload_shrink,
+            burn_high=args.overload_burn_high,
+            burn_low=args.overload_burn_low,
+            registry=get_registry(),
+        )
+        logger.info(
+            "overload control on: burn >= %.2f shrinks deadlines x%.2f and "
+            "sheds FE-only-able load; recovers at burn <= %.2f",
+            args.overload_burn_high, args.overload_shrink,
+            args.overload_burn_low,
+        )
     tenants = [
         t.strip() for t in (args.tenants or "").split(",") if t.strip()
     ]
@@ -543,6 +587,7 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
         )
     active["request_sample_rate"] = args.request_sample_rate
     active["slo_latency_ms"] = args.slo_latency_ms
+    active["overload_control"] = overload is not None
     active["tenants"] = tenants or None
 
     variants = [
@@ -625,6 +670,8 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
             doc = dict(active)
             if slo is not None:
                 doc["slo"] = slo.status()
+            if overload is not None:
+                doc["overload"] = overload.status()
             if tenant_slos:
                 doc["tenant_slo"] = {
                     t: tracker.status()
@@ -648,7 +695,7 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
     try:
         snapshot = _serve_stream(
             args, logger, timer, emitter, artifact, model_id, active,
-            bucket_sizes, state, plane,
+            bucket_sizes, state, plane, overload,
         )
         state["phase"] = "drained"
         if introspect is not None and args.introspect_hold > 0:
@@ -665,7 +712,7 @@ def _run_serving(args, logger, timer, emitter, telemetry=None) -> Optional[dict]
 
 def _serve_stream(
     args, logger, timer, emitter, artifact, model_id, active, bucket_sizes,
-    state, plane=None,
+    state, plane=None, overload=None,
 ) -> Optional[dict]:
     snapshot: Optional[dict] = None
     if args.data_dirs:
@@ -826,6 +873,11 @@ def _serve_stream(
                 )
                 scorers = scorers[:1]
             active["mode"] = "sharded-tenancy"
+            if overload is not None:
+                logger.warning(
+                    "--overload-control drives the plain replay batcher; "
+                    "it is ignored on the tenancy path"
+                )
             with timer.time("replay"):
                 snapshot = _serve_tenancy(
                     args, logger, active, tenants, scorers, admission,
@@ -879,6 +931,7 @@ def _serve_stream(
                     max_queue=active["max_queue"],
                     admission=admission,
                     plane=plane,
+                    overload=overload,
                 )
             if manager is not None:
                 logger.info(

@@ -49,6 +49,9 @@ map onto that design:
 - :mod:`photon_ml_tpu.serving.slo` — availability + latency objectives
   over a rolling window with error-budget burn-rate accounting
   (``/healthz`` degraded reason + ``serving.slo.*`` gauges).
+- :mod:`photon_ml_tpu.serving.overload` — closed-loop overload control:
+  SLO burn rate drives batch-deadline shrink and FE-only shedding with
+  hysteresis (``serving.overload.*`` gauges).
 - :mod:`photon_ml_tpu.serving.scenarios` — seeded traffic-shape scenarios
   (steady, diurnal, burst storm, cold-entity flood, hot-swap under load,
   plus the tenancy trio: tenant isolation, ramped rollout, nearline loop)
@@ -102,6 +105,7 @@ from photon_ml_tpu.serving.tenancy import (
     make_nearline_fn,
     tag_requests,
 )
+from photon_ml_tpu.serving.overload import OverloadController
 from photon_ml_tpu.serving.slo import SLOTracker
 from photon_ml_tpu.serving.routing import (
     CoordinateRouting,
@@ -142,6 +146,7 @@ __all__ = [
     "HotEntityCache",
     "HotSwapManager",
     "MicroBatcher",
+    "OverloadController",
     "PendingResult",
     "RoutingIndex",
     "ScoreRequest",

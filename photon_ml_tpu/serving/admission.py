@@ -240,11 +240,21 @@ class AdmissionController:
                 fresh, np.linalg.norm(buf[: fresh.size], axis=1)
             )
             for scorer in self._scorers:
+                provider = scorer._providers[cid]
+                # double-buffered providers: keep the spare generation half
+                # converged (invariant: both halves identical outside an
+                # in-flight flip) so the next hot-swap flip doesn't lose
+                # admitted rows. No write_lock needed — the request path
+                # never captures the spare half, and routing.lock (held
+                # here) keeps the generation index stable.
+                spare = getattr(provider, "spare_gen", None)
+                if spare is not None:
+                    provider.write_slots(shards, slots, buf, gen=spare)
                 # the donated scatter invalidates the replica's previous
                 # table array; its write_lock keeps that away from a
                 # gather in flight on the replica's scoring thread
                 with scorer.write_lock:
-                    scorer._providers[cid].write_slots(shards, slots, buf)
+                    provider.write_slots(shards, slots, buf)
             routing.publish(fresh, a_shards, a_slots)
             self.admitted_total += int(fresh.size)
             self.evicted_total += len(evicted)

@@ -13,6 +13,15 @@ runs the deadline policy instead: construct with ``max_wait_s`` and call
 ``poll()`` from its event loop — once the OLDEST pending request has
 waited past the deadline, everything pending drains through the smallest
 fitting buckets, bounding queue wait without manual ``flush`` calls.
+
+Two priority lanes mirror the continuous batcher: ``live`` (default)
+holds request traffic; ``background`` holds admission warmups and
+nearline replays and drains only when no live request is pending, so
+background work never seals a bucket ahead of a live request. An
+optional ``quota`` (tenancy token bucket) is consulted at drain time:
+an over-budget tenant's requests are dropped from the bucket and
+reported to the plane as errors charged to that tenant, instead of
+occupying padded device slots.
 """
 
 from __future__ import annotations
@@ -24,6 +33,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.requestplane import tenant_of_request_id
 from photon_ml_tpu.serving.scorer import GameScorer, ScoreRequest, ScoreResult
 from photon_ml_tpu.telemetry import span
 
@@ -39,6 +49,7 @@ class MicroBatcher:
         clock: Callable[[], float] = time.perf_counter,
         max_wait_s: Optional[float] = None,
         plane=None,
+        quota=None,
     ):
         if max_wait_s is not None and max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
@@ -59,14 +70,22 @@ class MicroBatcher:
         # request plane (serving/requestplane.py): lifecycle sampling +
         # SLO feed; None (the default) costs one check per drained batch
         self._plane = plane
+        # tenant token bucket (tenancy/quota.py), consulted at DRAIN time
+        self._quota = quota
+        # set by OverloadController.attach(); consulted at submit (shed)
+        # and polled from the drain path
+        self._overload = None
         self._stage_capable: Optional[bool] = None
         self._clock = clock
         self.max_wait_s = max_wait_s
         self._pending: "deque[Tuple[ScoreRequest, float]]" = deque()
+        # background lane: drains only when the live lane is empty
+        self._pending_bg: "deque[Tuple[ScoreRequest, float]]" = deque()
+        self.quota_shed_total = 0
 
     @property
     def queue_depth(self) -> int:
-        return len(self._pending)
+        return len(self._pending) + len(self._pending_bg)
 
     def _bucket_for(self, n: int) -> int:
         for b in self.bucket_sizes:
@@ -74,35 +93,92 @@ class MicroBatcher:
                 return b
         return self.max_bucket
 
-    def submit(self, request: ScoreRequest) -> List[ScoreResult]:
-        """Enqueue one request; returns results completed by this call
-        (empty until a full max-size batch has accumulated)."""
-        self._pending.append((request, self._clock()))
-        out: List[ScoreResult] = []
+    def _drain_full(self, out: List[ScoreResult]) -> None:
+        """Drain full live buckets; background buckets only once the live
+        lane is empty (lane ordering: background never seals a bucket
+        ahead of a live request)."""
         while len(self._pending) >= self.max_bucket:
             out.extend(self._drain(self.max_bucket))
+        while (
+            not self._pending and len(self._pending_bg) >= self.max_bucket
+        ):
+            out.extend(self._drain(self.max_bucket, lane=self._pending_bg))
+
+    def submit(
+        self, request: ScoreRequest, priority: str = "live"
+    ) -> List[ScoreResult]:
+        """Enqueue one request; returns results completed by this call
+        (empty until a full max-size batch has accumulated)."""
+        # single-request fast path: this runs once per request on the
+        # sealed serving loop, so it must not pay submit_many's framing
+        ovl = self._overload
+        if priority != "live" or (ovl is not None and ovl.active):
+            return self.submit_many((request,), priority=priority)
+        self._pending.append((request, self._clock()))
+        if len(self._pending) < self.max_bucket:
+            return []
+        out: List[ScoreResult] = []
+        self._drain_full(out)
         return out
 
-    def submit_many(self, requests: Sequence[ScoreRequest]) -> List[ScoreResult]:
+    def submit_many(
+        self, requests: Sequence[ScoreRequest], priority: str = "live"
+    ) -> List[ScoreResult]:
         """Enqueue a pre-collected run of requests in one call (the
         tenancy plane's bulk replay path). Same drain policy as
         :meth:`submit` — full max-size batches drain as they accumulate —
         but one clock read and one Python frame for the whole run instead
-        of one per request."""
+        of one per request. ``priority="background"`` routes to the
+        background lane (drained only when no live request is pending).
+        While an attached overload controller is active, live requests it
+        can answer FE-only are resolved inline without queueing."""
+        if priority not in ("live", "background"):
+            raise ValueError(f"unknown priority {priority!r}")
         if not requests:
             return []
-        now = self._clock()
-        self._pending.extend((r, now) for r in requests)
         out: List[ScoreResult] = []
-        while len(self._pending) >= self.max_bucket:
-            out.extend(self._drain(self.max_bucket))
+        ovl = self._overload
+        if ovl is not None and priority == "live" and ovl.active:
+            kept = []
+            for r in requests:
+                res = ovl.try_shed(r)
+                if res is None:
+                    kept.append(r)
+                else:
+                    out.append(res)
+            if out:
+                plane = self._plane
+                if plane is not None:
+                    # shed answers ARE completions (FE-only, ~0 queue
+                    # wait): feeding them lets the burn rate recover
+                    lat = np.zeros(len(out), dtype=np.float64)
+                    if getattr(plane, "wants_request_ids", False):
+                        plane.observe_complete(
+                            lat,
+                            request_ids=[r.request_id for r in out],
+                        )
+                    else:
+                        plane.observe_complete(lat)
+            requests = kept
+        now = self._clock()
+        lane = self._pending if priority == "live" else self._pending_bg
+        lane.extend((r, now) for r in requests)
+        self._drain_full(out)
         return out
 
     def flush(self) -> List[ScoreResult]:
-        """Score everything still pending (smallest buckets that fit)."""
+        """Score everything still pending (live lane first, then
+        background, through the smallest buckets that fit)."""
         out: List[ScoreResult] = []
         while self._pending:
             out.extend(self._drain(min(len(self._pending), self.max_bucket)))
+        while self._pending_bg:
+            out.extend(
+                self._drain(
+                    min(len(self._pending_bg), self.max_bucket),
+                    lane=self._pending_bg,
+                )
+            )
         return out
 
     def poll(self, now: Optional[float] = None) -> List[ScoreResult]:
@@ -111,7 +187,8 @@ class MicroBatcher:
         fitting buckets (younger requests ride along — padding slots are
         cheaper than a second dispatch). Otherwise a no-op. ``now`` defaults
         to the batcher's clock; pass it explicitly from an event loop that
-        already read the time."""
+        already read the time. The background lane is deadline-drained only
+        once the live lane is empty."""
         if self.max_wait_s is None:
             raise ValueError(
                 "poll() needs a deadline: construct the batcher with "
@@ -122,6 +199,17 @@ class MicroBatcher:
         out: List[ScoreResult] = []
         while self._pending and now - self._pending[0][1] >= self.max_wait_s:
             out.extend(self._drain(min(len(self._pending), self.max_bucket)))
+        while (
+            not self._pending
+            and self._pending_bg
+            and now - self._pending_bg[0][1] >= self.max_wait_s
+        ):
+            out.extend(
+                self._drain(
+                    min(len(self._pending_bg), self.max_bucket),
+                    lane=self._pending_bg,
+                )
+            )
         return out
 
     def _supports_stages(self) -> bool:
@@ -140,8 +228,17 @@ class MicroBatcher:
             self._stage_capable = cap
         return cap
 
-    def _drain(self, n: int) -> List[ScoreResult]:
-        batch = [self._pending.popleft() for _ in range(n)]
+    def _drain(self, n: int, lane=None) -> List[ScoreResult]:
+        if lane is None:
+            lane = self._pending
+        batch = [lane.popleft() for _ in range(n)]
+        if self._quota is not None:
+            batch = self._apply_quota(batch)
+            if not batch:
+                if self._overload is not None:
+                    self._overload.maybe_poll()
+                return []
+            n = len(batch)
         dequeued = self._clock()
         bucket = self._bucket_for(n)
         plane = self._plane
@@ -194,4 +291,34 @@ class MicroBatcher:
                         ],
                         dequeued, stages, done,
                     )
+        if self._overload is not None:
+            # drain-path control step (rate-limited inside the controller)
+            self._overload.maybe_poll()
         return results
+
+    def _apply_quota(self, batch):
+        """Drain-time tenant admission: requests from a tenant whose
+        token bucket is exhausted are dropped from the bucket here and
+        reported as errors charged to that tenant, instead of occupying
+        padded device slots ahead of in-budget tenants. Untagged requests
+        (no ``tenant!`` prefix) always pass."""
+        quota = self._quota
+        kept = []
+        shed_ids: List[str] = []
+        for item in batch:
+            tenant = tenant_of_request_id(item[0].request_id)
+            if tenant is None or quota.try_admit(tenant):
+                kept.append(item)
+            else:
+                shed_ids.append(item[0].request_id)
+        if shed_ids:
+            self.quota_shed_total += len(shed_ids)
+            plane = self._plane
+            if plane is not None:
+                if getattr(plane, "wants_request_ids", False):
+                    plane.observe_errors(
+                        len(shed_ids), request_ids=shed_ids
+                    )
+                else:
+                    plane.observe_errors(len(shed_ids))
+        return kept

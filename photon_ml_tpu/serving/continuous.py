@@ -19,6 +19,22 @@ full queue blocks ``submit`` — p99 latency is then roughly
 ``max_queue / throughput + one bucket's scoring time`` instead of
 unbounded queue growth.
 
+Two priority lanes keep serving work ahead of everything else: the
+``live`` lane (default) holds request traffic; the ``background`` lane
+(``submit(..., priority="background")``) holds admission warmups, swap
+probes, and nearline replays, and drains ONLY when no live request is
+pending — background work can never queue ahead of a live request. Each
+lane is independently capped at ``max_queue``, so a background flood
+cannot backpressure live submitters.
+
+Two optional controls act at the queue boundary: a
+:class:`~photon_ml_tpu.serving.tenancy.quota.TenantQuota` (``quota=``)
+is consulted at DRAIN time — a tenant over budget has its requests
+resolved with an error before they reach the device, charged to that
+tenant's own error budget via the plane — and an attached
+:class:`~photon_ml_tpu.serving.overload.OverloadController` may answer
+FE-only-able requests at SUBMIT time while the SLO budget is burning.
+
 ``scorers`` accepts one scorer or several replicas (multi-scorer mode:
 one ``GameScorer`` per device, shared routing index) — drained buckets
 round-robin across replicas, one scoring thread per replica, so replica
@@ -30,7 +46,6 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from itertools import repeat
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -38,6 +53,7 @@ import numpy as np
 from photon_ml_tpu.resilience.supervisor import SupervisedThread
 from photon_ml_tpu.serving.batcher import DEFAULT_BUCKET_SIZES
 from photon_ml_tpu.serving.metrics import ServingMetrics
+from photon_ml_tpu.serving.requestplane import tenant_of_request_id
 from photon_ml_tpu.serving.scorer import ScoreRequest, ScoreResult
 from photon_ml_tpu.telemetry import span
 
@@ -75,6 +91,7 @@ class ContinuousBatcher:
         max_queue: Optional[int] = None,
         clock: Callable[[], float] = time.perf_counter,
         plane=None,
+        quota=None,
     ):
         scorers = (
             list(scorers) if isinstance(scorers, (list, tuple)) else [scorers]
@@ -108,12 +125,24 @@ class ContinuousBatcher:
         # request plane (serving/requestplane.py): lifecycle sampling +
         # SLO feed; None (the default) costs one check per drained batch
         self._plane = plane
+        # tenant token bucket (tenancy/quota.py), consulted at DRAIN time:
+        # an over-budget tenant's requests resolve with an error instead of
+        # occupying device bucket slots
+        self._quota = quota
+        # set by OverloadController.attach(); consulted at submit (shed)
+        # and polled from the drain path
+        self._overload = None
         self._stage_capable: dict = {}
         self._clock = clock
         self._cond = threading.Condition()
         self._pending: "deque[Tuple[ScoreRequest, float, PendingResult]]" = (
             deque()
         )
+        # background lane: drains only when the live lane is empty
+        self._pending_bg: (
+            "deque[Tuple[ScoreRequest, float, PendingResult]]"
+        ) = deque()
+        self.quota_shed_total = 0
         self._inflight = 0  # requests popped but not yet resolved
         self._running = False
         self._stop_event = threading.Event()
@@ -159,10 +188,13 @@ class ContinuousBatcher:
         # resolve anything stranded (stop before flush): submitters must
         # not block forever on a dead batcher
         with self._cond:
-            while self._pending:
-                _, _, handle = self._pending.popleft()
-                handle.error = RuntimeError("batcher stopped before scoring")
-                handle.done = True
+            for lane in (self._pending, self._pending_bg):
+                while lane:
+                    _, _, handle = lane.popleft()
+                    handle.error = RuntimeError(
+                        "batcher stopped before scoring"
+                    )
+                    handle.done = True
             self._cond.notify_all()
 
     def __enter__(self) -> "ContinuousBatcher":
@@ -192,38 +224,69 @@ class ContinuousBatcher:
 
     @property
     def queue_depth(self) -> int:
-        return len(self._pending)
+        return len(self._pending) + len(self._pending_bg)
 
-    def submit(self, request: ScoreRequest) -> PendingResult:
+    def submit(
+        self, request: ScoreRequest, priority: str = "live"
+    ) -> PendingResult:
         """Enqueue one request (blocks only on backpressure)."""
-        return self.submit_many((request,))[0]
+        return self.submit_many((request,), priority=priority)[0]
 
     def submit_many(
-        self, requests: Sequence[ScoreRequest]
+        self, requests: Sequence[ScoreRequest], priority: str = "live"
     ) -> List[PendingResult]:
         """Enqueue a burst under one lock acquisition (amortizes the
-        condition handshake for high-rate closed-loop clients)."""
+        condition handshake for high-rate closed-loop clients).
+
+        ``priority="background"`` routes to the background lane, which
+        drains only when no live request is pending. While an attached
+        overload controller is active, live requests it can answer
+        FE-only are resolved here without ever entering the queue."""
+        if priority not in ("live", "background"):
+            raise ValueError(f"unknown priority {priority!r}")
         handles = [PendingResult(self) for _ in requests]
+        pairs = list(zip(requests, handles))
+        ovl = self._overload
+        if ovl is not None and priority == "live" and ovl.active:
+            kept = []
+            shed_ids: List[str] = []
+            for req, handle in pairs:
+                res = ovl.try_shed(req)
+                if res is None:
+                    kept.append((req, handle))
+                else:
+                    handle.value = res
+                    handle.done = True
+                    shed_ids.append(req.request_id)
+            pairs = kept
+            if shed_ids:
+                plane = self._plane
+                if plane is not None:
+                    # shed answers ARE completions (FE-only, ~0 queue
+                    # wait): feeding them lets the burn rate recover
+                    lat = np.zeros(len(shed_ids), dtype=np.float64)
+                    if getattr(plane, "wants_request_ids", False):
+                        plane.observe_complete(lat, request_ids=shed_ids)
+                    else:
+                        plane.observe_complete(lat)
+        lane = self._pending if priority == "live" else self._pending_bg
         with self._cond:
             if not self._running:
                 raise RuntimeError("batcher is not running — call start()")
             i = 0
-            while i < len(requests):
-                while (
-                    len(self._pending) >= self.max_queue and self._running
-                ):
+            while i < len(pairs):
+                while len(lane) >= self.max_queue and self._running:
                     self._cond.wait()
                 if not self._running:
                     raise RuntimeError("batcher stopped")
-                room = self.max_queue - len(self._pending)
+                room = self.max_queue - len(lane)
                 now = self._clock()
                 # C-level bulk extend: the lock is held, so per-item
                 # appends would serialize against the scoring threads
-                self._pending.extend(zip(
-                    requests[i : i + room],
-                    repeat(now),
-                    handles[i : i + room],
-                ))
+                lane.extend(
+                    (req, now, handle)
+                    for req, handle in pairs[i : i + room]
+                )
                 i += room
                 self._cond.notify_all()
         return handles
@@ -232,7 +295,7 @@ class ContinuousBatcher:
         """Block until every submitted request has been scored."""
         deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
-            while self._pending or self._inflight:
+            while self._pending or self._pending_bg or self._inflight:
                 remaining = (
                     None if deadline is None else deadline - self._clock()
                 )
@@ -266,11 +329,13 @@ class ContinuousBatcher:
             batch = None
             with self._cond:
                 while self._running:
-                    n = len(self._pending)
+                    # live lane first; background only when live is empty
+                    lane = self._pending if self._pending else self._pending_bg
+                    n = len(lane)
                     if n >= self.max_bucket:
                         break
                     if n:
-                        oldest_wait = self._clock() - self._pending[0][1]
+                        oldest_wait = self._clock() - lane[0][1]
                         if oldest_wait >= self.max_wait_s:
                             break
                         self._cond.wait(self.max_wait_s - oldest_wait)
@@ -278,14 +343,13 @@ class ContinuousBatcher:
                         self._cond.wait()
                 if not self._running:
                     return
-                take = min(len(self._pending), self.max_bucket)
-                if take == len(self._pending):
-                    batch = list(self._pending)
-                    self._pending.clear()
+                lane = self._pending if self._pending else self._pending_bg
+                take = min(len(lane), self.max_bucket)
+                if take == len(lane):
+                    batch = list(lane)
+                    lane.clear()
                 else:
-                    batch = [
-                        self._pending.popleft() for _ in range(take)
-                    ]
+                    batch = [lane.popleft() for _ in range(take)]
                 self._inflight += take
                 # queue room just opened: wake blocked submitters (and any
                 # sibling replica thread waiting for work)
@@ -309,7 +373,47 @@ class ContinuousBatcher:
             self._stage_capable[key] = cap
         return cap
 
+    def _apply_quota(self, batch):
+        """Drain-time tenant admission: requests from a tenant whose token
+        bucket is exhausted resolve with an error here — charged to that
+        tenant's own error budget through the plane — instead of occupying
+        device bucket slots ahead of in-budget tenants. Untagged requests
+        (no ``tenant!`` prefix) always pass."""
+        quota = self._quota
+        kept = []
+        shed = []
+        for item in batch:
+            tenant = tenant_of_request_id(item[0].request_id)
+            if tenant is None or quota.try_admit(tenant):
+                kept.append(item)
+            else:
+                shed.append(item)
+        if shed:
+            shed_ids = [req.request_id for req, _, _ in shed]
+            with self._cond:
+                for _, _, handle in shed:
+                    handle.error = RuntimeError(
+                        "request shed: tenant over quota at drain"
+                    )
+                    handle.done = True
+                self.quota_shed_total += len(shed)
+                self._inflight -= len(shed)
+                self._cond.notify_all()
+            plane = self._plane
+            if plane is not None:
+                if getattr(plane, "wants_request_ids", False):
+                    plane.observe_errors(len(shed), request_ids=shed_ids)
+                else:
+                    plane.observe_errors(len(shed))
+        return kept
+
     def _score(self, scorer, batch) -> None:
+        if self._quota is not None:
+            batch = self._apply_quota(batch)
+            if not batch:
+                if self._overload is not None:
+                    self._overload.maybe_poll()
+                return
         n = len(batch)
         dequeued = self._clock()
         bucket = self._bucket_for(n)
@@ -385,3 +489,8 @@ class ContinuousBatcher:
                         ],
                         dequeued, stages, done,
                     )
+        if self._overload is not None:
+            # drain-path control step (rate-limited inside the controller):
+            # the freshly fed SLO window drives shrink/shed for the NEXT
+            # submissions, no dedicated poller thread required
+            self._overload.maybe_poll()

@@ -13,12 +13,17 @@ delta into the narrowest possible mutation of a live ``GameScorer``:
 - cache-backed RE coordinates: O(1) backing-store rebind + invalidation of
   only the touched rows — everything else stays warm on device.
 
-The mutation runs in one critical section between request batches (the
-*blackout*, microseconds-to-milliseconds); a generation counter tracks the
-live version. An optional validation gate replays a held-out slice through
-the swapped scorer and rolls back to the previous generation when AUC
-regresses past a threshold — the inverse mutation is applied from an undo
-snapshot of exactly the touched rows, so rollback is as cheap as the swap.
+The mutation runs in one critical section; its *blackout* is the
+request-path BLOCKING time, not the section's wall clock — a sharded
+scorer stages row content into the spare generation half of its
+double-buffered device tables off the request path and blocks scoring only
+for the atomic generation flip (microseconds), while the single-table
+scorer's live-table mutation keeps wall-clock accounting. A generation
+counter tracks the live version. An optional validation gate replays a
+held-out slice through the swapped scorer and rolls back to the previous
+generation when AUC regresses past a threshold — the inverse mutation is
+applied from an undo snapshot of exactly the touched rows (on a sharded
+scorer: the same stage-and-flip-back), so rollback is as cheap as the swap.
 """
 
 from __future__ import annotations
@@ -248,14 +253,27 @@ class HotSwapManager:
                 )
 
         # ------------------------- critical section: the blackout -------
+        # blackout_s is the REQUEST-PATH blocking time, not the wall clock
+        # of the section: a sharded scorer's row updates stage into the
+        # spare generation half off the request path and return only the
+        # generation-flip window (see ShardedReTable.update_rows), so that
+        # staging work is subtracted from the wall clock. Hooks returning
+        # None (the single-table GameScorer mutates live tables) keep the
+        # historical wall-clock accounting.
         compiles_before = self._scorer.compile_count
         t0 = time.perf_counter()
+        nonblocking_s = 0.0
         regrew: List[str] = []
         self._scorer.set_artifact(candidate)
         for cid, w in fe_plan.items():
             self._scorer.update_fixed_effect(cid, w)
         for cid, (rows, values) in inplace_plan.items():
-            self._scorer.update_random_effect_rows(cid, rows, values)
+            u0 = time.perf_counter()
+            ret = self._scorer.update_random_effect_rows(cid, rows, values)
+            if isinstance(ret, float):
+                nonblocking_s += max(
+                    0.0, (time.perf_counter() - u0) - ret
+                )
         for cid, (backing, _) in rebind_plan.items():
             if self._scorer.rebind_random_effect(cid, backing):
                 regrew.append(cid)
@@ -263,7 +281,7 @@ class HotSwapManager:
             cache = self._scorer.caches[cid]
             cache.rebind(backing)
             cache.invalidate(rows)
-        blackout_s = time.perf_counter() - t0
+        blackout_s = max(0.0, time.perf_counter() - t0 - nonblocking_s)
         # ----------------------------------------------------------------
 
         self.generation += 1

@@ -95,6 +95,8 @@ def replay_requests(
     max_queue: Optional[int] = None,
     admission=None,
     plane=None,
+    overload=None,
+    quota=None,
 ) -> Tuple[List[ScoreResult], dict]:
     """Pump a request stream through a fresh microbatcher.
 
@@ -122,6 +124,14 @@ def replay_requests(
     the SLO status when the plane carries a tracker — ride in the
     snapshot under ``"request_plane"`` / ``"slo"``. ``plane=None`` (the
     default) is the bitwise-pinned zero-cost path.
+
+    An :class:`~photon_ml_tpu.serving.overload.OverloadController` passed
+    as ``overload`` is attached to the batcher for the duration of the
+    replay (deadline shrink + FE-only shed, detached on exit; its scorer
+    binding defaults to the lead scorer when not already bound) and its
+    status rides in the snapshot under ``"overload"``. A ``quota``
+    (tenancy token bucket) is forwarded to the batcher for drain-time
+    tenant admission.
     """
     from photon_ml_tpu.event import ScoringFinishEvent, ScoringStartEvent
 
@@ -152,6 +162,8 @@ def replay_requests(
         with span(
             "serve/replay", num_requests=len(requests), model_id=model_id
         ):
+            if overload is not None and overload._scorer is None:
+                overload.attach_scorer(lead)
             if continuous:
                 batcher = ContinuousBatcher(
                     scorers,
@@ -160,7 +172,10 @@ def replay_requests(
                     max_wait_s=max_wait_s,
                     max_queue=max_queue,
                     plane=plane,
+                    quota=quota,
                 ).start()
+                if overload is not None:
+                    overload.attach(batcher)
                 try:
                     handles = []
                     chunk = batcher.max_bucket
@@ -177,8 +192,21 @@ def replay_requests(
                         )
                     batcher.flush()
                 finally:
+                    if overload is not None:
+                        overload.detach(batcher)
                     batcher.stop()
-                results = [h.result(timeout=0) for h in handles]
+                if quota is None:
+                    results = [h.result(timeout=0) for h in handles]
+                else:
+                    results = []
+                    for h in handles:
+                        try:
+                            results.append(h.result(timeout=0))
+                        except RuntimeError:
+                            # drain-time quota shed: the request was
+                            # answered with an error and charged to its
+                            # tenant; the replay stream continues
+                            pass
             else:
                 if len(scorers) != 1:
                     raise ValueError(
@@ -187,8 +215,10 @@ def replay_requests(
                     )
                 batcher = MicroBatcher(
                     lead, bucket_sizes=bucket_sizes, metrics=metrics,
-                    plane=plane,
+                    plane=plane, quota=quota,
                 )
+                if overload is not None:
+                    overload.attach(batcher)
                 for i, req in enumerate(requests):
                     if watching and i % poll_every == 0:
                         results.extend(batcher.flush())
@@ -197,6 +227,8 @@ def replay_requests(
                         )
                     results.extend(batcher.submit(req))
                 results.extend(batcher.flush())
+                if overload is not None:
+                    overload.detach(batcher)
         wall = time.perf_counter() - t0
     finally:
         if started_admission:
@@ -220,6 +252,8 @@ def replay_requests(
         snapshot["request_plane"] = report
         if slo is not None:
             snapshot["slo"] = slo
+    if overload is not None:
+        snapshot["overload"] = overload.status()
     if watching:
         snapshot["swap_reports"] = [
             {

@@ -63,6 +63,7 @@ class CoordinateRouting:
         shard_capacity: int,
         resident_rows: Optional[int] = None,
         eviction_policy: str = "oldest",
+        score_delta: bool = True,
     ):
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
@@ -126,6 +127,18 @@ class CoordinateRouting:
         else:
             self._freq = None
             self._norm = None
+        # MEASURED score impact: per-row EWMA of |score − fe_only_score|
+        # observed on actual requests (the realized counterpart of the
+        # freq × norm Cauchy–Schwarz BOUND above). importance_of takes the
+        # max of the two — the bound covers rows never yet measured (just
+        # admitted, or resident before the first scored hit), the
+        # measurement rescues rows whose bound is loose in either
+        # direction. Same stats-grade write discipline as _freq.
+        self.score_delta = bool(score_delta) and eviction_policy == "importance"
+        if self.score_delta:
+            self._sdelta = np.zeros(max(self.n_rows, 1), dtype=np.float64)
+        else:
+            self._sdelta = None
         self._freq_batches = 0
 
         # lookup accounting (reset via reset_counters)
@@ -220,6 +233,8 @@ class CoordinateRouting:
         if self._freq_batches >= self.FREQ_DECAY_EVERY:
             self._freq_batches = 0
             self._freq *= 0.5
+            if self._sdelta is not None:
+                self._sdelta *= 0.5
 
     def note_row_norms(self, rows: np.ndarray, norms: np.ndarray) -> None:
         """Record the L2 magnitude of rows' coefficient content (called on
@@ -232,15 +247,46 @@ class CoordinateRouting:
         if keep.any():
             self._norm[rows[keep]] = norms[keep]
 
+    @property
+    def wants_score_deltas(self) -> bool:
+        """Whether the scorer should compute measured per-request
+        |score − fe_only| contributions for :meth:`note_score_deltas`
+        (only the importance policy with the score-delta signal enabled
+        consumes them — the default path never pays for the extra jit)."""
+        return self._sdelta is not None
+
+    def note_score_deltas(
+        self, entity_rows: np.ndarray, deltas: np.ndarray
+    ) -> None:
+        """Fold one batch of MEASURED per-request score impacts
+        (|score − fe_only_score| attributable to this coordinate) into the
+        EWMA plane; decayed on the same cadence as the frequency plane
+        (inside :meth:`note_requests`). No-op unless score-delta tracking
+        is on. Non-resident rows gather the zero cold slot, so their
+        measured contribution is 0 — the freq × norm bound governs them
+        until first residency."""
+        if self._sdelta is None:
+            return
+        rows = np.asarray(entity_rows, dtype=np.int64).ravel()
+        deltas = np.asarray(deltas, dtype=np.float64).ravel()
+        keep = (rows >= 0) & (rows < self._sdelta.size)
+        if keep.any():
+            np.add.at(self._sdelta, rows[keep], np.abs(deltas[keep]))
+
     def importance_of(self, rows: np.ndarray) -> np.ndarray:
-        """freq × max(norm, ε) per row — ε keeps frequency meaningful for
-        rows admitted through paths that never reported a norm."""
+        """max(freq × max(norm, ε), measured score delta) per row — ε
+        keeps frequency meaningful for rows admitted through paths that
+        never reported a norm; the measured plane (when tracked) rescues
+        rows whose Cauchy–Schwarz bound is loose."""
         if self._freq is None:
             return np.zeros(np.asarray(rows).size, dtype=np.float64)
         rows = np.asarray(rows, dtype=np.int64).ravel()
-        return self._freq[rows] * np.maximum(
+        bound = self._freq[rows] * np.maximum(
             self._norm[rows].astype(np.float64), 1e-12
         )
+        if self._sdelta is None:
+            return bound
+        return np.maximum(bound, self._sdelta[rows])
 
     def is_resident(self, row: int) -> bool:
         return 0 <= row < self.n_rows and self._slot_of[row] >= 0
@@ -403,6 +449,10 @@ class CoordinateRouting:
                     self._norm = np.concatenate(
                         [self._norm, np.zeros(extra, dtype=np.float32)]
                     )
+                if self._sdelta is not None:
+                    self._sdelta = np.concatenate(
+                        [self._sdelta, np.zeros(extra, dtype=np.float64)]
+                    )
             self.n_rows = n_rows
 
     def unpublish(self, rows: np.ndarray) -> None:
@@ -461,6 +511,7 @@ class CoordinateRouting:
             imp = self.importance_of(adm)
             out["importance_mean"] = float(imp.mean()) if imp.size else 0.0
             out["importance_max"] = float(imp.max()) if imp.size else 0.0
+            out["score_delta"] = self.score_delta
         return out
 
 
@@ -493,6 +544,7 @@ def build_routing(
     device_budget_rows: Optional[int] = None,
     headroom_fraction: float = 0.25,
     eviction_policy: str = "oldest",
+    score_delta: bool = True,
 ) -> RoutingIndex:
     """Routing for a set of RE coordinates (``cid -> n_rows``).
 
@@ -503,7 +555,9 @@ def build_routing(
     (the first ``(1 - headroom_fraction) * budget`` rows — the packed
     table's hot prefix) and admission headroom for the long tail.
     ``eviction_policy`` picks the admission victim rule: ``oldest`` (FIFO,
-    the default) or ``importance`` (evict lowest freq × norm).
+    the default) or ``importance`` (evict lowest importance score);
+    ``score_delta`` additionally tracks measured |score − fe_only| per row
+    under the importance policy (see ``note_score_deltas``).
     """
     coords: Dict[str, CoordinateRouting] = {}
     for cid, n_rows in re_tables.items():
@@ -523,5 +577,6 @@ def build_routing(
             shard_capacity=cap,
             resident_rows=base,
             eviction_policy=eviction_policy,
+            score_delta=score_delta,
         )
     return RoutingIndex(coords)

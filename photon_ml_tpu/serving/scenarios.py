@@ -380,8 +380,12 @@ def make_row_swap_fn(
             + rng.standard_normal((k, dim)).astype(np.float32) * scale
         )
         t0 = time.perf_counter()
-        lead.update_random_effect_rows(cid, rows, values)
-        pause = time.perf_counter() - t0
+        ret = lead.update_random_effect_rows(cid, rows, values)
+        # sharded scorers stage into the spare generation half and return
+        # the request-path blocking seconds (the flip window) — that is
+        # the pause scoring threads actually saw; a None return (the
+        # single-table scorer mutates live tables) keeps wall clock
+        pause = ret if isinstance(ret, float) else time.perf_counter() - t0
         state["generation"] += 1
         if metrics is not None:
             metrics.observe_swap(
@@ -408,6 +412,7 @@ def run_scenario(
     tenancy=None,
     nearline_fn: Optional[Callable[[], object]] = None,
     nearline_interval_s: float = 0.02,
+    overload=None,
 ) -> dict:
     """Drive one scenario through ``replay_requests`` phase by phase and
     return its result document: per-stage p50/p99 breakdown (from the
@@ -425,7 +430,13 @@ def run_scenario(
     which runs concurrently with every ``nearline`` phase the way
     ``swap_fn`` does for hot-swap phases. The result doc then carries
     per-tenant requests/sheds/SLO verdicts, observed variant shares, and
-    the nearline swap ledger."""
+    the nearline swap ledger.
+
+    ``overload`` (an
+    :class:`~photon_ml_tpu.serving.overload.OverloadController`) closes
+    the SLO-burn loop on the non-tenancy path: ``replay_requests``
+    attaches it to the batcher it builds, and the doc carries its final
+    ``status()``."""
     if tenancy is None and scenario.tenants:
         raise ValueError(
             f"scenario {scenario.name!r} declares tenants "
@@ -482,6 +493,7 @@ def run_scenario(
                     max_queue=max_queue,
                     admission=admission,
                     plane=plane,
+                    overload=overload,
                 )
             results.extend(res)
         finally:
@@ -529,6 +541,8 @@ def run_scenario(
         status = tracker.status()
         doc["slo"] = status
         doc["slo_verdict"] = status["verdict"]
+    if overload is not None:
+        doc["overload"] = overload.status()
     if tenancy is not None:
         doc["tenants"] = {}
         flooder = scenario.tenants[0] if scenario.tenants else None
@@ -557,6 +571,18 @@ def run_scenario(
                 if tenant != flooder
             )
             doc["flooding_tenant"] = flooder
+            if tenancy.quota is not None:
+                # the quota gate: the flood was shed onto the FLOODER's
+                # budget only — a shed landing on any other tenant means
+                # the token bucket charged the wrong neighbour
+                qstats = tenancy.quota.stats()["tenants"]
+                doc["flood_shed_ok"] = qstats.get(flooder, {}).get(
+                    "shed", 0
+                ) > 0 and all(
+                    s["shed"] == 0
+                    for t, s in qstats.items()
+                    if t != flooder
+                )
         if nearline_reports:
             doc["nearline"] = {
                 "deltas_applied": sum(

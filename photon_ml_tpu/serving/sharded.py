@@ -6,11 +6,14 @@ cache in front of it — whose per-request scatter fill is exactly the
 compile-storm and host-hop this module removes from the hot path). Here
 each coordinate's table is partitioned across ``S`` shards of a serving
 mesh (``parallel/mesh.py``; the cyclic row layout mirrors the grid
-placement of ``parallel/grid_features.py``), stacked as ONE device array
+placement of ``parallel/grid_features.py``), stacked as a device array
 ``[S, cap+1, dim]`` sharded over its leading axis — so a batch of B
 requests becomes a single jitted two-coordinate gather
 ``table[shard, slot]`` (one gather per shard after XLA partitioning),
-with no host work beyond the O(B) routing-index probe.
+with no host work beyond the O(B) routing-index probe. Each table is
+DOUBLE-BUFFERED (logically ``[2, S, cap+1, dim]``): hot swaps stage into
+the spare generation half and flip an atomic index, so publishing a
+delta never pauses the gather path (see :class:`ShardedReTable`).
 
 Residency semantics, in order of degradation:
 
@@ -93,11 +96,21 @@ def serving_mesh(num_devices: Optional[int] = None):
 class ShardedReTable:
     """One RE coordinate's device storage for one scorer replica.
 
-    Stacked array ``[S, cap+1, dim]``: shard ``s`` holds data slots
-    ``0..cap-1`` plus the permanently-zero cold slot ``cap``. WHERE a row
-    lives is owned by the shared :class:`CoordinateRouting`; this object
-    owns only the bytes (each replica has its own copy of the bytes, all
-    replicas share one routing truth).
+    DOUBLE-BUFFERED stacked array — logically ``[2, S, cap+1, dim]``, held
+    as two independent ``[S, cap+1, dim]`` device arrays (donation into a
+    slice of one stacked array would invalidate the half still being
+    gathered): shard ``s`` holds data slots ``0..cap-1`` plus the
+    permanently-zero cold slot ``cap``. ``table`` always resolves the
+    ACTIVE half via an atomic generation index; hot-swap writes stage into
+    the spare half off the request path and then flip the index
+    (:meth:`update_rows`), so a swap never pauses the gather path. Outside
+    an in-flight :meth:`update_rows` both halves hold identical bytes —
+    steady-state writers (the admission tier) write both. Memory cost: 2x
+    table HBM per coordinate.
+
+    WHERE a row lives is owned by the shared :class:`CoordinateRouting`;
+    this object owns only the bytes (each replica has its own copy of the
+    bytes, all replicas share one routing truth).
 
     The host backing store (the packed artifact table, possibly mmap'd)
     stays authoritative for non-resident rows; hot-swap row updates that
@@ -126,7 +139,9 @@ class ShardedReTable:
         if base:
             r = np.arange(base)
             host[r % S, r // S] = np.asarray(backing[:base], dtype=np.float32)
-        self._table = self._place(host)
+        # both generation halves start converged (identical bytes)
+        self._tables = [self._place(host), self._place(host)]
+        self._gen = 0
 
     def _place(self, host: np.ndarray):
         import jax
@@ -146,9 +161,25 @@ class ShardedReTable:
 
     @property
     def table(self):
-        """Device array [S, cap+1, dim]; slot ``cap`` of every shard is the
-        zero cold slot."""
-        return self._table
+        """ACTIVE generation half — device array [S, cap+1, dim]; slot
+        ``cap`` of every shard is the zero cold slot."""
+        return self._tables[self._gen]
+
+    @property
+    def generation(self) -> int:
+        """Index (0/1) of the active table half."""
+        return self._gen
+
+    @property
+    def spare_gen(self) -> int:
+        """Index of the spare (write-staging) table half."""
+        return 1 - self._gen
+
+    def flip(self) -> None:
+        """Atomically switch the active half. Callers must hold the owning
+        scorer's ``write_lock`` (so no in-flight gather still references
+        the half being retired) — see :meth:`update_rows`."""
+        self._gen = 1 - self._gen
 
     @property
     def cold_slot(self) -> int:
@@ -180,7 +211,11 @@ class ShardedReTable:
     # ------------------------------------------------------------- writing
 
     def write_slots(
-        self, shards: np.ndarray, slots: np.ndarray, values: np.ndarray
+        self,
+        shards: np.ndarray,
+        slots: np.ndarray,
+        values: np.ndarray,
+        gen: Optional[int] = None,
     ) -> None:
         """Scatter rows into (shard, slot) storage — genuinely in place
         (the table buffer is donated to the jitted scatter, no full-table
@@ -189,13 +224,18 @@ class ShardedReTable:
         ``(0, cold_slot)`` with zero values, which keeps the cold slot
         zero and the scatter program count at one.
 
-        Donation invalidates the prior table array object: hold the owning
-        scorer's ``write_lock`` so no in-flight gather still references it.
+        ``gen`` selects the table half (default: active). Donation
+        invalidates the prior half's array object: writes to the ACTIVE
+        half need the owning scorer's ``write_lock`` so no in-flight
+        gather still references it; writes to the SPARE half need only
+        ``routing.lock`` (which keeps the generation index stable and
+        serializes writers) — the request path never captures that half.
         """
         import jax.numpy as jnp
 
-        self._table = _donated_scatter()(
-            self._table,
+        g = self._gen if gen is None else int(gen)
+        self._tables[g] = _donated_scatter()(
+            self._tables[g],
             jnp.asarray(np.asarray(shards, dtype=np.int32)),
             jnp.asarray(np.asarray(slots, dtype=np.int32)),
             jnp.asarray(np.ascontiguousarray(values, dtype=np.float32)),
@@ -206,29 +246,47 @@ class ShardedReTable:
         rows: np.ndarray,
         values: np.ndarray,
         replicas: Optional[Sequence[Tuple[object, "ShardedReTable"]]] = None,
-    ) -> None:
-        """Hot-swap hook: update/append global rows in place. Resident rows
-        are overwritten in their slots; non-resident rows are admitted
-        immediately (allocating headroom slots, evicting the oldest
-        admitted rows when full). Raises only when the coordinate has no
-        headroom left for genuinely new rows.
+    ) -> float:
+        """Hot-swap hook: update/append global rows via a PAUSELESS
+        generation flip. Resident rows are overwritten; non-resident rows
+        are admitted immediately (allocating headroom slots, evicting the
+        oldest admitted rows when full). Raises only when the coordinate
+        has no headroom left for genuinely new rows. Returns the
+        request-path blocking seconds: the width of the flip window during
+        which every replica's ``write_lock`` is held (lock handoff only —
+        no device work happens inside it).
+
+        Three phases, all under ``routing.lock``:
+
+        1. STAGE — pad every write to a power-of-two shape (pads aim zeros
+           at shard 0's cold slot; the donated scatter compiles per shape,
+           so a nearline loop applying variable-size deltas would
+           otherwise trace a fresh program per tick) and scatter it into
+           every replica's SPARE half. No ``write_lock``: the request path
+           gathers only the active half, and ``routing.lock`` keeps every
+           ``_gen`` stable.
+        2. FLIP — acquire EVERY replica's ``write_lock`` (once held, no
+           gather is in flight on any replica) and flip all generation
+           indexes, all-or-nothing. This is the only blocking window and
+           the returned duration. New rows publish() only AFTER the flip:
+           before it, the still-active old half holds the evicted victims'
+           bytes in the reused slots (victims themselves were unpublished
+           inside ``allocate()`` and route FE-only from that moment).
+        3. CONVERGE — replay the same writes into the old (now spare)
+           halves. The flip held every ``write_lock``, so no in-flight
+           gather still references them; afterwards the invariant "both
+           halves identical outside this call" holds again.
 
         ``replicas`` is the multi-scorer fan-out: ``(write_lock, table)``
         pairs for EVERY replica of this coordinate (including this one).
-        Newly admitted rows are written to every replica's device table
-        before the shared routing publishes them — the same
-        write-everywhere-then-publish contract the admission controller
-        upholds, so no replica's scoring thread can route a fresh row to a
-        slot still holding the evicted victim's bytes. Defaults to this
-        table alone with no lock (single-replica callers already hold
-        their scorer's write_lock or run single-threaded).
-
-        The whole sequence runs under ``routing.lock`` so concurrent
-        admission steps and swaps cannot interleave allocate/publish."""
+        Defaults to this table alone with no lock (single-replica callers
+        run single-threaded). ``routing.lock`` (outer) ordering vs
+        ``write_lock`` (inner) is preserved; concurrent admission steps
+        and swaps cannot interleave allocate/publish."""
         rows = np.asarray(rows, dtype=np.int64).ravel()
         values = np.asarray(values, dtype=np.float32).reshape(rows.size, -1)
         if rows.size == 0:
-            return
+            return 0.0
         if replicas is None:
             replicas = [(contextlib.nullcontext(), self)]
         routing = self.routing
@@ -241,21 +299,13 @@ class ShardedReTable:
             for _, table in replicas:
                 for r, v in zip(rows, values):
                     table._overrides[int(r)] = np.array(v, dtype=np.float32)
-            res_slots = routing._slot_of[rows]
-            resident = res_slots >= 0
-            new_rows = np.unique(rows[~resident])
+            eff_slots = routing._slot_of[rows].copy()
+            eff_shards = routing._shard_of[rows].copy()
+            new_rows = np.unique(rows[eff_slots < 0])
+            publish_args = None
+            # (shards, slots, per-replica values) staged to BOTH halves
+            writes: List[Tuple[np.ndarray, np.ndarray, List[np.ndarray]]] = []
             if new_rows.size:
-                # evicted rows are unpublished inside allocate(); their
-                # slots are exactly the ones reused here, so the new
-                # content below overwrites them with no separate zeroing
-                # pass — and publish() runs only after EVERY replica holds
-                # the bytes. Writes are padded to power-of-two shapes
-                # (pads aim zeros at shard 0's cold slot, the admission
-                # tier's idiom): the donated scatter compiles per shape,
-                # and a nearline loop applying variable-size deltas every
-                # tick would otherwise trace a fresh program under
-                # routing.lock + write_lock — a multi-hundred-ms stall
-                # for every concurrent scoring thread
                 a_shards, a_slots, _ = routing.allocate(new_rows.size)
                 n = int(new_rows.size)
                 k = _pow2_bucket(n)
@@ -263,29 +313,62 @@ class ShardedReTable:
                 slots = np.full(k, routing.cold_slot, dtype=np.int32)
                 shards[:n] = a_shards
                 slots[:n] = a_slots
-                content = np.zeros((k, values.shape[1]), dtype=np.float32)
-                for lock, table in replicas:
-                    with lock:
-                        content[:n] = table.host_rows(new_rows)
-                        table.write_slots(shards, slots, content)
-                routing.publish(new_rows, a_shards, a_slots)
-                res_slots = routing._slot_of[rows]
-            # only still-resident rows get the in-place write: a row of
-            # this batch evicted to make room stays FE-only until
-            # re-admission (its override already carries the new content)
-            resident = res_slots >= 0
+                per_replica = []
+                for _, table in replicas:
+                    content = np.zeros((k, values.shape[1]), dtype=np.float32)
+                    content[:n] = table.host_rows(new_rows)
+                    per_replica.append(content)
+                writes.append((shards, slots, per_replica))
+                publish_args = (new_rows, a_shards, a_slots)
+                # residency as it will stand after publish(): overlay the
+                # fresh allocations on the current map (victims already
+                # cleared by allocate). A row of THIS batch evicted to
+                # make room stays FE-only until re-admission (its override
+                # already carries the new content).
+                eff_slots = routing._slot_of[rows].copy()
+                eff_shards = routing._shard_of[rows].copy()
+                pos = {int(r): i for i, r in enumerate(new_rows)}
+                for j, r in enumerate(rows):
+                    i = pos.get(int(r))
+                    if i is not None:
+                        eff_slots[j] = a_slots[i]
+                        eff_shards[j] = a_shards[i]
+            resident = eff_slots >= 0
             if resident.any():
                 n = int(resident.sum())
                 k = _pow2_bucket(n)
                 w_shards = np.zeros(k, dtype=np.int32)
                 w_slots = np.full(k, routing.cold_slot, dtype=np.int32)
-                w_shards[:n] = routing._shard_of[rows[resident]]
-                w_slots[:n] = res_slots[resident]
+                w_shards[:n] = eff_shards[resident]
+                w_slots[:n] = eff_slots[resident]
                 w_values = np.zeros((k, values.shape[1]), dtype=np.float32)
                 w_values[:n] = values[resident]
-                for lock, table in replicas:
-                    with lock:
-                        table.write_slots(w_shards, w_slots, w_values)
+                writes.append((w_shards, w_slots, [w_values] * len(replicas)))
+            if not writes:
+                return 0.0
+            # phase 1: stage into every spare half, off the request path
+            for shards, slots, per_replica in writes:
+                for (_, table), content in zip(replicas, per_replica):
+                    table.write_slots(
+                        shards, slots, content, gen=table.spare_gen
+                    )
+            # phase 2: the flip — the only request-path blocking window
+            t0 = time.perf_counter()
+            with contextlib.ExitStack() as stack:
+                for lock, _ in replicas:
+                    stack.enter_context(lock)
+                for _, table in replicas:
+                    table.flip()
+            blocking_s = time.perf_counter() - t0
+            if publish_args is not None:
+                routing.publish(*publish_args)
+            # phase 3: converge the retired halves (now spare)
+            for shards, slots, per_replica in writes:
+                for (_, table), content in zip(replicas, per_replica):
+                    table.write_slots(
+                        shards, slots, content, gen=table.spare_gen
+                    )
+            return blocking_s
 
     def fits(self, targets: np.ndarray) -> bool:
         """Whether a hot-swap touching these global rows stays in-shape:
@@ -336,6 +419,7 @@ class ShardedGameScorer:
         routing: Optional[RoutingIndex] = None,
         headroom_fraction: float = 0.25,
         eviction_policy: str = "oldest",
+        score_delta: bool = True,
     ):
         import jax
         import jax.numpy as jnp
@@ -388,6 +472,7 @@ class ShardedGameScorer:
                 device_budget_rows=device_budget_rows,
                 headroom_fraction=self._headroom_fraction,
                 eviction_policy=eviction_policy,
+                score_delta=score_delta,
             )
         self._routing = routing
         for cid in sorted(artifact.tables):
@@ -433,6 +518,27 @@ class ShardedGameScorer:
             return z, mean_function(task, z)
 
         self._score_fn = jax.jit(_score)
+
+        def _redelta(params, batch):
+            # per-coordinate |RE contribution| = the request's measured
+            # |score − fe_only_score| attributable to that coordinate.
+            # Traced/dispatched ONLY when a routing coordinate tracks
+            # measured score deltas (importance policy + score_delta) —
+            # the default path never pays for it.
+            out = {}
+            for cid, shard, _ in re_specs:
+                vals, idx = batch["shards"][shard]
+                rows = params["re"][cid][
+                    batch["re_shards"][cid], batch["slots"][cid]
+                ]
+                out[cid] = jnp.abs(
+                    (vals * jnp.take_along_axis(rows, idx, axis=1)).sum(
+                        axis=1
+                    )
+                )
+            return out
+
+        self._redelta_fn = jax.jit(_redelta)
 
     # ---------------------------------------------------------- properties
 
@@ -546,14 +652,18 @@ class ShardedGameScorer:
 
     def update_random_effect_rows(
         self, cid: str, rows: np.ndarray, values: np.ndarray
-    ) -> None:
+    ) -> float:
+        """Returns the request-path blocking seconds — the generation-flip
+        window of :meth:`ShardedReTable.update_rows`. Callers doing
+        blackout accounting (hot-swap manager, scenario swappers) subtract
+        the non-blocking staging work from their wall clock."""
         provider = self._providers.get(cid)
         if provider is None:
             raise ValueError(f"{cid!r} is not a random-effect coordinate")
         group = self._replica_group or [self]
         # routing.lock (taken inside update_rows) is the OUTER lock; each
-        # replica's write_lock is taken per device write inside it
-        provider.update_rows(
+        # replica's write_lock is taken only across the generation flip
+        return provider.update_rows(
             rows,
             values,
             replicas=[(s.write_lock, s._providers[cid]) for s in group],
@@ -596,10 +706,13 @@ class ShardedGameScorer:
                     )
                 self._routing.coordinates[cid] = fresh
                 routing = fresh
+            # build the replacement table (device placement of both
+            # generation halves) OUTSIDE write_lock — concurrent scoring
+            # keeps gathering the old provider; only the pointer install
+            # blocks, and only for a reference assignment
+            fresh_provider = ShardedReTable(backing, routing, mesh=self._mesh)
             with self.write_lock:
-                self._providers[cid] = ShardedReTable(
-                    backing, routing, mesh=self._mesh
-                )
+                self._providers[cid] = fresh_provider
             return routing.shard_capacity != old_cap
 
     def restore_random_effect(
@@ -667,6 +780,7 @@ class ShardedGameScorer:
         re_shards: Dict[str, np.ndarray] = {}
         slots: Dict[str, np.ndarray] = {}
         cold: Dict[int, List[str]] = {}
+        sdelta_rows: Dict[str, np.ndarray] = {}
         with span("serve/route", n=n):
             for cid, feature_shard, re_type in self._re_specs:
                 table = artifact.tables[cid]
@@ -711,6 +825,8 @@ class ShardedGameScorer:
                     )
                 else:
                     routing.note_requests(entity_rows[:n])
+                if routing.wants_score_deltas:
+                    sdelta_rows[cid] = entity_rows[:n].copy()
                 if deferred.size and self._admission is not None:
                     self._admission.note_deferred(cid, deferred)
                 # pad rows (and this batch's FE-only rows) gather the zero
@@ -762,6 +878,21 @@ class ShardedGameScorer:
                     stages["dispatch_done"] = time.perf_counter()
                 z_list = np.asarray(z)[:n].tolist()
                 mean_list = np.asarray(mean)[:n].tolist()
+            deltas_host = None
+            if sdelta_rows:
+                # measured importance: the aux gather must run while the
+                # captured params are still valid (a donated write after
+                # the lock would invalidate them)
+                with span("serve/score_delta", n=n):
+                    d = self._redelta_fn(params, batch)
+                    deltas_host = {
+                        cid: np.asarray(d[cid])[:n] for cid in sdelta_rows
+                    }
+        if deltas_host is not None:
+            for cid, rows_arr in sdelta_rows.items():
+                self._routing[cid].note_score_deltas(
+                    rows_arr, deltas_host[cid]
+                )
         if stages is not None:
             stages["device_done"] = time.perf_counter()
         empty: Tuple[str, ...] = ()

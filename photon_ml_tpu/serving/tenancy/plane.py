@@ -104,7 +104,15 @@ class TenancyPlane:
     per-tenant budgets); ``quota``/``router`` are optional — without a
     quota everything admits, without a router everything serves the base
     variant. ``metrics_registry`` adds per-tenant request/shed counters
-    under tenant label scopes."""
+    under tenant label scopes.
+
+    ``quota_mode`` picks WHERE the token bucket is consulted:
+    ``"submit"`` (default, the historical behavior) sheds at the plane's
+    front door, before routing; ``"drain"`` admits everything into the
+    per-variant batchers and lets each batcher consult the quota as
+    buckets seal — an over-budget tenant's requests then drop out of the
+    padded bucket at the last moment (charged to that tenant via the
+    plane) instead of being rejected while device slots sit idle."""
 
     def __init__(
         self,
@@ -117,11 +125,17 @@ class TenancyPlane:
         max_wait_s: float = 0.002,
         default_tenant: str = "default",
         metrics_registry=None,
+        quota_mode: str = "submit",
     ):
+        if quota_mode not in ("submit", "drain"):
+            raise ValueError(
+                f"quota_mode must be 'submit' or 'drain', got {quota_mode!r}"
+            )
         self.registry = registry
         self.router = router if router is not None else VariantRouter()
         self.plane = plane
         self.quota = quota
+        self.quota_mode = quota_mode
         self._metrics = metrics
         self._bucket_sizes = tuple(bucket_sizes)
         self._max_wait_s = max_wait_s
@@ -147,6 +161,11 @@ class TenancyPlane:
                         metrics=self._metrics,
                         max_wait_s=self._max_wait_s,
                         plane=self.plane,
+                        quota=(
+                            self.quota
+                            if self.quota_mode == "drain"
+                            else None
+                        ),
                     )
                     self._batchers[variant_id] = b
         return b
@@ -173,7 +192,11 @@ class TenancyPlane:
         self.tenant_submitted[tenant] = (
             self.tenant_submitted.get(tenant, 0) + 1
         )
-        if self.quota is not None and not self.quota.try_admit(tenant):
+        if (
+            self.quota is not None
+            and self.quota_mode == "submit"
+            and not self.quota.try_admit(tenant)
+        ):
             self.tenant_shed[tenant] = self.tenant_shed.get(tenant, 0) + 1
             if self.plane is not None:
                 self.plane.observe_tenant_errors(tenant, 1)
@@ -210,7 +233,7 @@ class TenancyPlane:
         submitted = self.tenant_submitted
         for tenant, n in Counter(tenants).items():
             submitted[tenant] = submitted.get(tenant, 0) + n
-        quota = self.quota
+        quota = self.quota if self.quota_mode == "submit" else None
         if quota is not None:
             kept: List[ScoreRequest] = []
             kept_tenants: List[str] = []

@@ -365,9 +365,26 @@ class VariantRegistry:
             )
 
         # --------------- the variant's blackout: shared-table writes ----
+        # blackout_s is request-path blocking time: sharded leads stage
+        # into the spare generation half and return only the flip window
+        # (see ShardedReTable.update_rows); a None return (single-table
+        # lead) keeps wall-clock accounting.
         t0 = time.perf_counter()
+        nonblocking_s = 0.0
         for cid, (targets, values) in write_plan.items():
-            self.lead.update_random_effect_rows(cid, targets, values)
+            u0 = time.perf_counter()
+            ret = self.lead.update_random_effect_rows(cid, targets, values)
+            if isinstance(ret, float):
+                nonblocking_s += max(0.0, (time.perf_counter() - u0) - ret)
+            routing = getattr(self.lead, "routing", None)
+            if routing is not None and cid in routing:
+                # importance plane: a freshly claimed overlay row enters
+                # with zero request frequency and would be the first
+                # eviction victim despite being this variant's only copy —
+                # seed the claim as one request so freq × norm ranks it
+                # like any just-requested row (note_row_norms already ran
+                # inside update_rows). No-op under the default policy.
+                routing[cid].note_requests(targets)
         new_state = VariantState(
             variant_id=variant_id,
             generation=state.generation + 1,
@@ -383,7 +400,7 @@ class VariantRegistry:
             rollbacks=state.rollbacks,
         )
         self._states[variant_id] = new_state
-        blackout_s = time.perf_counter() - t0
+        blackout_s = max(0.0, time.perf_counter() - t0 - nonblocking_s)
         # ----------------------------------------------------------------
 
         validation_metric: Optional[float] = None

@@ -429,6 +429,72 @@ register_knob(KnobSpec(
 ))
 
 register_knob(KnobSpec(
+    name="serve.overload_burn_high",
+    kind="float",
+    default=1.0,
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "metric:serving.overload.burn_rate",
+        "metric:serving.overload.active",
+        "metric:serving.slo.burn_rate",
+        "metric:serving.latency_p99_ms",
+    ),
+    candidates=(0.8, 1.0, 1.5, 2.0),
+    description=(
+        "SLO burn rate at which closed-loop overload control engages "
+        "(serve_game --overload-burn-high): batch deadlines shrink and "
+        "FE-only-able requests are answered on the host without "
+        "queueing. 1.0 means the error budget burns exactly as fast as "
+        "it accrues; lower engages earlier (more shedding, tighter "
+        "tail), higher tolerates short bursts before actuating."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="serve.overload_shrink",
+    kind="float",
+    default=0.5,
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "metric:serving.overload.deadline_scale",
+        "metric:serving.batch_fill_ratio",
+        "metric:serving.latency_p99_ms",
+    ),
+    candidates=(0.25, 0.5, 0.75),
+    description=(
+        "Batch-deadline multiplier applied while overloaded (serve_game "
+        "--overload-shrink): smaller buckets dispatch sooner, trading "
+        "batch fill for queue wait exactly when queue wait is burning "
+        "the latency budget. Too small wastes device dispatches on "
+        "near-empty buckets; 0.5 halves the deadline."
+    ),
+))
+
+register_knob(KnobSpec(
+    name="serve.score_delta_importance",
+    kind="bool",
+    default=True,
+    applies_to="serve",
+    phase="serving",
+    metric_deps=(
+        "metric:serving.device_resident_rate",
+        "metric:serving.eviction.importance",
+        "metric:serving.importance.mean",
+    ),
+    candidates=(False, True),
+    description=(
+        "Fold each entity's observed |score - FE-only score| EWMA into "
+        "the importance eviction score (with serve.eviction_policy="
+        "importance): rows whose random-effect correction actually "
+        "moves scores stay resident even at modest request frequency. "
+        "Off reverts to frequency x coefficient-norm alone. No effect "
+        "under the 'oldest' policy (the delta pass never runs there)."
+    ),
+))
+
+register_knob(KnobSpec(
     name="train.engine",
     kind="str",
     default="auto",

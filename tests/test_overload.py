@@ -1,0 +1,455 @@
+"""Closed-loop overload control + priority lanes + drain-time quota.
+
+The load-bearing guarantees:
+
+- hysteresis: burn >= burn_high engages (deadline shrink + shed), burn
+  inside the band holds state, burn <= burn_low releases and restores
+  the native deadline;
+- FE-only shed answers ONLY requests whose every RE entity is absent or
+  non-resident, with the same FE-only score the full path produces, and
+  never sheds a resident entity;
+- priority lanes: background submissions never drain ahead of pending
+  live requests, in both the sealed and the continuous batcher;
+- drain-time quota: an over-budget tenant's requests drop out at the
+  bucket boundary, charged to that tenant, while other tenants' requests
+  score normally.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.indexmap import DefaultIndexMap
+from photon_ml_tpu.serving import (
+    ContinuousBatcher,
+    MicroBatcher,
+    OverloadController,
+    ScoreRequest,
+    ServingArtifact,
+    ServingTable,
+    ShardedGameScorer,
+)
+from photon_ml_tpu.serving.tenancy import TenantQuota
+from photon_ml_tpu.serving.tenancy.quota import TenantBudget
+from photon_ml_tpu.types import TaskType
+
+N_ENT = 32
+D_RE = 4
+D_FE = 8
+MAX_NNZ = {"global": 4, "per_user": D_RE}
+
+
+def _artifact(seed=5):
+    rng = np.random.default_rng(seed)
+    return ServingArtifact(
+        task=TaskType.LOGISTIC_REGRESSION,
+        tables={
+            "fixed": ServingTable(
+                feature_shard="global", random_effect_type=None,
+                weights=(rng.standard_normal(D_FE) * 0.1).astype(np.float32),
+            ),
+            "per_user": ServingTable(
+                feature_shard="per_user", random_effect_type="userId",
+                weights=(
+                    rng.standard_normal((N_ENT, D_RE)) * 0.3
+                ).astype(np.float32),
+                entity_index=DefaultIndexMap(
+                    {f"u{i}": i for i in range(N_ENT)}
+                ),
+            ),
+        },
+        model_name="overload-test",
+    )
+
+
+def _request(i, entity="u1", tenant=None):
+    rid = f"r{i}" if tenant is None else f"{tenant}!r{i}"
+    ids = {} if entity is None else {"userId": entity}
+    return ScoreRequest(
+        request_id=rid,
+        features={
+            "global": {0: 1.0, 2: -0.5},
+            "per_user": {j: 0.25 * (j + 1) for j in range(D_RE)},
+        },
+        entity_ids=ids,
+        offset=0.1 * i,
+    )
+
+
+class FakeSLO:
+    def __init__(self, burn=0.0):
+        self.burn = burn
+
+    def status(self):
+        return {"burn_rate": self.burn}
+
+
+class FakeBatcher:
+    def __init__(self, max_wait_s=0.004):
+        self.max_wait_s = max_wait_s
+
+
+class TestHysteresis:
+    def test_engage_hold_release(self):
+        slo = FakeSLO(0.0)
+        ctrl = OverloadController(
+            slo, shrink_factor=0.5, burn_high=1.0, burn_low=0.5
+        )
+        b = FakeBatcher(0.004)
+        ctrl.attach(b)
+        assert b._overload is ctrl
+        assert ctrl.poll() is False
+        assert b.max_wait_s == 0.004
+
+        slo.burn = 1.2
+        assert ctrl.poll() is True
+        assert b.max_wait_s == pytest.approx(0.002)
+        assert ctrl.activations == 1
+
+        # inside the hysteresis band: state holds
+        slo.burn = 0.7
+        assert ctrl.poll() is True
+        assert b.max_wait_s == pytest.approx(0.002)
+        assert ctrl.activations == 1
+
+        slo.burn = 0.3
+        assert ctrl.poll() is False
+        assert b.max_wait_s == 0.004
+        assert ctrl.recoveries == 1
+
+    def test_attach_mid_overload_shrinks_immediately(self):
+        ctrl = OverloadController(FakeSLO(2.0), shrink_factor=0.25)
+        ctrl.poll()
+        b = FakeBatcher(0.008)
+        ctrl.attach(b)
+        assert b.max_wait_s == pytest.approx(0.002)
+        ctrl.detach(b)
+        assert b.max_wait_s == 0.008
+        assert b._overload is None
+
+    def test_stop_restores_deadlines(self):
+        ctrl = OverloadController(FakeSLO(5.0))
+        b = FakeBatcher(0.004)
+        ctrl.attach(b)
+        ctrl.poll()
+        assert b.max_wait_s < 0.004
+        ctrl.stop()
+        assert b.max_wait_s == 0.004
+        assert ctrl.active is False
+
+    def test_maybe_poll_rate_limits(self):
+        clock = {"t": 0.0}
+        slo = FakeSLO(2.0)
+        ctrl = OverloadController(
+            slo, poll_interval_s=1.0, clock=lambda: clock["t"]
+        )
+        ctrl.maybe_poll()
+        assert ctrl.active is True
+        slo.burn = 0.0
+        ctrl.maybe_poll()  # within the interval: no state change
+        assert ctrl.active is True
+        clock["t"] = 1.5
+        ctrl.maybe_poll()
+        assert ctrl.active is False
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            OverloadController(FakeSLO(), shrink_factor=0.0)
+        with pytest.raises(ValueError):
+            OverloadController(FakeSLO(), burn_high=0.5, burn_low=1.0)
+
+
+class TestFeOnlyShed:
+    def _controller(self, scorer, burn=2.0):
+        ctrl = OverloadController(FakeSLO(burn))
+        ctrl.attach_scorer(scorer)
+        ctrl.poll()
+        return ctrl
+
+    def test_sheds_ghost_entity_with_fe_only_score(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        ctrl = self._controller(scorer)
+        req = _request(0, entity="nobody")
+        shed = ctrl.try_shed(req)
+        assert shed is not None
+        assert shed.cold_coordinates == ("per_user",)
+        want = scorer.score_batch([req], bucket_size=1)[0]
+        assert shed.score == pytest.approx(want.score, rel=1e-5)
+        assert shed.mean == pytest.approx(want.mean, rel=1e-5)
+        assert ctrl.shed_total == 1
+
+    def test_sheds_idless_request(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        ctrl = self._controller(scorer)
+        assert ctrl.try_shed(_request(1, entity=None)) is not None
+
+    def test_refuses_resident_entity(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        ctrl = self._controller(scorer)
+        assert ctrl.try_shed(_request(2, entity="u3")) is None
+        assert ctrl.shed_total == 0
+
+    def test_sheds_non_resident_known_entity(self):
+        # budget 8 -> only the base rows are resident; u30 is known but
+        # non-resident, so the full path scores it FE-only anyway
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2,
+            device_budget_rows=8,
+        )
+        ctrl = self._controller(scorer)
+        req = _request(3, entity="u30")
+        shed = ctrl.try_shed(req)
+        assert shed is not None
+        want = scorer.score_batch([req], bucket_size=1)[0]
+        assert want.cold_coordinates  # fixture sanity: FE-only either way
+        assert shed.score == pytest.approx(want.score, rel=1e-5)
+
+    def test_no_shed_when_inactive(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        ctrl = self._controller(scorer, burn=0.0)
+        assert ctrl.active is False
+        assert ctrl.try_shed(_request(4, entity="nobody")) is None
+
+    def test_continuous_batcher_sheds_at_submit(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        ctrl = self._controller(scorer)
+        reqs = [
+            _request(i, entity="nobody" if i % 2 else "u2")
+            for i in range(8)
+        ]
+        with ContinuousBatcher(
+            scorer, bucket_sizes=(4,), max_wait_s=0.001
+        ) as cb:
+            ctrl.attach(cb)
+            handles = cb.submit_many(reqs)
+            cb.flush()
+            results = [h.result(timeout=5) for h in handles]
+        assert ctrl.shed_total == 4
+        want = scorer.score_batch(reqs, bucket_size=8)
+        for got, w, req in zip(results, want, reqs):
+            assert got.request_id == req.request_id
+            if req.entity_ids.get("userId") == "u2":
+                assert got.score == w.score  # device path: bitwise
+            else:
+                assert got.score == pytest.approx(w.score, rel=1e-5)
+
+
+class TestPriorityLanes:
+    def test_micro_batcher_live_drains_before_background(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        order = []
+        real = scorer.score_batch
+
+        def spy(requests, bucket_size, **kw):
+            order.extend(r.request_id for r in requests)
+            return real(requests, bucket_size, **kw)
+
+        scorer.score_batch = spy
+        mb = MicroBatcher(scorer, bucket_sizes=(4,), max_wait_s=10.0)
+        mb.submit_many(
+            [_request(i) for i in range(2)], priority="background"
+        )
+        assert mb.queue_depth == 2  # below a bucket: nothing drained
+        mb.submit_many([_request(10 + i) for i in range(2)])
+        out = mb.flush()
+        assert len(out) == 4
+        # live requests sealed first, background rode the later bucket
+        assert order[:2] == ["r10", "r11"]
+        assert order[2:4] == ["r0", "r1"]
+
+    def test_micro_batcher_full_background_bucket_waits_for_live(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        mb = MicroBatcher(scorer, bucket_sizes=(2,), max_wait_s=10.0)
+        mb._pending.append((_request(0), mb._clock()))  # one live waiting
+        out = mb.submit_many(
+            [_request(1), _request(2)], priority="background"
+        )
+        # a full background bucket must NOT seal ahead of pending live
+        assert out == []
+        assert len(mb._pending_bg) == 2
+        out = mb.submit(_request(3))  # completes the live bucket
+        assert [r.request_id for r in out][:2] == ["r0", "r3"]
+
+    def test_micro_batcher_poll_drains_background_when_live_empty(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        clock = {"t": 0.0}
+        mb = MicroBatcher(
+            scorer, bucket_sizes=(4,), max_wait_s=0.5,
+            clock=lambda: clock["t"],
+        )
+        mb.submit_many([_request(0)], priority="background")
+        assert mb.poll(now=0.1) == []
+        clock["t"] = 1.0
+        out = mb.poll(now=1.0)
+        assert [r.request_id for r in out] == ["r0"]
+
+    def test_continuous_batcher_background_lane(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        with ContinuousBatcher(
+            scorer, bucket_sizes=(4,), max_wait_s=0.001
+        ) as cb:
+            bg = cb.submit_many(
+                [_request(i) for i in range(3)], priority="background"
+            )
+            live = cb.submit_many([_request(10)])
+            cb.flush()
+            for h in bg + live:
+                assert h.result(timeout=5) is not None
+        assert cb.queue_depth == 0
+
+    def test_rejects_unknown_priority(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        mb = MicroBatcher(scorer, bucket_sizes=(4,))
+        with pytest.raises(ValueError):
+            mb.submit(_request(0), priority="urgent")
+
+
+class TestDrainTimeQuota:
+    def _quota(self, flooder_budget=2):
+        return TenantQuota({
+            "acme": TenantBudget(rate=0.001, burst=flooder_budget),
+            "zen": TenantBudget(rate=0.001, burst=100),
+        })
+
+    def test_micro_batcher_drops_over_budget_tenant_at_drain(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        quota = self._quota(flooder_budget=2)
+        mb = MicroBatcher(scorer, bucket_sizes=(4,), quota=quota)
+        reqs = [
+            _request(i, tenant="acme" if i % 2 else "zen")
+            for i in range(8)
+        ]
+        out = mb.submit_many(reqs)
+        out.extend(mb.flush())
+        # acme offered 4, budget 2 -> 2 shed; zen all served
+        assert len(out) == 6
+        assert mb.quota_shed_total == 2
+        stats = quota.stats()["tenants"]
+        assert stats["acme"]["shed"] == 2
+        assert stats["zen"]["shed"] == 0
+
+    def test_continuous_batcher_resolves_shed_handles_with_error(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        quota = self._quota(flooder_budget=1)
+        with ContinuousBatcher(
+            scorer, bucket_sizes=(4,), max_wait_s=0.001, quota=quota
+        ) as cb:
+            handles = cb.submit_many(
+                [_request(i, tenant="acme") for i in range(4)]
+            )
+            cb.flush()
+            ok, shed = 0, 0
+            for h in handles:
+                try:
+                    h.result(timeout=5)
+                    ok += 1
+                except RuntimeError:
+                    shed += 1
+        assert ok == 1 and shed == 3
+        assert cb.quota_shed_total == 3
+
+    def test_untagged_requests_bypass_quota(self):
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        quota = TenantQuota({"acme": TenantBudget(rate=0.001, burst=1)})
+        mb = MicroBatcher(scorer, bucket_sizes=(4,), quota=quota)
+        out = mb.submit_many([_request(i) for i in range(4)])
+        assert len(out) == 4
+        assert mb.quota_shed_total == 0
+
+    def test_tenancy_plane_drain_mode(self):
+        from photon_ml_tpu.serving import TenancyPlane, VariantRegistry
+        from photon_ml_tpu.serving.tenancy import tag_requests
+
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        registry = VariantRegistry([scorer])
+        quota = self._quota(flooder_budget=2)
+        plane = TenancyPlane(
+            registry, quota=quota, bucket_sizes=(4,),
+            quota_mode="drain",
+        )
+        acme = tag_requests([_request(i) for i in range(4)], "acme")
+        zen = tag_requests([_request(100 + i) for i in range(4)], "zen")
+        results = plane.replay([*acme, *zen], poll_every=0)
+        # submit-time admission is OFF in drain mode: sheds happen at the
+        # bucket boundary and land in the quota's own ledger
+        assert len(results) == 6
+        assert quota.stats()["tenants"]["acme"]["shed"] == 2
+        assert plane.tenant_shed == {}
+
+    def test_tenancy_plane_rejects_bad_mode(self):
+        from photon_ml_tpu.serving import TenancyPlane, VariantRegistry
+
+        scorer = ShardedGameScorer(
+            _artifact(), max_nnz=MAX_NNZ, num_shards=2
+        )
+        with pytest.raises(ValueError):
+            TenancyPlane(
+                VariantRegistry([scorer]), quota_mode="sideways"
+            )
+
+
+class TestObservability:
+    def test_gauges_written_on_poll(self):
+        class Reg:
+            def __init__(self):
+                self.vals = {}
+
+            def gauge(self, name, v):
+                self.vals[name] = v
+
+        reg = Reg()
+        ctrl = OverloadController(FakeSLO(1.5), registry=reg)
+        ctrl.poll()
+        assert reg.vals["serving.overload.burn_rate"] == 1.5
+        assert reg.vals["serving.overload.active"] == 1.0
+        assert reg.vals["serving.overload.deadline_scale"] == 0.5
+        assert reg.vals["serving.overload.shed_total"] == 0.0
+
+    def test_status_doc(self):
+        ctrl = OverloadController(FakeSLO(2.0))
+        ctrl.poll()
+        doc = ctrl.status()
+        assert doc["active"] is True
+        assert doc["last_burn_rate"] == 2.0
+        assert doc["activations"] == 1
+        assert doc["shed_total"] == 0
+
+    def test_background_poller_start_stop(self):
+        slo = FakeSLO(2.0)
+        ctrl = OverloadController(slo, poll_interval_s=0.005)
+        with ctrl:
+            deadline = time.monotonic() + 2.0
+            while not ctrl.active and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert ctrl.active is True
+        assert ctrl.active is False

@@ -818,3 +818,248 @@ class TestCoordinatedHotSwap:
         after = scorer.score_batch(reqs, bucket_size=16)
         for b, a in zip(before, after):
             assert b.score == a.score
+
+
+class TestPauselessFlip:
+    """Generation-flip hot swap: the double-buffered device table stages
+    candidate rows into the spare half off the request path and blocks
+    scoring only for the atomic flip. Acceptance: bitwise score parity
+    through a flip (under concurrent scoring), bitwise rollback parity,
+    and converged halves after every update."""
+
+    def _halves(self, scorer, cid="per_user"):
+        p = scorer._providers[cid]
+        return np.asarray(p._tables[0]), np.asarray(p._tables[1])
+
+    def test_update_returns_blocking_seconds_and_flips(self):
+        artifact = _artifact()
+        scorer = ShardedGameScorer(artifact, max_nnz=MAX_NNZ, num_shards=2)
+        provider = scorer._providers["per_user"]
+        gen_before = provider.generation
+        t0 = time.perf_counter()
+        ret = scorer.update_random_effect_rows(
+            "per_user", np.array([3, 7]),
+            np.full((2, D_RE), 1.25, dtype=np.float32),
+        )
+        wall = time.perf_counter() - t0
+        assert isinstance(ret, float)
+        assert 0.0 <= ret <= wall
+        assert provider.generation == 1 - gen_before
+        a, b = self._halves(scorer)
+        np.testing.assert_array_equal(a, b)  # phase-3 convergence
+
+    def test_flip_parity_under_concurrent_scoring(self):
+        """A scoring thread hammers score_batch while the main thread
+        applies row updates; every drained batch must be bitwise equal to
+        a reference scorer that saw the same updates synchronously —
+        a gather must never observe a half-written table."""
+        artifact = _artifact()
+        sharded = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2
+        )
+        ref = GameScorer(_artifact(), max_nnz=MAX_NNZ)
+        reqs = _requests(16, seed=21)
+        stop = threading.Event()
+        errors = []
+
+        def _hammer():
+            while not stop.is_set():
+                try:
+                    sharded.score_batch(reqs, bucket_size=16)
+                except BaseException as e:  # pragma: no cover
+                    errors.append(e)
+                    return
+
+        t = threading.Thread(target=_hammer)
+        t.start()
+        rng = np.random.default_rng(11)
+        try:
+            for _ in range(8):
+                rows = np.unique(rng.integers(0, N_ENT, size=6))
+                values = rng.standard_normal(
+                    (rows.size, D_RE)
+                ).astype(np.float32)
+                sharded.update_random_effect_rows("per_user", rows, values)
+                ref.update_random_effect_rows("per_user", rows, values)
+        finally:
+            stop.set()
+            t.join()
+        assert not errors
+        want = ref.score_batch(reqs, bucket_size=16)
+        got = sharded.score_batch(reqs, bucket_size=16)
+        for g, w in zip(got, want):
+            assert g.score == w.score  # bitwise, not allclose
+            assert g.mean == w.mean
+        a, b = self._halves(sharded)
+        np.testing.assert_array_equal(a, b)
+
+    def test_flip_parity_sealed_and_continuous_batchers(self):
+        """Both serving paths (sealed MicroBatcher, continuous batcher)
+        observe identical post-flip scores."""
+        from photon_ml_tpu.serving import MicroBatcher
+
+        artifact = _artifact()
+        sharded = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2
+        )
+        rows = np.array([2, 5, 9])
+        values = np.full((3, D_RE), -0.75, dtype=np.float32)
+        sharded.update_random_effect_rows("per_user", rows, values)
+        reqs = _requests(8, seed=33)
+        want = sharded.score_batch(reqs, bucket_size=8)
+        sealed = MicroBatcher(sharded, bucket_sizes=(8,))
+        got_sealed = sealed.submit_many(reqs)
+        with ContinuousBatcher(
+            sharded, bucket_sizes=(8,), max_wait_s=0.001
+        ) as cb:
+            handles = cb.submit_many(reqs)
+            cb.flush()
+            got_cont = [h.result(timeout=5) for h in handles]
+        for out in (got_sealed, got_cont):
+            assert len(out) == len(want)
+            by_id = {r.request_id: r for r in out}
+            for w in want:
+                assert by_id[w.request_id].score == w.score
+
+    def test_rollback_flip_back_parity(self):
+        """apply_delta then rollback restores the exact pre-swap scores
+        (the inverse update stages into the spare half and flips back),
+        and both halves converge again."""
+        from photon_ml_tpu.incremental.delta import build_delta
+
+        artifact = _artifact()
+        scorer = ShardedGameScorer(artifact, max_nnz=MAX_NNZ, num_shards=2)
+        manager = HotSwapManager(scorer)
+        reqs = [
+            ScoreRequest(
+                request_id=r.request_id, features=r.features,
+                entity_ids={"userId": "u3" if i % 2 else "u9"},
+                offset=r.offset,
+            )
+            for i, r in enumerate(_requests(16, seed=41))
+        ]
+        before = scorer.score_batch(reqs, bucket_size=16)
+        delta = build_delta(
+            {"per_user": {"u3": {0: 4.0}, "u9": {1: -2.0}}},
+            artifact,
+            generation=1,
+        )
+        report = manager.apply_delta(delta)
+        assert not report.rolled_back
+        mid = scorer.score_batch(reqs, bucket_size=16)
+        assert any(m.score != b.score for m, b in zip(mid, before))
+        manager.rollback()
+        after = scorer.score_batch(reqs, bucket_size=16)
+        for b, a in zip(before, after):
+            assert b.score == a.score  # bitwise rollback parity
+        a0, a1 = self._halves(scorer)
+        np.testing.assert_array_equal(a0, a1)
+
+    def test_multi_replica_flip_is_all_or_nothing(self):
+        """All replicas flip generations together under the replica-group
+        update; their halves converge and scores agree bitwise."""
+        artifact = _artifact()
+        routing = None
+        scorers = []
+        for _ in range(2):
+            s = ShardedGameScorer(
+                artifact, max_nnz=MAX_NNZ, num_shards=2, routing=routing
+            )
+            routing = s.routing
+            scorers.append(s)
+        scorers[0].set_replica_group(scorers)
+        gens_before = [
+            s._providers["per_user"].generation for s in scorers
+        ]
+        scorers[0].update_random_effect_rows(
+            "per_user", np.array([4]),
+            np.full((1, D_RE), 2.5, dtype=np.float32),
+        )
+        for s, g in zip(scorers, gens_before):
+            assert s._providers["per_user"].generation == 1 - g
+            h0, h1 = self._halves(s)
+            np.testing.assert_array_equal(h0, h1)
+        reqs = _requests(8, seed=51)
+        a = scorers[0].score_batch(reqs, bucket_size=8)
+        b = scorers[1].score_batch(reqs, bucket_size=8)
+        for x, y in zip(a, b):
+            assert x.score == y.score
+
+
+class TestScoreDeltaImportance:
+    """Satellite: per-entity |score - FE-only score| EWMA folded into the
+    importance eviction signal."""
+
+    def test_score_deltas_accumulate_under_importance(self):
+        artifact = _artifact()
+        scorer = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2,
+            eviction_policy="importance",
+        )
+        routing = scorer.routing["per_user"]
+        assert routing.wants_score_deltas
+        reqs = [
+            ScoreRequest(
+                request_id=f"d{i}",
+                features={
+                    "global": {0: 1.0},
+                    "per_user": {j: 1.0 for j in range(D_RE)},
+                },
+                entity_ids={"userId": "u7"},
+            )
+            for i in range(8)
+        ]
+        scorer.score_batch(reqs, bucket_size=8)
+        assert routing._sdelta is not None
+        assert routing._sdelta[7] > 0.0
+        # the fold-in lifts importance above the freq x norm bound alone
+        bound = routing._freq[np.array([7])] * np.maximum(
+            routing._norm[np.array([7])].astype(np.float64), 1e-12
+        )
+        imp = routing.importance_of(np.array([7]))
+        assert imp[0] >= bound[0]
+        assert imp[0] >= routing._sdelta[7]
+
+    def test_oldest_policy_never_runs_delta_pass(self):
+        """Default 'oldest' routing wants no deltas: the aux jit never
+        runs and scores are bitwise identical with score_delta on/off."""
+        artifact = _artifact()
+        reqs = _requests(16, seed=61)
+        on = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2, score_delta=True
+        )
+        off = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2, score_delta=False
+        )
+        assert not on.routing["per_user"].wants_score_deltas
+        a = on.score_batch(reqs, bucket_size=16)
+        b = off.score_batch(reqs, bucket_size=16)
+        for x, y in zip(a, b):
+            assert x.score == y.score
+            assert x.mean == y.mean
+
+    def test_score_delta_off_reverts_to_freq_norm(self):
+        artifact = _artifact()
+        scorer = ShardedGameScorer(
+            artifact, max_nnz=MAX_NNZ, num_shards=2,
+            eviction_policy="importance", score_delta=False,
+        )
+        routing = scorer.routing["per_user"]
+        assert not routing.wants_score_deltas
+        assert routing._sdelta is None
+        scorer.score_batch(_requests(8, seed=71), bucket_size=8)
+        # importance_of still works on the freq x norm bound
+        imp = routing.importance_of(np.arange(4))
+        assert imp.shape == (4,)
+
+    def test_decay_halves_sdelta_with_freq(self):
+        routing = CoordinateRouting(
+            n_rows=8, num_shards=1, shard_capacity=8,
+            eviction_policy="importance",
+        )
+        rows = np.array([1, 2])
+        routing.note_score_deltas(rows, np.array([4.0, 8.0]))
+        before = routing._sdelta[rows].copy()
+        for _ in range(CoordinateRouting.FREQ_DECAY_EVERY):
+            routing.note_requests(np.array([0]))
+        assert np.all(routing._sdelta[rows] <= before / 2 + 1e-12)

@@ -565,3 +565,34 @@ class TestTenancyScenarios:
             run_scenario(
                 scenario, [_scorer()], BUCKETS, ServingMetrics()
             )
+
+
+class TestOverlayAdmissionSeed:
+    def test_overlay_rows_seed_request_frequency(self):
+        """A freshly claimed overlay row must not be the importance
+        plane's first eviction victim: the claim seeds one request of
+        frequency so ``freq x norm`` ranks it like a just-requested
+        row."""
+        art = _artifact()
+        scorer = _scorer(art, eviction_policy="importance")
+        reg = VariantRegistry(scorer)
+        reg.add_variant("v1")
+        touched = ["u3", "u5"]
+        reg.apply_delta(
+            "v1", build_delta(_delta_for(art, touched), art, generation=1)
+        )
+        coord = scorer.routing["per_user"]
+        for eid in touched:
+            row = reg.state("v1").overlay_rows["per_user"][eid]
+            assert coord._freq[row] > 0.0, eid
+            assert coord.importance_of(np.array([row]))[0] > 0.0
+
+    def test_default_policy_overlay_seed_is_noop(self):
+        art = _artifact()
+        scorer = _scorer(art)  # "oldest": no frequency plane at all
+        reg = VariantRegistry(scorer)
+        reg.add_variant("v1")
+        reg.apply_delta(
+            "v1", build_delta(_delta_for(art, ["u2"]), art, generation=1)
+        )
+        assert scorer.routing["per_user"]._freq is None
