@@ -270,6 +270,10 @@ class CoordinateDescent:
         if schedule:
             tracker.record_schedule(outer, cid, schedule)
             coord.last_schedule_decisions = None
+        residency = getattr(coord, "last_residency_decisions", None)
+        if residency:
+            tracker.record_residency(outer, cid, residency)
+            coord.last_residency_decisions = None
         cluster_events = getattr(coord, "last_cluster_events", None)
         if cluster_events:
             tracker.record_cluster(outer, cid, cluster_events)

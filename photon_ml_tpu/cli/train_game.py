@@ -247,6 +247,23 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "held-out metric in far fewer block visits on "
                         "skewed data; off is bitwise-identical to the "
                         "historical shuffle order")
+    p.add_argument("--resident-blocks", type=int, default=0, metavar="N",
+                   help="streaming: pin up to N top-duality-gap blocks' "
+                        "device uploads across passes (the HBM level of "
+                        "the disk->RAM->HBM residency hierarchy, "
+                        "docs/SCALING.md). Warm passes re-upload only the "
+                        "non-resident remainder, cutting H2D bytes by "
+                        "resident/total with an unchanged solve "
+                        "trajectory; the set re-pins between passes as "
+                        "gap mass shifts. 0 = off (bitwise-identical "
+                        "streaming). Costs N x block upload bytes of "
+                        "device memory")
+    p.add_argument("--resident-bytes", type=int, default=None, metavar="B",
+                   help="streaming: cap the resident set by device BYTES "
+                        "instead of (or in addition to) --resident-blocks; "
+                        "the tighter budget wins. The per-block unit is "
+                        "the fixed block upload size, so B buys "
+                        "B // block_upload_bytes pinned blocks")
     p.add_argument("--hosts", type=int, default=0, metavar="N",
                    help="cluster: run the streamed fixed-effect solve "
                         "data-parallel across N coordinated worker "
@@ -313,6 +330,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
             "--stream-mode stochastic (full-batch mode must visit every "
             "block per pass to stay exact)"
         )
+    if args.resident_blocks < 0:
+        p.error("--resident-blocks must be >= 0")
+    if args.resident_bytes is not None and args.resident_bytes < 1:
+        p.error("--resident-bytes must be >= 1")
+    residency_on = args.resident_blocks > 0 or args.resident_bytes is not None
+    if residency_on and not args.streaming:
+        p.error("--resident-blocks/--resident-bytes require --streaming "
+                "(they pin streamed block uploads)")
+    if residency_on and args.stream_mode == "stochastic" and not args.gap_schedule:
+        p.error("--resident-blocks/--resident-bytes with --stream-mode "
+                "stochastic require --gap-schedule (the scheduler's gap "
+                "feedback picks the resident set)")
+    if residency_on and args.hosts > 0:
+        p.error("--resident-blocks/--resident-bytes do not compose with "
+                "--hosts (cluster workers own their blocks' device "
+                "placement)")
     if args.hosts < 0:
         p.error("--hosts must be >= 0")
     if args.hosts > 0 and (not args.streaming or args.stream_mode != "full"):
@@ -975,6 +1008,8 @@ def run(args: argparse.Namespace) -> GameFit:
                     prefetch_depth=args.prefetch_depth,
                     mode=args.stream_mode,
                     gap_schedule=args.gap_schedule,
+                    resident_blocks=args.resident_blocks,
+                    resident_bytes=args.resident_bytes,
                     progress=progress,
                     cluster=cluster,
                 )

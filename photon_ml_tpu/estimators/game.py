@@ -611,6 +611,8 @@ class GameEstimator:
         blocks_per_update: int = 1,
         seed: int = 0,
         gap_schedule: bool = False,
+        resident_blocks: int = 0,
+        resident_bytes: Optional[int] = None,
         progress: Optional[object] = None,
         cluster: Optional[object] = None,
     ) -> GameFit:
@@ -632,6 +634,14 @@ class GameEstimator:
         gate it on held-out metric parity before trusting it.
         ``gap_schedule=True`` (stochastic only) replaces the blind shuffle
         with duality-gap-guided block selection (docs/SCALING.md).
+
+        ``resident_blocks``/``resident_bytes`` cap a device-resident set of
+        top-gap blocks whose uploads persist across streamed passes — the
+        HBM level of the residency hierarchy (docs/SCALING.md "Residency
+        hierarchy"). Warm passes then re-upload only the non-resident
+        remainder; the solve trajectory is unchanged (identical visit
+        order, only transfer volume drops). Requires ``mode='full'`` or
+        ``gap_schedule=True``, and no ``cluster``.
 
         ``cluster`` (a ``parallel.cluster.ClusterPlane`` or bare
         ``ClusterCoordinator``) runs the fixed-effect solve data-parallel
@@ -710,6 +720,8 @@ class GameEstimator:
                     blocks_per_update=blocks_per_update,
                     seed=seed,
                     gap_schedule=gap_schedule,
+                    resident_blocks=resident_blocks,
+                    resident_bytes=resident_bytes,
                     # convergence plane: per-block loss/grad/gap probes run
                     # only when a tracker is attached (bitwise contract)
                     collect_block_stats=progress is not None,

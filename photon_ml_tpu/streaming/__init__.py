@@ -24,6 +24,11 @@ from photon_ml_tpu.streaming.prefetch import (
     DeviceBlock,
     PrefetchStats,
 )
+from photon_ml_tpu.streaming.residency import (
+    ResidencyManager,
+    ResidencyStats,
+    residency_hierarchy,
+)
 from photon_ml_tpu.streaming.solver import (
     BlockStatsProbe,
     StreamSolveInfo,
@@ -50,6 +55,9 @@ __all__ = [
     "BlockPrefetcher",
     "DeviceBlock",
     "PrefetchStats",
+    "ResidencyManager",
+    "ResidencyStats",
+    "residency_hierarchy",
     "BlockStatsProbe",
     "StreamSolveInfo",
     "reset_stream_trace_counts",

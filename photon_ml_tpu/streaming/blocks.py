@@ -263,6 +263,9 @@ class StreamingSource:
         self._skipped_log: List[dict] = []
         # decode accounting for the planning/setup passes (bench evidence)
         self.files_decoded = 0
+        # RAM level of the residency hierarchy: part files served from the
+        # decoded-file LRU instead of re-decoding (residency_hierarchy)
+        self.file_cache_hits = 0
         self._work_s = 0.0  # host decode+pack seconds, whatever thread
         # wall-clock with >= 1 decode in flight (for the wall-based hide
         # ratio: parallel workers must not be double counted)
@@ -449,6 +452,9 @@ class StreamingSource:
             cached = self._file_cache.pop(fi, None)
             if cached is not None:
                 self._file_cache[fi] = cached  # re-insert: most recently used
+                # RAM level of the residency hierarchy: a decoded-file LRU
+                # hit is an Avro decode that never happened
+                self.file_cache_hits += 1
                 return cached
             fut = self._pending.get(fi)
         if fut is not None:
@@ -741,3 +747,17 @@ class StreamingSource:
         indices) — the unit the prefetch-depth RSS bound multiplies."""
         k = self.plan.shard_widths[shard]
         return self.plan.block_rows * k * 8
+
+    def block_upload_bytes(self, shards: Optional[Sequence[str]] = None) -> int:
+        """H2D bytes of ONE uploaded block restricted to ``shards``
+        (default: all): the per-row scalar planes (labels/offsets/weights,
+        f32 each) plus each shard's ELL payload as it crosses the link
+        (f32 values + i32 indices). Block shapes are fixed by the plan, so
+        this is uniform across blocks — the residency plane's byte budget
+        divides by it exactly."""
+        want = tuple(shards) if shards is not None else tuple(self.shard_configs)
+        b = self.plan.block_rows
+        total = 3 * b * 4
+        for sid in want:
+            total += b * self.plan.shard_widths[sid] * 8
+        return total

@@ -39,7 +39,12 @@ from photon_ml_tpu.opt.tracking import (
 )
 from photon_ml_tpu.streaming.blocks import StreamingSource
 from photon_ml_tpu.streaming.gapsched import GapScheduler
-from photon_ml_tpu.streaming.prefetch import BlockPrefetcher, PrefetchStats
+from photon_ml_tpu.streaming.prefetch import (
+    BlockPrefetcher,
+    DeviceBlock,
+    PrefetchStats,
+)
+from photon_ml_tpu.streaming.residency import ResidencyManager
 from photon_ml_tpu.streaming.solver import (
     BlockStatsProbe,
     StreamPrograms,
@@ -165,6 +170,22 @@ class StreamingFixedEffectCoordinate(Coordinate):
     last_cluster_events: Optional[list] = dataclasses.field(
         default=None, repr=False
     )
+    # HBM residency plane (streaming/residency.py): a nonzero block budget
+    # and/or a byte budget pins the top-gap blocks' device arrays across
+    # passes, skipping their device_put entirely; the non-resident
+    # remainder streams through the prefetcher as before. Off by default —
+    # with both unset the streamed path is bitwise identical to today (the
+    # CI residency parity gate pins this). The manager persists across CD
+    # outer iterations, so pinned blocks survive between solves; re-pinning
+    # happens only between passes (never mid-pass).
+    resident_blocks: int = 0
+    resident_bytes: Optional[int] = None
+    last_residency_decisions: Optional[list] = dataclasses.field(
+        default=None, repr=False
+    )
+    _residency: Optional[ResidencyManager] = dataclasses.field(
+        default=None, repr=False
+    )
     _gap_scheduler: Optional[GapScheduler] = dataclasses.field(
         default=None, repr=False
     )
@@ -200,6 +221,23 @@ class StreamingFixedEffectCoordinate(Coordinate):
                     f"cluster planned {self.cluster.num_blocks} blocks but "
                     f"this source streams {self.source.plan.num_blocks}"
                 )
+        if self.resident_blocks or self.resident_bytes is not None:
+            if self.cluster is not None:
+                raise ValueError(
+                    "device residency requires local streaming: cluster "
+                    "workers own their blocks' device placement"
+                )
+            if self.mode == "stochastic" and not self.gap_schedule:
+                raise ValueError(
+                    "stochastic residency requires gap_schedule — the "
+                    "scheduler's gap feedback is what picks the resident set"
+                )
+            self._residency = ResidencyManager(
+                self.source.plan.num_blocks,
+                self.source.block_upload_bytes((self.shard_id,)),
+                max_blocks=int(self.resident_blocks),
+                max_bytes=self.resident_bytes,
+            )
 
     # -- shapes -----------------------------------------------------------
 
@@ -241,6 +279,84 @@ class StreamingFixedEffectCoordinate(Coordinate):
                 blk.data[self.shard_id] = data
             yield blk
 
+    def _pass_blocks(self, residual_padded=None, order=None, probe=None):
+        """One streamed pass, residency-aware. With no residency plane this
+        is exactly the historical ``_blocks`` pass (bitwise contract); with
+        one it is the resident/streamed merge of ``_resident_pass``. Either
+        way the probe (when given) is told each yielded block's true index
+        so gap attribution survives skips and merges."""
+        if self._residency is None:
+            for blk in self._blocks(residual_padded, order=order):
+                if probe is not None:
+                    probe.note_visit(blk.index)
+                yield blk
+            return
+        yield from self._resident_pass(residual_padded, order, probe)
+
+    def _resident_pass(self, residual_padded, order, probe):
+        """Merge device-resident blocks with the streamed remainder.
+
+        The visit order is IDENTICAL to the non-resident pass — resident
+        blocks are served in place, from HBM, while only the non-resident
+        remainder flows through the prefetcher (whose H2D overlaps the
+        resident blocks' solve work). Identical order means identical
+        floating-point accumulation, so residency changes transfer volume
+        only, never the trajectory.
+
+        Resident entries keep their BASE offsets; the CD residual is fused
+        into a per-pass copy by the same fixed-shape program as the
+        streamed path (no mutation of the pinned arrays, no new traces).
+        Re-pinning happens HERE, at pass start, from the probe's previous
+        completed pass — between passes, never mid-pass.
+        """
+        mgr = self._residency
+        if probe is not None and probe.has_measurements:
+            mgr.update_gaps({
+                s["block"]: s["gap_estimate"] for s in probe.last_pass
+            })
+            mgr.repin()
+        visit = (
+            list(range(self.source.plan.num_blocks))
+            if order is None
+            else [int(i) for i in order]
+        )
+        stream_order = [i for i in visit if not mgr.is_resident(i)]
+        prefetcher = BlockPrefetcher(
+            self.source,
+            shards=(self.shard_id,),
+            depth=self.prefetch_depth,
+            order=stream_order,
+        )
+        self.last_prefetch_stats = prefetcher.stats
+        streamed = iter(prefetcher)
+        pending = next(streamed, None)
+        for i in visit:
+            blk = mgr.get(i)
+            if blk is not None:
+                prefetcher.stats.resident_hit_blocks += 1
+                prefetcher.stats.resident_hit_bytes += mgr.block_bytes
+            elif pending is not None and pending.index == i:
+                blk = pending
+                # store-on-visit: the upload we just paid for is retained
+                # if the block is in the pin target and the budget has room
+                mgr.offer(i, blk)
+                pending = next(streamed, None)
+            else:
+                continue  # skipped upstream (on_block_error=skip)
+            if probe is not None:
+                probe.note_visit(blk.index)
+            data = blk.data[self.shard_id]
+            if residual_padded is not None:
+                data = data.replace(
+                    offsets=_fuse_block_offsets(
+                        data.offsets, residual_padded, jnp.int32(blk.start)
+                    )
+                )
+            yield DeviceBlock(
+                index=blk.index, start=blk.start, num_real=blk.num_real,
+                data={self.shard_id: data}, weight_sum=blk.weight_sum,
+            )
+
     # -- Coordinate interface --------------------------------------------
 
     def update_model_device(
@@ -257,7 +373,9 @@ class StreamingFixedEffectCoordinate(Coordinate):
         probe = (
             BlockStatsProbe()
             if (
-                self.collect_block_stats
+                # the residency plane NEEDS the gap probe: the resident set
+                # is chosen from measured gaps, never statically
+                (self.collect_block_stats or self._residency is not None)
                 and self.mode == "full"
                 and self.cluster is None  # workers report stats instead
             )
@@ -278,7 +396,9 @@ class StreamingFixedEffectCoordinate(Coordinate):
                     w0,
                     make_blocks=lambda: (
                         blk.data[self.shard_id]
-                        for blk in self._blocks(residual_padded)
+                        for blk in self._pass_blocks(
+                            residual_padded, probe=probe
+                        )
                     ),
                     configuration=self.configuration,
                     info=info,
@@ -292,6 +412,14 @@ class StreamingFixedEffectCoordinate(Coordinate):
                         self._gap_scheduler = GapScheduler(
                             plan.num_blocks, plan=plan, seed=self.seed
                         )
+                        if self._residency is not None:
+                            # stochastic repin rides the scheduler's own
+                            # epoch-end gap feedback (one signal, two
+                            # consumers); mark_failed evicts through the
+                            # same attachment
+                            self._gap_scheduler.attach_residency(
+                                self._residency
+                            )
                     scheduler = self._gap_scheduler
                 result = solve_streaming_stochastic(
                     self.objective(),
@@ -317,10 +445,13 @@ class StreamingFixedEffectCoordinate(Coordinate):
         skipped = self.source.drain_skipped_blocks()
         if skipped:
             self.last_skipped_blocks = skipped
+            failed = [s["block"] for s in skipped]
             if self._gap_scheduler is not None:
-                self._gap_scheduler.mark_failed(
-                    [s["block"] for s in skipped]
-                )
+                self._gap_scheduler.mark_failed(failed)
+            if self._residency is not None:
+                # idempotent with the scheduler's forwarding: a pinned
+                # block that failed to rebuild must leave HBM either way
+                self._residency.mark_failed(failed)
         self.last_solve_info = info
         self.last_tracker = FixedEffectOptimizationTracker(
             states=OptimizationStatesTracker.from_result(result)
@@ -331,6 +462,20 @@ class StreamingFixedEffectCoordinate(Coordinate):
                 self.last_prefetch_stats.block_gaps = {
                     s["block"]: s["gap_estimate"] for s in probe.last_pass
                 }
+        if self._residency is not None:
+            if probe is not None and probe.has_measurements:
+                # fold the FINAL pass's gaps in so the next solve (or the
+                # score passes between CD outer iterations) starts on the
+                # freshest resident set — still a between-pass repin
+                self._residency.update_gaps({
+                    s["block"]: s["gap_estimate"] for s in probe.last_pass
+                })
+                self._residency.repin()
+            decisions = self._residency.drain_decisions()
+            if decisions:
+                self.last_residency_decisions = (
+                    self.last_residency_decisions or []
+                ) + decisions
         return GeneralizedLinearModel(
             coefficients=Coefficients(means=result.w), task=self.task
         )
@@ -401,7 +546,8 @@ class StreamingFixedEffectCoordinate(Coordinate):
         plan = self.source.plan
         w = model.coefficients.means
         out = jnp.zeros((plan.padded_rows,), dtype=jnp.float32)
-        for blk in self._blocks():
+        # residency-aware: score passes serve pinned blocks from HBM too
+        for blk in self._pass_blocks():
             feats = blk.data[self.shard_id].features
             scores = _block_matvec(feats.values, feats.indices, w)
             out = _scatter_scores(out, scores, jnp.int32(blk.start))
@@ -422,7 +568,9 @@ class _OwnShardBlocks:
         self.order = None if order is None else [int(i) for i in order]
 
     def __iter__(self):
-        for blk in self.coord._blocks(self.residual_padded, order=self.order):
+        for blk in self.coord._pass_blocks(
+            self.residual_padded, order=self.order
+        ):
             yield _ShardBlock(
                 data=blk.data[self.coord.shard_id],
                 weight_sum=blk.weight_sum,

@@ -101,6 +101,17 @@ class GapScheduler:
         self.epoch = 0
         self.decisions: List[dict] = []
         self._rng = np.random.default_rng(seed)
+        # HBM residency plane (streaming/residency.py): when attached, the
+        # scheduler's epoch-end gap feedback doubles as the residency
+        # plane's repin signal, and permanently failed blocks are evicted
+        # from the resident set the moment they are excluded here
+        self._residency = None
+
+    def attach_residency(self, manager) -> None:
+        """Couple a :class:`~photon_ml_tpu.streaming.residency.ResidencyManager`
+        to this scheduler's gap feedback: ``update`` forwards measurements
+        and triggers the between-epoch repin; ``mark_failed`` evicts."""
+        self._residency = manager
 
     # -- scheduling -------------------------------------------------------
 
@@ -202,6 +213,11 @@ class GapScheduler:
                 )
             self.scores[b] = abs(float(gap))
             self.age[b] = 0
+        if self._residency is not None and gaps:
+            # same signal, second consumer: the epoch boundary is the only
+            # legal repin point (never mid-pass)
+            self._residency.update_gaps(gaps)
+            self._residency.repin()
 
     def mark_failed(self, blocks) -> None:
         """Exclude permanently failed blocks (on_block_error=skip) from
@@ -211,6 +227,9 @@ class GapScheduler:
             bi = int(b)
             if 0 <= bi < self.num_blocks:
                 self.excluded[bi] = True
+        if self._residency is not None:
+            # a block that cannot build must not stay pinned in HBM
+            self._residency.mark_failed(blocks)
 
     def drain_decisions(self) -> List[dict]:
         """Per-epoch decision records accumulated since the last drain

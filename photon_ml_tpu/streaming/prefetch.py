@@ -70,8 +70,15 @@ class PrefetchStats:
     stall_s: float = 0.0         # consumer time blocked waiting for a block
     transfer_s: float = 0.0      # device_put dispatch time (all uploads)
     upload_hidden_s: float = 0.0  # uploads dispatched while solve in flight
+    h2d_bytes: int = 0           # bytes actually crossing host->device
     cache_hit_blocks: int = 0    # blocks served from the block cache
     cache_load_s: float = 0.0    # wall seconds mapping+validating entries
+    # HBM residency plane (streaming/residency.py): blocks this pass served
+    # straight from the device-resident set — uploads that never happened.
+    # Written by the streamed coordinate, which owns the resident/streamed
+    # merge; the prefetcher itself only ever sees the non-resident order.
+    resident_hit_blocks: int = 0
+    resident_hit_bytes: int = 0  # H2D bytes those hits avoided
     # per-block duality-gap estimates of the most recent streamed solve's
     # final pass (block index -> gap), written by the streaming coordinate
     # when the convergence plane is on. The DuHL-style GapScheduler
@@ -125,7 +132,13 @@ class BlockPrefetcher:
 
     def _to_device(self, blk: HostBlock) -> DeviceBlock:
         t0 = time.perf_counter()
-        with span("stream h2d transfer", block=blk.index):
+        # indices upload as i32 regardless of the host dtype, so count the
+        # converted size — these bytes feed the ≥2× residency gate and must
+        # match what actually crosses the H2D link
+        nbytes = blk.labels.nbytes + blk.offsets.nbytes + blk.weights.nbytes
+        for vals, idx in blk.shards.values():
+            nbytes += vals.nbytes + idx.size * 4
+        with span("stream h2d transfer", block=blk.index, bytes=int(nbytes)):
             data: Dict[str, LabeledData] = {}
             labels = jax.device_put(blk.labels)
             offsets = jax.device_put(blk.offsets)
@@ -142,6 +155,7 @@ class BlockPrefetcher:
                 )
         dt = time.perf_counter() - t0
         self.stats.transfer_s += dt
+        self.stats.h2d_bytes += int(nbytes)
         if self.stats.blocks > 1:
             # device_put is async-dispatched and acc_vg returns futures, so
             # every upload after the pass's first is issued while the
@@ -182,6 +196,7 @@ class BlockPrefetcher:
         reg.count("stream.stall_s", self.stats.stall_s)
         reg.count("stream.transfer_s", self.stats.transfer_s)
         reg.count("stream.upload_hidden_s", self.stats.upload_hidden_s)
+        reg.count("stream.h2d_bytes", self.stats.h2d_bytes)
         reg.count("stream.cache_hit_blocks", self.stats.cache_hit_blocks)
         reg.count("stream.cache_load_s", self.stats.cache_load_s)
         reg.gauge("stream.prefetch_hide_ratio", self.stats.hide_ratio)

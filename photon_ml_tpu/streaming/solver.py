@@ -97,28 +97,53 @@ class BlockStatsProbe:
     def __init__(self) -> None:
         self._pending: List[tuple] = []
         self._futures: List[tuple] = []
+        self._visit_pending: List[int] = []
+        self._visit: List[int] = []
         self._resolved: Optional[List[dict]] = None
 
     def begin_pass(self) -> None:
         self._pending = []
+        self._visit_pending = []
 
     def on_block(self, partial_loss, partial_grad_norm, gap_estimate) -> None:
         self._pending.append((partial_loss, partial_grad_norm, gap_estimate))
+
+    def note_visit(self, block: int) -> None:
+        """Optional attribution hook: the block generator records each
+        yielded block's TRUE index so ``last_pass`` labels stats by it
+        instead of by enumerate position. Without it a degraded pass
+        (on_block_error=skip) — or any non-natural visit order, like the
+        residency plane's resident/streamed merge under skips — would
+        silently misattribute every stat after the first gap."""
+        self._visit_pending.append(int(block))
 
     def end_pass(self) -> None:
         # keep the futures; only the final completed pass is ever read, so
         # host resolution is deferred to the last_pass property — no D2H
         # sync on the intermediate line-search passes
         self._futures = self._pending
+        self._visit = self._visit_pending
         self._pending = []
+        self._visit_pending = []
         self._resolved = None
+
+    @property
+    def has_measurements(self) -> bool:
+        """True once at least one streamed pass completed (the residency
+        plane repins only on measured evidence)."""
+        return bool(self._futures)
 
     @property
     def last_pass(self) -> List[dict]:
         if self._resolved is None:
+            labels = (
+                self._visit
+                if len(self._visit) == len(self._futures)
+                else list(range(len(self._futures)))
+            )
             self._resolved = [
                 {
-                    "block": i,
+                    "block": labels[i],
                     "partial_loss": float(f),
                     "partial_grad_norm": float(g),
                     "gap_estimate": float(gap),

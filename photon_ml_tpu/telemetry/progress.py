@@ -252,6 +252,44 @@ class ConvergenceTracker:
                     "progress.schedule_records", len(decisions)
                 )
 
+    def record_residency(
+        self, outer: int, coordinate: str, decisions: List[Dict[str, Any]]
+    ) -> None:
+        """Pin/evict decisions of the HBM residency plane
+        (``ResidencyManager.drain_decisions()``): which block entered or
+        left the device-resident set, on what staleness-decayed gap score
+        (-1.0 = bootstrap pin, no measurement yet), and the H2D byte delta
+        the decision implies for every later pass."""
+        with self._lock:
+            if self._closed:
+                return
+            for d in decisions:
+                self._emit({
+                    "kind": "residency",
+                    "outer": int(outer),
+                    "coordinate": str(coordinate),
+                    "epoch": int(d["epoch"]),
+                    "action": str(d["action"]),
+                    "block": int(d["block"]),
+                    "gap_score": float(d["gap_score"]),
+                    "byte_delta": int(d["byte_delta"]),
+                    "resident_blocks": int(d.get("resident_blocks", 0)),
+                    "resident_bytes": int(d.get("resident_bytes", 0)),
+                })
+            if decisions:
+                last = decisions[-1]
+                self.registry.gauge(
+                    "stream.residency.resident_blocks",
+                    float(last.get("resident_blocks", 0)),
+                )
+                self.registry.gauge(
+                    "stream.residency.resident_bytes",
+                    float(last.get("resident_bytes", 0)),
+                )
+                self.registry.count(
+                    "progress.residency_records", len(decisions)
+                )
+
     def record_cluster(
         self, outer: int, coordinate: str, events: List[Dict[str, Any]]
     ) -> None:
@@ -531,6 +569,7 @@ def convergence_report(
     val_rows = [r for r in progress if r.get("kind") == "validation"]
     block_rows = [r for r in progress if r.get("kind") == "block"]
     anomalies = [r for r in progress if r.get("kind") == "anomaly"]
+    residency_rows = [r for r in progress if r.get("kind") == "residency"]
 
     report: Dict[str, Any] = {
         "num_updates": len(coord_rows),
@@ -625,6 +664,25 @@ def convergence_report(
                 b["gap_max"] = max(gaps)
                 b["gap_sum"] = sum(gaps)
         report["blocks"] = per_blocks
+
+    if residency_rows:
+        per_res: Dict[str, Dict[str, Any]] = {}
+        for rec in residency_rows:
+            cid = rec["coordinate"]
+            r = per_res.setdefault(cid, {
+                "pins": 0, "evictions": 0, "resident_blocks": 0,
+                "resident_bytes": 0, "saved_bytes_per_pass": 0,
+            })
+            if rec["action"] == "pin":
+                r["pins"] += 1
+            elif rec["action"] == "evict":
+                r["evictions"] += 1
+            # records are chronological: the last one carries the final
+            # resident footprint; the byte deltas telescope to the same
+            r["resident_blocks"] = int(rec.get("resident_blocks", 0))
+            r["resident_bytes"] = int(rec.get("resident_bytes", 0))
+            r["saved_bytes_per_pass"] = r["resident_bytes"]
+        report["residency"] = per_res
     return report
 
 
@@ -676,6 +734,15 @@ def format_progress_report(report: Dict[str, Any]) -> str:
                 f"gap_sum={b.get('gap_sum', 0.0):.6g}, "
                 f"gap_max={b.get('gap_max', 0.0):.6g}"
             )
+    residency = report.get("residency", {})
+    for cid, r in residency.items():
+        lines.append("")
+        lines.append(
+            f"hbm residency [{cid}]: {r['resident_blocks']} blocks pinned "
+            f"({r['resident_bytes'] / 1e6:.1f} MB), "
+            f"{r['pins']} pins / {r['evictions']} evictions, "
+            f"~{r['saved_bytes_per_pass'] / 1e6:.1f} MB H2D saved per pass"
+        )
     anomalies = report.get("anomalies", [])
     if anomalies:
         lines.append("")

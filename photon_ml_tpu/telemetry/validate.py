@@ -54,6 +54,10 @@ _PROGRESS_SCHEMAS: Dict[str, tuple] = {
     # cluster plane (parallel/cluster): block rebalance / host-loss /
     # reassignment events of a distributed solve
     "cluster": ("outer", "coordinate", "event"),
+    # HBM residency plane (streaming/residency.py): one record per
+    # pin/evict decision — which block, on what gap score, byte delta
+    "residency": ("outer", "coordinate", "epoch", "action", "block",
+                  "gap_score", "byte_delta"),
 }
 
 

@@ -404,6 +404,33 @@ register_knob(KnobSpec(
 ))
 
 register_knob(KnobSpec(
+    name="stream.resident_blocks",
+    kind="int",
+    default=0,
+    applies_to="train",
+    phase="io",
+    metric_deps=(
+        "metric:stream.h2d_bytes",
+        "metric:stream.transfer_s",
+        "metric:stream.upload_hidden_s",
+        "metric:stream.residency.h2d_saved_bytes",
+        "metric:stream.residency.hbm_hit_blocks",
+        "phase:transfers",
+    ),
+    candidates=(0, 2, 4, 8, 16),
+    description=(
+        "Device-resident block budget for streamed training (train_game "
+        "--resident-blocks; 0 = off, bitwise-identical streaming). The "
+        "top-gap blocks' uploads persist across passes (DuHL, arxiv "
+        "1702.07005), so warm passes re-upload only the non-resident "
+        "remainder — stream.h2d_bytes drops by resident/total per pass. "
+        "Worth proposing when stream.transfer_s is material and device "
+        "memory has headroom of resident_blocks x block upload bytes; "
+        "pointless when the solve is decode- or compute-bound."
+    ),
+))
+
+register_knob(KnobSpec(
     name="serve.eviction_policy",
     kind="str",
     default="oldest",
