@@ -2460,6 +2460,9 @@ def _multihost_bench():
                     heartbeat_timeout_s=60.0,
                     log_dir=os.path.join(tmp, f"logs-{hosts}h"),
                 )
+                # skew attribution piggybacks on the partial replies —
+                # same message count, so it cannot perturb the scaling
+                plane.coordinator.enable_telemetry()
                 # count passes so throughput normalizes to blocks/s: fp
                 # reassociation across partitions can still flip a rare
                 # borderline line-search trial, and wall alone would then
@@ -2481,6 +2484,7 @@ def _multihost_bench():
                             np.zeros(MH_DIM + 1, dtype=np.float32)
                         )
                         plane.drain_events()
+                        plane.drain_pass_profiles()
                         passes[0] = 0
                     t0 = _time.perf_counter()
                     fit = _estimator8().fit_streaming(
@@ -2493,9 +2497,54 @@ def _multihost_bench():
                     plane.close()
                 return fit, wall, passes[0], events
 
+            def _skew_summary(cluster_passes):
+                """Per-arm skew/comm-wait attribution from the
+                coordinator's pass profiles (the analyze_run --cluster
+                decomposition, aggregated)."""
+                if not cluster_passes:
+                    return None
+                wall = sum(p["wall_s"] for p in cluster_passes)
+                busy = sum(p["busy_s"] for p in cluster_passes)
+                wait = sum(p["allreduce_wait_s"] for p in cluster_passes)
+                bubble = sum(p["bubble_s"] for p in cluster_passes)
+                idx = [p["straggler_index"] for p in cluster_passes]
+                hosts_busy: dict = {}
+                for p in cluster_passes:
+                    for h, row in (p.get("hosts") or {}).items():
+                        hosts_busy[str(h)] = round(
+                            hosts_busy.get(str(h), 0.0)
+                            + float(row.get("busy_s", 0.0)), 4
+                        )
+                return {
+                    "passes": len(cluster_passes),
+                    "allreduce_wait_mean_s": round(
+                        wait / len(cluster_passes), 4
+                    ),
+                    "allreduce_wait_frac": round(wait / wall, 4),
+                    "coordinator_bubble_frac": round(bubble / wall, 4),
+                    "busy_frac": round(busy / wall, 4),
+                    "straggler_index_mean": round(
+                        sum(idx) / len(idx), 4
+                    ),
+                    "attribution_coverage": round(
+                        (busy + wait + bubble) / wall, 4
+                    ),
+                    "hosts_busy_s": hosts_busy,
+                }
+
             arms = {}
             for hosts in MH_HOSTS:
-                fit, wall, passes, _ = _cluster_arm(hosts)
+                # the tracker rides the bench ledger, so cluster_pass /
+                # host_pass records land in multihost-ledger.jsonl (CI's
+                # cluster observability gate replays them)
+                mh_tracker = ConvergenceTracker(
+                    ledger=summarize_telemetry.run.ledger,
+                    abort_on_divergence=False,
+                )
+                fit, wall, passes, _ = _cluster_arm(
+                    hosts, tracker=mh_tracker
+                )
+                mh_tracker.finish()
                 arms[hosts] = {
                     "fit_wall_s": round(wall, 3),
                     "passes": passes,
@@ -2503,6 +2552,7 @@ def _multihost_bench():
                         passes * MH_NUM_BLOCKS / wall, 2
                     ),
                     "auc": round(_val_auc(fit), 6),
+                    "skew": _skew_summary(mh_tracker.cluster_passes),
                 }
 
             base_rate = arms[MH_HOSTS[0]]["blocks_per_s"]
@@ -2546,6 +2596,17 @@ def _multihost_bench():
             ),
             "auc_singlehost": round(auc_solo, 6),
             "auc_parity_delta": round(auc_delta, 6),
+            # headline skew/comm-wait attribution for the 2-host arm (the
+            # per-arm breakdown lives under hosts.<n>.skew)
+            "allreduce_wait_frac_2hosts": (
+                arms.get(2, {}).get("skew") or {}
+            ).get("allreduce_wait_frac"),
+            "straggler_index_2hosts": (
+                arms.get(2, {}).get("skew") or {}
+            ).get("straggler_index_mean"),
+            "skew_attribution_coverage_2hosts": (
+                arms.get(2, {}).get("skew") or {}
+            ).get("attribution_coverage"),
             "chaos": {
                 "hosts": 2,
                 "killed_host": 1,
@@ -2560,6 +2621,7 @@ def _multihost_bench():
                 "blocks_reassigned": int(blocks_reassigned),
                 "ledger_events": ledger_events,
                 "ledger_cluster_records": len(cluster_recs),
+                "skew": _skew_summary(tracker.cluster_passes),
             },
             "rows": n_rows,
             "dim": MH_DIM + 1,

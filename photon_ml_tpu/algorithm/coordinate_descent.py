@@ -278,6 +278,10 @@ class CoordinateDescent:
         if cluster_events:
             tracker.record_cluster(outer, cid, cluster_events)
             coord.last_cluster_events = None
+        cluster_passes = getattr(coord, "last_cluster_passes", None)
+        if cluster_passes:
+            tracker.record_cluster_passes(outer, cid, cluster_passes)
+            coord.last_cluster_passes = None
         skipped = getattr(coord, "last_skipped_blocks", None)
         if skipped:
             for s in skipped:

@@ -85,6 +85,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         ),
     )
     parser.add_argument(
+        "--cluster",
+        action="store_true",
+        help=(
+            "Render the cluster-plane skew attribution (per-pass busy/"
+            "allreduce-wait/bubble decomposition, per-host work vs the "
+            "assigner's predicted shares, straggler ranking, imbalance "
+            "trend) from the ledger's cluster_pass/host_pass records; "
+            "exits nonzero when the ledger carries none."
+        ),
+    )
+    parser.add_argument(
         "--quiet",
         action="store_true",
         help="Suppress the human-readable report (JSON outputs still written).",
@@ -119,6 +130,20 @@ def run(args: argparse.Namespace) -> int:
             return 1
         if not args.quiet:
             print(format_request_report(report.requests))
+    if args.cluster:
+        from photon_ml_tpu.telemetry.analyze import format_cluster_report
+
+        if not report.cluster:
+            print(
+                "analyze_run: ledger carries no cluster_pass records (run "
+                "the cluster plane with telemetry — train_game --hosts "
+                "N --telemetry-out, or bench.py --multihost with "
+                "BENCH_TELEMETRY_DIR — to record skew profiles)",
+                file=sys.stderr,
+            )
+            return 1
+        if not args.quiet:
+            print(format_cluster_report(report.cluster))
     if not args.quiet:
         print(format_report(report))
     if args.json:

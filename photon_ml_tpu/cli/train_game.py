@@ -662,10 +662,14 @@ def run(args: argparse.Namespace) -> GameFit:
                 varz=lambda: vars(args),
                 health=progress.health,
                 port=args.introspect_port,
-                extra_json={"/progress": progress.progress_json},
+                extra_json={
+                    "/progress": progress.progress_json,
+                    "/cluster": progress.cluster_json,
+                },
             ).start()
             logger.info(
-                "introspection on http://%s:%d (/progress /metrics /healthz)",
+                "introspection on http://%s:%d "
+                "(/progress /cluster /metrics /healthz)",
                 introspect.host, introspect.port,
             )
             if args.introspect_port_file:
@@ -747,6 +751,14 @@ def run(args: argparse.Namespace) -> GameFit:
                 if args.cluster_kill_host is not None:
                     h, n = args.cluster_kill_host.split(":")
                     kill_host = (int(h), int(n))
+                # federate observability across the mesh: worker ledgers
+                # land beside the coordinator's --telemetry-out ledger
+                cluster_telemetry_dir = None
+                if args.telemetry_out:
+                    cluster_telemetry_dir = os.path.join(
+                        os.path.dirname(os.path.abspath(args.telemetry_out)),
+                        "cluster-workers",
+                    )
                 with timer.time("launch cluster"):
                     cluster = ClusterPlane.launch(
                         num_hosts=args.hosts,
@@ -770,7 +782,12 @@ def run(args: argparse.Namespace) -> GameFit:
                             else None
                         ),
                         kill_host=kill_host,
+                        telemetry_dir=cluster_telemetry_dir,
                     )
+                if progress is not None or telemetry is not None:
+                    # skew profiles feed the progress ledger's
+                    # cluster_pass/host_pass records and the /cluster route
+                    cluster.coordinator.enable_telemetry()
                 logger.info(
                     "cluster: %d worker host(s) connected on %s:%d",
                     args.hosts, *cluster.coordinator.address,
@@ -1175,6 +1192,21 @@ def run(args: argparse.Namespace) -> GameFit:
         # listeners must flush/close even when the run fails; telemetry
         # finishes after them so every bridged event is in the ledger
         emitter.clear_listeners()
+        if (
+            telemetry is not None
+            and progress is not None
+            and progress.cluster_passes
+        ):
+            from photon_ml_tpu.telemetry import cluster_lane_events
+
+            # per-host lanes (pid = 1 + host) alongside the coordinator's
+            # own spans in the Chrome trace
+            telemetry.add_trace_events(
+                cluster_lane_events(
+                    progress.cluster_passes,
+                    origin_unix=telemetry.tracer.origin_unix,
+                )
+            )
         finish_telemetry(telemetry, phases=dict(timer.durations))
 
 

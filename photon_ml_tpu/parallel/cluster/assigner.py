@@ -108,6 +108,23 @@ class BlockAssigner:
             out[h].sort()
         return out
 
+    def predicted_shares(
+        self, assignment: Dict[int, List[int]]
+    ) -> Dict[int, float]:
+        """Each host's share of the total effective gap mass its slice
+        carries — the LPT objective, i.e. the assigner's implicit
+        prediction of relative per-host work. The coordinator's skew
+        profile compares this against measured per-host busy time
+        (assignment-quality feedback: a future skew-aware assigner
+        actuates on the gap between the two)."""
+        eff = self.effective_scores()
+        assigned = [b for blks in assignment.values() for b in blks]
+        total = max(float(eff[assigned].sum()), 1e-30) if assigned else 1e-30
+        return {
+            int(h): float(eff[blks].sum()) / total if blks else 0.0
+            for h, blks in assignment.items()
+        }
+
     def assign(self) -> Dict[int, List[int]]:
         """The per-pass partition of every non-excluded block over the
         live hosts."""
@@ -119,19 +136,14 @@ class BlockAssigner:
             # a line-searching solve runs many passes per iteration; only
             # partition CHANGES are ledger-worthy
             self._last_assignment = assignment
-            eff = self.effective_scores()
+            shares = self.predicted_shares(assignment)
             self._decisions.append({
                 "event": "rebalance",
                 "hosts": {
                     str(h): len(blks) for h, blks in assignment.items()
                 },
                 "score_share": {
-                    str(h): round(
-                        float(
-                            eff[blks].sum() / max(eff[blocks].sum(), 1e-30)
-                        ), 4,
-                    )
-                    for h, blks in assignment.items()
+                    str(h): round(shares[h], 4) for h in assignment
                 },
             })
         return assignment

@@ -24,6 +24,18 @@ Failure protocol (rides PR 14's resilience plane):
   the SAME pass — the pass completes, the epoch barrier holds, nothing
   aborts. Only when zero hosts survive does the pass raise
   :class:`ClusterError`.
+
+Observability (off by default — ``enable_telemetry()``): when enabled the
+coordinator stamps per-fragment dispatch/arrival times, asks workers to
+piggyback their recv→decode→solve→reply timings onto each ``partial``
+reply (a ``"telemetry"`` dict — the wire protocol is otherwise unchanged,
+and with telemetry off the messages are byte-identical to the plain
+plane), and folds each pass into a skew profile: per-host busy seconds,
+allreduce wait (last arrival minus first arrival), the coordinator's own
+fold/update bubble, a straggler index, and measured per-host work shares
+against the assigner's LPT-predicted gap shares. Profiles drain through
+:meth:`ClusterCoordinator.drain_pass_profiles` into the progress ledger
+as ``cluster_pass``/``host_pass`` records (see docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -56,6 +68,10 @@ class _WorkerHandle:
         self.msock = msock
         self.alive = True
         self.last_seen = time.monotonic()
+        # Heartbeat inter-arrival tracking (timeout tuning): last beat time
+        # and a bounded window of deltas for the p99 gauge.
+        self.last_beat: Optional[float] = None
+        self.beat_deltas: List[float] = []
 
 
 class ClusterCoordinator:
@@ -94,6 +110,13 @@ class ClusterCoordinator:
         self._next_frag = 0
         self._events: List[dict] = []
         self._closed = False
+        # Telemetry (off by default; the wire protocol is unchanged and
+        # byte-identical until enable_telemetry() is called).
+        self.telemetry_enabled = False
+        self._pass_profiles: List[dict] = []
+        self._frag_meta: Dict[Tuple[int, int], dict] = {}
+        self._pass_t0 = 0.0
+        self._pass_requeued = 0
 
     # -- membership --------------------------------------------------------
 
@@ -144,10 +167,31 @@ class ClusterCoordinator:
                 msg = handle.msock.recv()
                 handle.last_seen = time.monotonic()
                 if msg.get("type") == "heartbeat":
+                    self._note_heartbeat(handle)
                     continue
                 self._inbox.put((handle.host, msg))
         except (EOFError, OSError):
             self._inbox.put((handle.host, None))
+
+    def _note_heartbeat(self, handle: _WorkerHandle) -> None:
+        """Track per-host heartbeat inter-arrival so the timeout can be
+        tuned from data: ``cluster.heartbeat_interarrival_p99_s{host=h}``
+        far below the timeout means the timeout has headroom; near it
+        means false host-lost verdicts are imminent."""
+        now = time.monotonic()
+        if handle.last_beat is not None:
+            delta = now - handle.last_beat
+            deltas = handle.beat_deltas
+            deltas.append(delta)
+            if len(deltas) > 256:
+                del deltas[: len(deltas) - 256]
+            scoped = get_registry().scoped({"host": str(handle.host)})
+            scoped.observe("cluster.heartbeat_interarrival_s", delta)
+            scoped.gauge(
+                "cluster.heartbeat_interarrival_p99_s",
+                float(np.percentile(deltas, 99)),
+            )
+        handle.last_beat = now
 
     # -- failure -----------------------------------------------------------
 
@@ -216,6 +260,12 @@ class ClusterCoordinator:
         assignment = self.assigner.assign()
         w = np.asarray(w)
         self._next_frag = 0
+        tele = self.telemetry_enabled
+        self._pass_t0 = time.monotonic()
+        self._frag_meta = {}
+        self._pass_requeued = 0
+        start_unix = time.time() if tele else 0.0
+        predicted = self.assigner.predicted_shares(assignment) if tele else {}
         # pending: (host, frag) -> blocks in flight
         pending: Dict[Tuple[int, int], List[int]] = {}
         dropped: List[int] = []
@@ -224,16 +274,7 @@ class ClusterCoordinator:
                 continue
             handle = self.workers[host]
             frag = self._next_frag
-            if self._send(
-                handle,
-                {
-                    "type": "pass",
-                    "pass_id": pass_id,
-                    "frag": frag,
-                    "w": w,
-                    "blocks": blocks,
-                },
-            ):
+            if self._send_fragment(handle, pass_id, frag, w, blocks):
                 pending[(host, frag)] = blocks
                 self._next_frag += 1
             else:
@@ -245,30 +286,178 @@ class ClusterCoordinator:
         g_sum = np.zeros_like(w, dtype=np.float64)
         gaps: Dict[int, float] = {}
         block_stats: List[dict] = []
+        arrivals: List[dict] = []
+        stray = 0
+        # Check heartbeats on a monotonic interval even when the inbox is
+        # busy — a chatty inbox must not defer dead-host detection.
+        hb_interval = min(1.0, self.heartbeat_timeout_s / 4.0)
+        last_hb_check = time.monotonic()
         while pending:
-            try:
-                host, msg = self._inbox.get(timeout=1.0)
-            except queue.Empty:
+            now = time.monotonic()
+            if now - last_hb_check >= hb_interval:
+                last_hb_check = now
                 for dead in self._check_heartbeats():
                     self._recover(dead, pass_id, pending, w)
-                continue
+                if not pending:
+                    break
+            try:
+                host, msg = self._inbox.get(timeout=hb_interval)
+            except queue.Empty:
+                continue  # heartbeat check runs at the top of the loop
             if msg is None:
                 self._lose_host(host, "connection closed")
                 self._recover(host, pass_id, pending, w)
                 continue
             if msg.get("type") != "partial" or msg.get("pass_id") != pass_id:
-                continue  # stray reply from an abandoned fragment
+                # stray reply from an abandoned fragment
+                get_registry().count("cluster.stray_partials")
+                stray += 1
+                continue
             key = (host, msg["frag"])
             if key not in pending:
+                get_registry().count("cluster.stray_partials")
+                stray += 1
                 continue
             del pending[key]
+            if tele:
+                meta = self._frag_meta.pop(key, None) or {
+                    "host": host,
+                    "frag": int(msg["frag"]),
+                    "blocks": 0,
+                    "dispatch_s": 0.0,
+                }
+                meta["arrival_s"] = time.monotonic() - self._pass_t0
+                meta["worker"] = dict(msg.get("telemetry") or {})
+                arrivals.append(meta)
             f_sum += float(msg["f"])
             g_sum += np.asarray(msg["g"], dtype=np.float64)
             for st in msg.get("block_stats", ()):
                 gaps[int(st["block"])] = float(st.get("gap", 0.0))
                 block_stats.append(dict(st, host=host))
         self.assigner.update(gaps)
+        if tele:
+            self._profile_pass(pass_id, start_unix, arrivals, predicted, stray)
         return f_sum, g_sum, gaps, block_stats
+
+    def _send_fragment(
+        self,
+        handle: _WorkerHandle,
+        pass_id: int,
+        frag: int,
+        w: np.ndarray,
+        blocks: List[int],
+    ) -> bool:
+        """Send one ``pass`` fragment, stamping dispatch time when
+        telemetry is on. With telemetry off the message is byte-identical
+        to the plain plane (no extra keys)."""
+        msg = {
+            "type": "pass",
+            "pass_id": pass_id,
+            "frag": frag,
+            "w": w,
+            "blocks": blocks,
+        }
+        if self.telemetry_enabled:
+            msg["telemetry"] = True
+        if not self._send(handle, msg):
+            return False
+        if self.telemetry_enabled:
+            self._frag_meta[(handle.host, frag)] = {
+                "host": handle.host,
+                "frag": frag,
+                "blocks": len(blocks),
+                "dispatch_s": time.monotonic() - self._pass_t0,
+            }
+        return True
+
+    def _profile_pass(
+        self,
+        pass_id: int,
+        start_unix: float,
+        arrivals: List[dict],
+        predicted: Dict[int, float],
+        stray: int,
+    ) -> None:
+        """Fold one pass's fragment timeline into a skew profile.
+
+        The decomposition is exact by construction: ``busy_s`` (start →
+        first arrival, the fully overlapped compute window) +
+        ``allreduce_wait_s`` (first → last arrival, the skew window where
+        the coordinator waits on stragglers) + ``bubble_s`` (last arrival
+        → end, the coordinator's own fold + assigner update) == wall.
+        """
+        t_end = time.monotonic()
+        wall = max(t_end - self._pass_t0, 1e-12)
+        if arrivals:
+            first = min(a["arrival_s"] for a in arrivals)
+            last = max(a["arrival_s"] for a in arrivals)
+        else:
+            first = last = wall
+        hosts: Dict[int, dict] = {}
+        fragments: List[dict] = []
+        for a in arrivals:
+            worker = a.get("worker") or {}
+            h = hosts.setdefault(
+                int(a["host"]),
+                {
+                    "busy_s": 0.0,
+                    "wall_s": 0.0,
+                    "blocks": 0,
+                    "frags": 0,
+                    "decode_s": 0.0,
+                    "solve_s": 0.0,
+                    "reply_s": 0.0,
+                    "h2d_bytes": 0,
+                },
+            )
+            h["frags"] += 1
+            h["blocks"] += int(worker.get("blocks", a.get("blocks", 0)))
+            h["wall_s"] = max(h["wall_s"], float(a["arrival_s"]))
+            h["busy_s"] += float(worker.get("busy_s", 0.0))
+            h["decode_s"] += float(worker.get("decode_s", 0.0))
+            h["solve_s"] += float(worker.get("solve_s", 0.0))
+            h["reply_s"] += float(worker.get("reply_s", 0.0))
+            h["h2d_bytes"] += int(worker.get("h2d_bytes", 0))
+            fragments.append(
+                {
+                    "host": int(a["host"]),
+                    "frag": int(a["frag"]),
+                    "blocks": int(a.get("blocks", 0)),
+                    "dispatch_s": float(a.get("dispatch_s", 0.0)),
+                    "arrival_s": float(a["arrival_s"]),
+                    "busy_s": float(worker.get("busy_s", 0.0)),
+                }
+            )
+        total_busy = sum(h["busy_s"] for h in hosts.values())
+        for host, h in hosts.items():
+            if host in predicted:
+                h["predicted_share"] = float(predicted[host])
+            if total_busy > 0:
+                h["actual_share"] = h["busy_s"] / total_busy
+        walls = [h["wall_s"] for h in hosts.values()]
+        straggler_index = (
+            max(walls) / max(sum(walls) / len(walls), 1e-12) if walls else 1.0
+        )
+        straggler_host = (
+            max(hosts, key=lambda k: hosts[k]["wall_s"]) if hosts else -1
+        )
+        profile = {
+            "pass_id": pass_id,
+            "start_unix": start_unix,
+            "wall_s": wall,
+            "busy_s": first,
+            "allreduce_wait_s": max(last - first, 0.0),
+            "bubble_s": max(wall - last, 0.0),
+            "straggler_index": float(straggler_index),
+            "straggler_host": int(straggler_host),
+            "blocks": sum(h["blocks"] for h in hosts.values()),
+            "hosts": hosts,
+            "fragments": fragments,
+            "stray_partials": stray,
+            "requeued_blocks": self._pass_requeued,
+        }
+        self._pass_profiles.append(profile)
+        get_registry().record_cluster_pass(profile)
 
     def _recover(
         self,
@@ -302,6 +491,8 @@ class ClusterCoordinator:
             raise ClusterError("no live hosts to requeue blocks on")
         targets = self.assigner.reassign(blocks)
         get_registry().count("cluster.blocks_reassigned", len(blocks))
+        get_registry().count("cluster.requeued_blocks", len(blocks))
+        self._pass_requeued += len(blocks)
         self._events.append(
             {
                 "event": "blocks_reassigned",
@@ -312,16 +503,7 @@ class ClusterCoordinator:
         for host, blks in targets.items():
             handle = self.workers[host]
             frag = self._next_frag
-            if self._send(
-                handle,
-                {
-                    "type": "pass",
-                    "pass_id": pass_id,
-                    "frag": frag,
-                    "w": np.asarray(w),
-                    "blocks": blks,
-                },
-            ):
+            if self._send_fragment(handle, pass_id, frag, np.asarray(w), blks):
                 pending[(host, frag)] = blks
                 self._next_frag += 1
             else:
@@ -329,6 +511,19 @@ class ClusterCoordinator:
                 self._requeue(pass_id, blks, pending, w)
 
     # -- bookkeeping -------------------------------------------------------
+
+    def enable_telemetry(self, enabled: bool = True) -> None:
+        """Turn on per-pass skew profiling and worker timing piggyback.
+        Off by default: the disabled path sends byte-identical messages
+        and builds no profiles."""
+        self.telemetry_enabled = bool(enabled)
+
+    def drain_pass_profiles(self) -> List[dict]:
+        """Return and clear the skew profiles accumulated since the last
+        drain (one per :meth:`distributed_pass` with telemetry on)."""
+        out = self._pass_profiles
+        self._pass_profiles = []
+        return out
 
     def drain_events(self) -> List[dict]:
         out = self._events + self.assigner.drain_decisions()

@@ -68,13 +68,21 @@ class ClusterPlane:
         startup_timeout_s: Optional[float] = None,
         log_dir: Optional[str] = None,
         env: Optional[Dict[str, str]] = None,
+        telemetry_dir: Optional[str] = None,
     ) -> "ClusterPlane":
         """Spawn ``num_hosts`` workers over the same training files and
         block plan; ``kill_host=(h, n)`` arms host ``h`` to chaos-die after
-        streaming ``n`` blocks (the killed-host-mid-epoch drill)."""
+        streaming ``n`` blocks (the killed-host-mid-epoch drill).
+        ``telemetry_dir`` federates observability across the mesh: the
+        coordinator profiles every pass (skew/straggler attribution) and
+        each worker writes its own ledger to
+        ``{telemetry_dir}/worker-{host}-ledger.jsonl``."""
         coordinator = ClusterCoordinator(
             num_hosts, num_blocks, heartbeat_timeout_s=heartbeat_timeout_s
         )
+        if telemetry_dir is not None:
+            os.makedirs(telemetry_dir, exist_ok=True)
+            coordinator.enable_telemetry()
         if log_dir is None:
             log_dir = tempfile.mkdtemp(prefix="photon-cluster-")
         os.makedirs(log_dir, exist_ok=True)
@@ -116,6 +124,13 @@ class ClusterPlane:
                     cmd += ["--block-latency-s", str(block_latency_s)]
                 if kill_host is not None and kill_host[0] == host:
                     cmd += ["--chaos-kill-after", str(kill_host[1])]
+                if telemetry_dir is not None:
+                    cmd += [
+                        "--telemetry-out",
+                        os.path.join(
+                            telemetry_dir, f"worker-{host}-ledger.jsonl"
+                        ),
+                    ]
                 log_path = os.path.join(log_dir, f"worker-{host}.log")
                 log_paths.append(log_path)
                 log_f = open(log_path, "wb")
@@ -156,6 +171,9 @@ class ClusterPlane:
 
     def drain_events(self) -> List[dict]:
         return self.coordinator.drain_events()
+
+    def drain_pass_profiles(self) -> List[dict]:
+        return self.coordinator.drain_pass_profiles()
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -24,9 +24,18 @@ Message vocabulary (dicts keyed by ``"type"``):
 * ``residual``   coordinator -> workers: the CD residual plane for the
                  next solve (per outer iteration, not per pass).
 * ``pass``       coordinator -> worker: ``pass_id``, ``frag``, ``w``, and
-                 the ``blocks`` this host streams for this pass.
+                 the ``blocks`` this host streams for this pass. With
+                 coordinator telemetry enabled the message carries
+                 ``telemetry: True``, asking the worker to time itself.
 * ``partial``    worker -> coordinator: echo of ``pass_id``/``frag`` plus
                  the host's partial ``f``/``g`` sums and per-block stats.
+                 When the ``pass`` asked for telemetry, also a
+                 ``telemetry`` dict piggybacking the fragment timings —
+                 ``busy_s``/``decode_s``/``solve_s``/``reply_s``,
+                 ``blocks`` visited, ``h2d_bytes`` moved — so the skew
+                 profile needs no second transport. With telemetry off
+                 (the default) both messages are byte-identical to the
+                 plain plane: zero extra keys, zero extra messages.
 * ``heartbeat``  worker -> coordinator: liveness, sent from a dedicated
                  thread so a long jit compile never reads as death.
 * ``stop``       coordinator -> workers: drain and exit 0.

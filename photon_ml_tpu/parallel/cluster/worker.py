@@ -41,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...resilience.faultpoints import FatalInjectedFault, fault_point, register_fault_site
+from ...telemetry.metrics import get_registry
+from ...telemetry.span import span
 from ...streaming.blocks import StreamingSource
 from ...streaming.coordinate import (
     _fuse_block_offsets,
@@ -99,7 +101,10 @@ class ClusterWorker:
 
     # -- one pass fragment -------------------------------------------------
 
-    def _partial(self, w: np.ndarray, blocks: List[int]) -> dict:
+    def _partial(
+        self, w: np.ndarray, blocks: List[int], telemetry: bool = False
+    ) -> dict:
+        t0 = time.perf_counter() if telemetry else 0.0
         w_dev = jnp.asarray(w, dtype=jnp.float32)
         f = jnp.zeros((), dtype=w_dev.dtype)
         g = jnp.zeros((self._dim,), dtype=w_dev.dtype)
@@ -110,6 +115,7 @@ class ClusterWorker:
             depth=self.prefetch_depth,
             order=[int(b) for b in blocks],
         )
+        t_decode = time.perf_counter() if telemetry else 0.0
         for blk in prefetcher:
             fault_point(FAULT_SITE)
             if (
@@ -134,7 +140,7 @@ class ClusterWorker:
             self._blocks_done += 1
             if self.block_latency_s > 0:
                 time.sleep(self.block_latency_s)
-        return {
+        reply = {
             "f": float(f),
             "g": np.asarray(g, dtype=np.float64),
             "block_stats": [
@@ -147,6 +153,18 @@ class ClusterWorker:
                 for idx, bf, bg, bgap in stats
             ],
         }
+        if telemetry:
+            # Piggybacked fragment timing: decode (weight upload +
+            # prefetcher setup), solve (the block loop), plus blocks
+            # visited and H2D bytes moved. busy_s/reply_s are stamped by
+            # run() just before send, where the reply cost is known.
+            reply["telemetry"] = {
+                "decode_s": t_decode - t0,
+                "solve_s": time.perf_counter() - t_decode,
+                "blocks": len(stats),
+                "h2d_bytes": int(prefetcher.stats.h2d_bytes),
+            }
+        return reply
 
     # -- protocol loop -----------------------------------------------------
 
@@ -189,13 +207,40 @@ class ClusterWorker:
                         )
                     )
                 elif kind == "pass":
-                    reply = self._partial(msg["w"], msg["blocks"])
+                    # The coordinator only sets "telemetry" when its own
+                    # telemetry is enabled; without it the reply is
+                    # byte-identical to the plain plane.
+                    want_tele = bool(msg.get("telemetry"))
+                    t_recv = time.perf_counter() if want_tele else 0.0
+                    with span(
+                        "cluster/fragment",
+                        host=self.host_id,
+                        pass_id=int(msg["pass_id"]),
+                        frag=int(msg["frag"]),
+                        blocks=len(msg["blocks"]),
+                    ):
+                        reply = self._partial(
+                            msg["w"], msg["blocks"], telemetry=want_tele
+                        )
                     reply.update(
                         type="partial",
                         pass_id=msg["pass_id"],
                         frag=msg["frag"],
                         host=self.host_id,
                     )
+                    if want_tele:
+                        wt = reply["telemetry"]
+                        t_send = time.perf_counter()
+                        wt["reply_s"] = max(
+                            0.0,
+                            t_send - t_recv - wt["decode_s"] - wt["solve_s"],
+                        )
+                        wt["busy_s"] = t_send - t_recv
+                        reg = get_registry()
+                        reg.count("cluster.worker.fragments")
+                        reg.count("cluster.worker.blocks", wt["blocks"])
+                        reg.count("cluster.worker.h2d_bytes", wt["h2d_bytes"])
+                        reg.observe("cluster.worker.solve_s", wt["solve_s"])
                     msock.send(reply)
         except EOFError:
             logger.info("host %d: coordinator closed connection", self.host_id)
@@ -252,6 +297,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--block-cache-dir", default=None)
     p.add_argument("--block-latency-s", type=float, default=None)
     p.add_argument("--chaos-kill-after", type=int, default=None)
+    p.add_argument(
+        "--telemetry-out",
+        default=None,
+        metavar="LEDGER.jsonl",
+        help="write this worker's own run ledger (fragment spans, "
+        "cluster.worker.* counters) to this path; enables span tracing "
+        "in the worker process",
+    )
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -289,11 +342,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         block_latency_s=args.block_latency_s,
         chaos_kill_after=args.chaos_kill_after,
     )
+    run = None
+    if args.telemetry_out:
+        from ...telemetry import start_run
+
+        run = start_run(
+            f"cluster-worker-{args.host_id}", ledger_path=args.telemetry_out
+        )
     try:
         worker.run(_parse_address(args.coordinator_address))
     except FatalInjectedFault as exc:
         logger.error("chaos-killed: %s", exc)
         return 17
+    finally:
+        if run is not None:
+            try:
+                run.finish()
+            except Exception:
+                logger.exception("worker telemetry finish failed")
     return 0
 
 

@@ -170,6 +170,12 @@ class StreamingFixedEffectCoordinate(Coordinate):
     last_cluster_events: Optional[list] = dataclasses.field(
         default=None, repr=False
     )
+    # per-pass skew profiles (coordinator telemetry, when enabled) drained
+    # after each cluster solve for the progress ledger's
+    # cluster_pass/host_pass records
+    last_cluster_passes: Optional[list] = dataclasses.field(
+        default=None, repr=False
+    )
     # HBM residency plane (streaming/residency.py): a nonzero block budget
     # and/or a byte budget pins the top-gap blocks' device arrays across
     # passes, skipping their device_put entirely; the non-resident
@@ -533,6 +539,11 @@ class StreamingFixedEffectCoordinate(Coordinate):
         events = self.cluster.drain_events()
         if events:
             self.last_cluster_events = events
+        drain_profiles = getattr(self.cluster, "drain_pass_profiles", None)
+        if drain_profiles is not None:
+            profiles = drain_profiles()
+            if profiles:
+                self.last_cluster_passes = profiles
         return result
 
     def update_model(

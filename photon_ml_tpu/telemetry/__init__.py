@@ -37,6 +37,7 @@ from photon_ml_tpu.telemetry.sinks import (
     RunLedger,
     TelemetryEventListener,
     chrome_trace_events,
+    cluster_lane_events,
     format_summary_table,
     span_tree_summary,
     write_chrome_trace,
@@ -60,6 +61,8 @@ from photon_ml_tpu.telemetry.analyze import (
     analyze_ledger,
     analyze_records,
     classify_span,
+    cluster_report,
+    format_cluster_report,
     format_report,
 )
 
@@ -80,6 +83,7 @@ __all__ = [
     "RunLedger",
     "TelemetryEventListener",
     "chrome_trace_events",
+    "cluster_lane_events",
     "format_summary_table",
     "span_tree_summary",
     "write_chrome_trace",
@@ -98,5 +102,7 @@ __all__ = [
     "analyze_ledger",
     "analyze_records",
     "classify_span",
+    "cluster_report",
+    "format_cluster_report",
     "format_report",
 ]

@@ -134,6 +134,9 @@ class RunReport:
     # request-plane tail attribution (request_report) when the ledger
     # carries sampled "request" lifecycle records; None otherwise
     requests: Optional[Dict[str, Any]] = None
+    # cluster-plane skew attribution (cluster_report) when the ledger
+    # carries cluster_pass/host_pass progress records; None otherwise
+    cluster: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -396,6 +399,197 @@ def format_request_report(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def cluster_report(
+    records: Sequence[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """Cluster-plane skew attribution over ``cluster_pass``/``host_pass``
+    progress records (the coordinator's per-pass profiles).
+
+    Per pass the coordinator's decomposition is exact — busy (start →
+    first arrival) + allreduce wait (first → last arrival) + coordinator
+    bubble (last arrival → end) == wall — so ``attribution_coverage``
+    should sit at ~1.0; much below 1 means malformed records. Per host
+    it joins measured busy seconds and blocks against the assigner's
+    LPT-predicted gap shares (``share_error`` is the mean |predicted −
+    actual|, the assignment-quality signal a skew-aware assigner would
+    actuate on), ranks stragglers by how often each host was the last
+    arrival, and tracks the straggler-index trend across passes. Joins
+    kind="cluster" event records (rebalances, host losses) when present.
+    Returns None when the records carry no ``cluster_pass`` entries.
+    """
+    progress = [r for r in records if r.get("kind")]
+    passes = [r for r in progress if r.get("kind") == "cluster_pass"]
+    if not passes:
+        return None
+    host_rows = [r for r in progress if r.get("kind") == "host_pass"]
+
+    pass_rows: List[Dict[str, Any]] = []
+    tot_wall = tot_busy = tot_wait = tot_bubble = 0.0
+    straggler_counts: Dict[int, int] = {}
+    trend: List[float] = []
+    for r in passes:
+        wall = float(r.get("wall_s", 0.0))
+        busy = float(r.get("busy_s", 0.0))
+        wait = float(r.get("allreduce_wait_s", 0.0))
+        bubble = float(r.get("bubble_s", 0.0))
+        cov = (busy + wait + bubble) / wall if wall > 0 else 1.0
+        idx = float(r.get("straggler_index", 1.0))
+        trend.append(round(idx, 4))
+        sh = int(r.get("straggler_host", -1))
+        if sh >= 0:
+            straggler_counts[sh] = straggler_counts.get(sh, 0) + 1
+        pass_rows.append({
+            "outer": r.get("outer"),
+            "pass_id": r.get("pass_id"),
+            "hosts": int(r.get("hosts", 0)),
+            "blocks": int(r.get("blocks", 0)),
+            "wall_s": round(wall, 6),
+            "busy_s": round(busy, 6),
+            "allreduce_wait_s": round(wait, 6),
+            "bubble_s": round(bubble, 6),
+            "straggler_index": round(idx, 4),
+            "straggler_host": sh,
+            "attribution_coverage": round(cov, 6),
+            "stray_partials": int(r.get("stray_partials", 0)),
+            "requeued_blocks": int(r.get("requeued_blocks", 0)),
+        })
+        tot_wall += wall
+        tot_busy += busy
+        tot_wait += wait
+        tot_bubble += bubble
+
+    hosts: Dict[str, Dict[str, Any]] = {}
+    for r in host_rows:
+        h = hosts.setdefault(
+            str(r.get("host")),
+            {
+                "passes": 0,
+                "busy_s": 0.0,
+                "wall_s": 0.0,
+                "blocks": 0,
+                "h2d_bytes": 0,
+                "share_error": 0.0,
+                "_share_samples": 0,
+            },
+        )
+        h["passes"] += 1
+        h["busy_s"] = round(h["busy_s"] + float(r.get("busy_s", 0.0)), 9)
+        h["wall_s"] = round(h["wall_s"] + float(r.get("wall_s", 0.0)), 9)
+        h["blocks"] += int(r.get("blocks", 0))
+        h["h2d_bytes"] += int(r.get("h2d_bytes", 0))
+        if "predicted_share" in r and "actual_share" in r:
+            h["share_error"] += abs(
+                float(r["predicted_share"]) - float(r["actual_share"])
+            )
+            h["_share_samples"] += 1
+    for h in hosts.values():
+        n = h.pop("_share_samples")
+        h["share_error"] = round(h["share_error"] / n, 6) if n else None
+        h["times_straggler"] = 0
+    for sh, n in straggler_counts.items():
+        if str(sh) in hosts:
+            hosts[str(sh)]["times_straggler"] = n
+    ranking = sorted(
+        hosts,
+        key=lambda k: (-hosts[k]["times_straggler"], -hosts[k]["wall_s"]),
+    )
+
+    events: Dict[str, int] = {}
+    for r in progress:
+        if r.get("kind") == "cluster":
+            ev = str(r.get("event", "unknown"))
+            events[ev] = events.get(ev, 0) + 1
+
+    return {
+        "num_passes": len(pass_rows),
+        "num_hosts": len(hosts),
+        "wall_s": round(tot_wall, 6),
+        "busy_s": round(tot_busy, 6),
+        "allreduce_wait_s": round(tot_wait, 6),
+        "bubble_s": round(tot_bubble, 6),
+        "busy_frac": round(tot_busy / tot_wall, 6) if tot_wall else 1.0,
+        "comm_wait_frac": round(tot_wait / tot_wall, 6) if tot_wall else 0.0,
+        "bubble_frac": round(tot_bubble / tot_wall, 6) if tot_wall else 0.0,
+        "attribution_coverage": (
+            round((tot_busy + tot_wait + tot_bubble) / tot_wall, 6)
+            if tot_wall
+            else 1.0
+        ),
+        "straggler_index_mean": round(sum(trend) / len(trend), 4),
+        "imbalance_trend": trend,
+        "straggler_ranking": ranking,
+        "hosts": hosts,
+        "passes": pass_rows,
+        "events": events,
+        "stray_partials": sum(p["stray_partials"] for p in pass_rows),
+        "requeued_blocks": sum(p["requeued_blocks"] for p in pass_rows),
+    }
+
+
+def format_cluster_report(report: Dict[str, Any]) -> str:
+    """Human-readable cluster skew tables (``analyze_run --cluster`` and
+    the live ``/cluster`` route's text form)."""
+    lines = [
+        f"cluster plane: {report['num_passes']} distributed pass(es) over "
+        f"{report['num_hosts']} host(s)"
+    ]
+    lines.append(
+        f"  wall {report['wall_s']:.4f}s = busy {report['busy_s']:.4f}s "
+        f"({report['busy_frac'] * 100:.1f}%) + allreduce wait "
+        f"{report['allreduce_wait_s']:.4f}s "
+        f"({report['comm_wait_frac'] * 100:.1f}%) + coordinator bubble "
+        f"{report['bubble_s']:.4f}s ({report['bubble_frac'] * 100:.1f}%) — "
+        f"coverage {report['attribution_coverage'] * 100:.2f}%"
+    )
+    lines.append(
+        f"  {'pass':>5} {'hosts':>5} {'blocks':>6} {'wall s':>9} "
+        f"{'busy s':>9} {'wait s':>9} {'skew':>6} {'requeue':>7}"
+    )
+    for p in report.get("passes") or []:
+        lines.append(
+            f"  {p['pass_id']:>5} {p['hosts']:>5} {p['blocks']:>6} "
+            f"{p['wall_s']:>9.4f} {p['busy_s']:>9.4f} "
+            f"{p['allreduce_wait_s']:>9.4f} {p['straggler_index']:>6.2f} "
+            f"{p['requeued_blocks']:>7}"
+        )
+    hosts = report.get("hosts") or {}
+    if hosts:
+        lines.append(
+            f"  {'host':>5} {'busy s':>9} {'blocks':>6} {'h2d MB':>8} "
+            f"{'straggler':>9} {'share err':>9}"
+        )
+        for host in sorted(hosts, key=lambda k: int(k) if k.isdigit() else 0):
+            h = hosts[host]
+            err = h.get("share_error")
+            lines.append(
+                f"  {host:>5} {h['busy_s']:>9.4f} {h['blocks']:>6} "
+                f"{h['h2d_bytes'] / 1e6:>8.2f} {h['times_straggler']:>9} "
+                + (f"{err:>9.4f}" if err is not None else f"{'—':>9}")
+            )
+    ranking = report.get("straggler_ranking") or []
+    if ranking:
+        lines.append("  straggler ranking (worst first): " + ", ".join(
+            f"host {h}" for h in ranking
+        ))
+    trend = report.get("imbalance_trend") or []
+    if trend:
+        lines.append(
+            "  imbalance trend (straggler index per pass): "
+            + " ".join(f"{x:.2f}" for x in trend)
+            + f"   mean {report['straggler_index_mean']:.2f}"
+        )
+    if report.get("stray_partials"):
+        lines.append(
+            f"  stray partials dropped: {report['stray_partials']}"
+        )
+    events = report.get("events") or {}
+    if events:
+        lines.append("  events: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(events.items())
+        ))
+    return "\n".join(lines)
+
+
 def analyze_records(
     records: Sequence[Dict[str, Any]],
     source_path: Optional[str] = None,
@@ -636,6 +830,7 @@ def analyze_records(
             convergence_report(progress_recs) if progress_recs else None
         ),
         requests=request_report(request_recs) if request_recs else None,
+        cluster=cluster_report(progress_recs) if progress_recs else None,
     )
 
 
@@ -729,6 +924,14 @@ def format_report(report: RunReport) -> str:
             f"lifecycle record(s), tail worst stage "
             f"'{tail.get('worst_stage', '?')}' — full attribution via "
             "analyze_run --requests"
+        )
+    if report.cluster:
+        clu = report.cluster
+        lines.append(
+            f"  cluster plane: {clu.get('num_passes', 0)} distributed "
+            f"pass(es) over {clu.get('num_hosts', 0)} host(s), comm wait "
+            f"{clu.get('comm_wait_frac', 0.0) * 100:.1f}% of pass wall — "
+            "full skew attribution via analyze_run --cluster"
         )
     if report.warnings:
         lines.append("")

@@ -231,6 +231,33 @@ class MetricsRegistry:
         ):
             self.count(f"transfer.{field}", getattr(transfers, field, 0))
 
+    def record_cluster_pass(self, profile: Dict[str, Any]) -> None:
+        """Fold one distributed-pass skew profile (the coordinator's
+        telemetry, parallel/cluster) into cluster.* metrics: pass-level
+        wall/wait histograms plus host-scoped busy/blocks via the same
+        ``scoped`` mechanism the tenancy plane uses."""
+        self.count("cluster.passes")
+        self.observe("cluster.pass.wall_s", float(profile.get("wall_s", 0.0)))
+        self.observe(
+            "cluster.pass.allreduce_wait_s",
+            float(profile.get("allreduce_wait_s", 0.0)),
+        )
+        self.gauge(
+            "cluster.pass.bubble_s", float(profile.get("bubble_s", 0.0))
+        )
+        self.gauge(
+            "cluster.straggler_index",
+            float(profile.get("straggler_index", 1.0)),
+        )
+        for host, h in (profile.get("hosts") or {}).items():
+            scoped = self.scoped({"host": str(host)})
+            scoped.gauge("cluster.host.busy_s", float(h.get("busy_s", 0.0)))
+            scoped.gauge("cluster.host.wall_s", float(h.get("wall_s", 0.0)))
+            scoped.count("cluster.host.blocks", float(h.get("blocks", 0)))
+            scoped.count(
+                "cluster.host.h2d_bytes", float(h.get("h2d_bytes", 0))
+            )
+
     def record_serving_snapshot(self, snap: Dict[str, Any]) -> None:
         """Fold a serving metrics snapshot dict into serving.* gauges.
 

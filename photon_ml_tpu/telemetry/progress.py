@@ -114,6 +114,9 @@ class ConvergenceTracker:
         self.abort_on_divergence = bool(abort_on_divergence)
         self._lock = threading.RLock()
         self.records: List[Dict[str, Any]] = []
+        # full skew profiles (fragment timelines included) — the trimmed
+        # ledger records keep only the per-pass/per-host aggregates
+        self.cluster_passes: List[Dict[str, Any]] = []
         self.anomaly: Optional[Dict[str, Any]] = None
         self._last_objective: Optional[float] = None
         self._resilience_count = 0
@@ -314,6 +317,79 @@ class ConvergenceTracker:
                 self._emit(rec)
             if events:
                 self.registry.count("progress.cluster_records", len(events))
+
+    def record_cluster_passes(
+        self, outer: int, coordinate: str, profiles: List[Dict[str, Any]]
+    ) -> None:
+        """Per-pass skew profiles of a distributed streamed solve
+        (``ClusterCoordinator.drain_pass_profiles()``): one ``cluster_pass``
+        record per pass (wall decomposed exactly into busy + allreduce
+        wait + coordinator bubble, plus the straggler picture) and one
+        ``host_pass`` record per (pass, host) with that host's measured
+        busy/wall/blocks and its predicted-vs-actual work share. Full
+        profiles (with fragment timelines) stay in ``self.cluster_passes``
+        for the /cluster route and per-host trace-lane export."""
+        with self._lock:
+            if self._closed:
+                return
+            for p in profiles:
+                hosts = p.get("hosts") or {}
+                self._emit({
+                    "kind": "cluster_pass",
+                    "outer": int(outer),
+                    "coordinate": str(coordinate),
+                    "pass_id": int(p["pass_id"]),
+                    "wall_s": float(p["wall_s"]),
+                    "busy_s": float(p["busy_s"]),
+                    "allreduce_wait_s": float(p["allreduce_wait_s"]),
+                    "bubble_s": float(p["bubble_s"]),
+                    "straggler_index": float(p.get("straggler_index", 1.0)),
+                    "straggler_host": int(p.get("straggler_host", -1)),
+                    "hosts": len(hosts),
+                    "blocks": int(p.get("blocks", 0)),
+                    "stray_partials": int(p.get("stray_partials", 0)),
+                    "requeued_blocks": int(p.get("requeued_blocks", 0)),
+                })
+                for host in sorted(hosts, key=int):
+                    h = hosts[host]
+                    rec: Dict[str, Any] = {
+                        "kind": "host_pass",
+                        "outer": int(outer),
+                        "coordinate": str(coordinate),
+                        "pass_id": int(p["pass_id"]),
+                        "host": int(host),
+                        "busy_s": float(h.get("busy_s", 0.0)),
+                        "wall_s": float(h.get("wall_s", 0.0)),
+                        "blocks": int(h.get("blocks", 0)),
+                        "frags": int(h.get("frags", 0)),
+                        "decode_s": float(h.get("decode_s", 0.0)),
+                        "solve_s": float(h.get("solve_s", 0.0)),
+                        "reply_s": float(h.get("reply_s", 0.0)),
+                        "h2d_bytes": int(h.get("h2d_bytes", 0)),
+                    }
+                    if h.get("predicted_share") is not None:
+                        rec["predicted_share"] = float(h["predicted_share"])
+                    if h.get("actual_share") is not None:
+                        rec["actual_share"] = float(h["actual_share"])
+                    self._emit(rec)
+            if profiles:
+                self.cluster_passes.extend(dict(p) for p in profiles)
+                self.registry.count(
+                    "progress.cluster_pass_records", len(profiles)
+                )
+
+    def cluster_json(self) -> Dict[str, Any]:
+        """Payload for the live ``/cluster`` introspection route: the
+        skew profiles recorded so far plus the latest straggler picture."""
+        with self._lock:
+            passes = [dict(p) for p in self.cluster_passes]
+        doc: Dict[str, Any] = {"num_passes": len(passes), "passes": passes}
+        if passes:
+            last = passes[-1]
+            doc["straggler_index_last"] = last.get("straggler_index")
+            doc["straggler_host_last"] = last.get("straggler_host")
+            doc["allreduce_wait_last_s"] = last.get("allreduce_wait_s")
+        return doc
 
     def record_resilience(
         self,

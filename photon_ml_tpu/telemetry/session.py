@@ -45,8 +45,15 @@ class TelemetryRun:
         self._emitters: List[Any] = []
         self._finished = False
         self._spans_flushed = 0
+        self._extra_trace_events: List[Dict[str, Any]] = []
         if self.ledger is not None:
             self.ledger.write("meta", phase="start", label=label)
+
+    def add_trace_events(self, events) -> None:
+        """Queue pre-built Chrome trace events (e.g. the per-host cluster
+        lanes from :func:`~photon_ml_tpu.telemetry.sinks.cluster_lane_events`)
+        for the trace file ``finish`` writes. No-op without a trace path."""
+        self._extra_trace_events.extend(events)
 
     def attach(self, emitter) -> _sinks.TelemetryEventListener:
         """Register the event bridge on ``emitter`` and track it so
@@ -107,6 +114,8 @@ class TelemetryRun:
                 self.trace_path,
                 spans,
                 metadata={"label": self.label, "num_spans": len(spans)},
+                extra_events=self._extra_trace_events or None,
+                pid_key="host",
             )
             _log.info("wrote chrome trace (%d events) to %s", n, self.trace_path)
         if self.ledger is not None:

@@ -177,15 +177,25 @@ class TelemetryEventListener(EventListener):
 # ---------------------------------------------------------------- chrome
 
 def chrome_trace_events(
-    spans: Iterable[SpanRecord], pid: int = 0
+    spans: Iterable[SpanRecord], pid: int = 0, pid_key: Optional[str] = None
 ) -> List[Dict[str, Any]]:
     """Spans as Chrome trace-event dicts (``ph: "X"`` complete events).
-    Timestamps/durations are microseconds relative to the tracer origin."""
+    Timestamps/durations are microseconds relative to the tracer origin.
+    ``pid_key`` names a span attribute whose integer value becomes the
+    event's pid — per-host lanes for cluster-plane spans (a worker's
+    ``cluster/fragment`` spans carry ``host=N``); spans without the
+    attribute keep the default ``pid``."""
     events: List[Dict[str, Any]] = []
     for rec in spans:
         args = {str(k): _jsonable(v) for k, v in rec.attrs.items()}
         if rec.failed:
             args["error"] = rec.error
+        event_pid = pid
+        if pid_key is not None and pid_key in rec.attrs:
+            try:
+                event_pid = 1 + int(rec.attrs[pid_key])
+            except (TypeError, ValueError):
+                pass
         events.append(
             {
                 "name": rec.name,
@@ -193,7 +203,7 @@ def chrome_trace_events(
                 "ph": "X",
                 "ts": rec.start_s * 1e6,
                 "dur": rec.duration_s * 1e6,
-                "pid": pid,
+                "pid": event_pid,
                 "tid": rec.thread_id,
                 "args": args,
             }
@@ -201,13 +211,50 @@ def chrome_trace_events(
     return events
 
 
+def cluster_lane_events(
+    cluster_passes: Iterable[Dict[str, Any]], origin_unix: float = 0.0
+) -> List[Dict[str, Any]]:
+    """Per-host Chrome trace lanes from the coordinator's skew profiles
+    (``ClusterCoordinator.drain_pass_profiles()`` /
+    ``ConvergenceTracker.cluster_passes``): one ``X`` event per dispatched
+    fragment spanning dispatch→arrival, on ``pid = 1 + host`` so each
+    worker host gets its own track while the coordinator's spans stay on
+    pid 0. ``origin_unix`` is the tracer origin the coordinator's own
+    spans are relative to, so the lanes line up with them."""
+    events: List[Dict[str, Any]] = []
+    for p in cluster_passes:
+        base = float(p.get("start_unix", 0.0)) - float(origin_unix)
+        for f in p.get("fragments", ()):
+            dispatch = float(f.get("dispatch_s", 0.0))
+            arrival = float(f.get("arrival_s", dispatch))
+            events.append(
+                {
+                    "name": f"pass {p.get('pass_id')} frag {f.get('frag')}",
+                    "cat": "cluster",
+                    "ph": "X",
+                    "ts": max(0.0, base + dispatch) * 1e6,
+                    "dur": max(0.0, arrival - dispatch) * 1e6,
+                    "pid": 1 + int(f.get("host", 0)),
+                    "tid": 0,
+                    "args": {str(k): _jsonable(v) for k, v in f.items()},
+                }
+            )
+    return events
+
+
 def write_chrome_trace(
     path: str,
     spans: Iterable[SpanRecord],
     metadata: Optional[Dict[str, Any]] = None,
+    extra_events: Optional[Iterable[Dict[str, Any]]] = None,
+    pid_key: Optional[str] = None,
 ) -> int:
-    """Write a Perfetto-loadable trace file; returns the event count."""
-    events = chrome_trace_events(spans)
+    """Write a Perfetto-loadable trace file; returns the event count.
+    ``extra_events`` (already trace-event dicts, e.g. from
+    :func:`cluster_lane_events`) are appended verbatim."""
+    events = chrome_trace_events(spans, pid_key=pid_key)
+    if extra_events is not None:
+        events.extend(extra_events)
     doc: Dict[str, Any] = {
         "traceEvents": events,
         "displayTimeUnit": "ms",

@@ -54,6 +54,17 @@ _PROGRESS_SCHEMAS: Dict[str, tuple] = {
     # cluster plane (parallel/cluster): block rebalance / host-loss /
     # reassignment events of a distributed solve
     "cluster": ("outer", "coordinate", "event"),
+    # cluster plane skew profiles: one record per distributed pass with
+    # the exact wall-clock decomposition (busy + allreduce wait +
+    # coordinator bubble == wall) ...
+    "cluster_pass": ("outer", "coordinate", "pass_id", "wall_s", "busy_s",
+                     "allreduce_wait_s", "bubble_s", "straggler_index",
+                     "hosts"),
+    # ... plus one record per (pass, host) with that host's measured
+    # busy/wall and blocks visited (and, when present, the assigner's
+    # predicted_share vs the measured actual_share)
+    "host_pass": ("outer", "coordinate", "pass_id", "host", "busy_s",
+                  "wall_s", "blocks"),
     # HBM residency plane (streaming/residency.py): one record per
     # pin/evict decision — which block, on what gap score, byte delta
     "residency": ("outer", "coordinate", "epoch", "action", "block",

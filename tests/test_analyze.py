@@ -267,6 +267,143 @@ class TestAnalyzeRunCli:
             assert knob["rationale"], name
 
 
+def _cluster_records():
+    """2-host / 3-pass cluster ledger: host 1 is the consistent straggler
+    (arrives last every pass), pass walls decompose exactly into busy +
+    allreduce wait + coordinator bubble, and a rebalance event rides
+    along — the merged multi-host shape train_game --hosts emits."""
+    recs = [
+        {"type": "meta", "ts": 2000.0, "phase": "start", "label": "mh"},
+    ]
+    ts = 2000.5
+    for pass_id in range(3):
+        # host 0 finishes at 0.8s, host 1 at 1.0s; coordinator folds for
+        # another 0.1s -> wall 1.1 = busy 0.8 + wait 0.2 + bubble 0.1
+        recs.append({
+            "type": "progress", "ts": ts, "kind": "cluster_pass",
+            "outer": 0, "coordinate": "fixed", "pass_id": pass_id,
+            "wall_s": 1.1, "busy_s": 0.8, "allreduce_wait_s": 0.2,
+            "bubble_s": 0.1, "straggler_index": 1.1 + 0.01 * pass_id,
+            "straggler_host": 1, "hosts": 2, "blocks": 8,
+            "stray_partials": 1 if pass_id == 0 else 0,
+            "requeued_blocks": 0,
+        })
+        for host, busy, wall, share in (
+            (0, 0.78, 0.8, 0.52), (1, 0.97, 1.0, 0.48),
+        ):
+            recs.append({
+                "type": "progress", "ts": ts + 0.001, "kind": "host_pass",
+                "outer": 0, "coordinate": "fixed", "pass_id": pass_id,
+                "host": host, "busy_s": busy, "wall_s": wall, "blocks": 4,
+                "frags": 1, "decode_s": 0.3, "solve_s": 0.45,
+                "reply_s": 0.03, "h2d_bytes": 1_000_000,
+                "predicted_share": 0.5, "actual_share": share,
+            })
+        ts += 1.2
+    recs.append({
+        "type": "progress", "ts": ts, "kind": "cluster",
+        "outer": 0, "coordinate": "fixed", "event": "rebalance",
+    })
+    recs.append({"type": "meta", "ts": 2005.0, "phase": "finish"})
+    return recs
+
+
+class TestClusterReport:
+    def test_two_host_attribution_and_coverage(self):
+        """The tentpole contract: ≥95% of each pass's wall attributed to
+        busy / allreduce wait / bubble, per-host busy+blocks joined."""
+        report = analyze_records(_cluster_records())
+        cl = report.cluster
+        assert cl is not None
+        assert cl["num_passes"] == 3
+        assert cl["num_hosts"] == 2
+        # decomposition is exact by construction -> coverage ~1.0
+        assert cl["attribution_coverage"] == pytest.approx(1.0, abs=1e-6)
+        for p in cl["passes"]:
+            assert p["attribution_coverage"] == pytest.approx(1.0, abs=1e-6)
+        assert cl["busy_frac"] == pytest.approx(0.8 / 1.1, abs=1e-4)
+        assert cl["comm_wait_frac"] == pytest.approx(0.2 / 1.1, abs=1e-4)
+        # per-host attribution: both hosts present with busy time + blocks
+        assert set(cl["hosts"]) == {"0", "1"}
+        for h in cl["hosts"].values():
+            assert h["passes"] == 3
+            assert h["busy_s"] > 0
+            assert h["blocks"] == 12
+            assert h["h2d_bytes"] == 3_000_000
+        # share_error = mean |predicted - actual| = |0.5 - 0.52|
+        assert cl["hosts"]["0"]["share_error"] == pytest.approx(0.02)
+        assert cl["hosts"]["1"]["share_error"] == pytest.approx(0.02)
+
+    def test_straggler_ranking_trend_and_events(self):
+        cl = analyze_records(_cluster_records()).cluster
+        # host 1 was the last arrival in every pass
+        assert cl["straggler_ranking"][0] == "1"
+        assert cl["hosts"]["1"]["times_straggler"] == 3
+        assert cl["hosts"]["0"]["times_straggler"] == 0
+        assert cl["imbalance_trend"] == [1.1, 1.11, 1.12]
+        assert cl["straggler_index_mean"] == pytest.approx(1.11)
+        assert cl["stray_partials"] == 1
+        assert cl["events"] == {"rebalance": 1}
+
+    def test_no_cluster_records_means_none(self):
+        from photon_ml_tpu.telemetry import cluster_report
+
+        report = analyze_records(_synthetic_records())
+        assert report.cluster is None
+        assert cluster_report(_synthetic_records()) is None
+
+    def test_report_round_trips_through_json(self):
+        report = analyze_records(_cluster_records())
+        doc = json.loads(json.dumps(report.to_dict()))
+        assert doc["cluster"]["num_hosts"] == 2
+        assert doc["cluster"]["attribution_coverage"] == pytest.approx(1.0)
+
+    def test_format_cluster_report_renders_tables(self):
+        from photon_ml_tpu.telemetry import format_cluster_report
+
+        text = format_cluster_report(
+            analyze_records(_cluster_records()).cluster
+        )
+        assert "cluster plane: 3 distributed pass(es) over 2 host(s)" in text
+        assert "allreduce wait" in text
+        assert "straggler ranking (worst first): host 1, host 0" in text
+        assert "imbalance trend" in text
+        assert "stray partials dropped: 1" in text
+        # the one-line pointer also lands in the main report
+        assert "analyze_run --cluster" in format_report(report=analyze_records(
+            _cluster_records()
+        ))
+
+    def test_truncated_worker_ledger_tolerated(self, tmp_path):
+        """A chaos-killed worker leaves a ledger cut mid-write; the merged
+        analysis must still build the cluster report from the valid
+        prefix (warn, don't crash)."""
+        path = _write_ledger(tmp_path / "cut.jsonl", _cluster_records())
+        with open(path, "a") as f:
+            f.write('{"type": "progress", "kind": "host_pa')  # no newline
+        report = analyze_ledger(path)
+        assert any("partial record" in w for w in report.warnings)
+        assert report.cluster is not None
+        assert report.cluster["num_passes"] == 3
+        assert report.cluster["num_hosts"] == 2
+
+    def test_analyze_run_cluster_flag(self, tmp_path, capsys):
+        from photon_ml_tpu.cli.analyze_run import main
+
+        path = _write_ledger(tmp_path / "mh.jsonl", _cluster_records())
+        assert main([path, "--cluster"]) == 0
+        out = capsys.readouterr().out
+        assert "cluster plane: 3 distributed pass(es)" in out
+        assert "straggler ranking" in out
+
+    def test_analyze_run_cluster_flag_without_records(self, tmp_path, capsys):
+        from photon_ml_tpu.cli.analyze_run import main
+
+        path = _write_ledger(tmp_path / "plain.jsonl", _synthetic_records())
+        assert main([path, "--cluster"]) == 1
+        assert "no cluster_pass records" in capsys.readouterr().err
+
+
 @pytest.mark.slow
 class TestAnalyzeTrainGate:
     @pytest.fixture(scope="class")

@@ -447,6 +447,247 @@ class TestKilledHostRecovery:
         assert all(r["coordinate"] == "fixed" for r in recs)
 
 
+class TestSkewProfile:
+    """Coordinator telemetry: per-pass skew profiles, wire-level gating,
+    stray-partial accounting, and heartbeat-check starvation."""
+
+    def test_profile_decomposes_pass_wall_exactly(self, cluster_dataset):
+        dim = _open_source(cluster_dataset).plan.shard_dims["global"]
+        plane = _plane(cluster_dataset, hosts=2)
+        plane.enable_telemetry()
+        try:
+            plane.distributed_pass(np.zeros(dim, dtype=np.float32))
+            plane.distributed_pass(np.zeros(dim, dtype=np.float32))
+        finally:
+            plane.shutdown()
+        profiles = plane.drain_pass_profiles()
+        assert len(profiles) == 2
+        assert plane.drain_pass_profiles() == []  # drained
+        for p in profiles:
+            # exact decomposition: busy + allreduce wait + bubble == wall
+            assert p["busy_s"] + p["allreduce_wait_s"] + p["bubble_s"] == (
+                pytest.approx(p["wall_s"], rel=1e-6)
+            )
+            assert sorted(p["hosts"]) == [0, 1]
+            assert p["blocks"] == 4
+            assert p["straggler_host"] in (0, 1)
+            assert p["straggler_index"] >= 1.0
+            for h in p["hosts"].values():
+                assert h["busy_s"] > 0
+                assert h["blocks"] == 2
+                assert h["predicted_share"] == pytest.approx(0.5, abs=0.2)
+                assert 0.0 < h["actual_share"] < 1.0
+            frags = p["fragments"]
+            assert {f["host"] for f in frags} == {0, 1}
+            assert all(
+                f["arrival_s"] >= f["dispatch_s"] >= 0.0 for f in frags
+            )
+
+    def test_disabled_path_sends_byte_identical_messages(self):
+        import socket as _socket
+
+        from photon_ml_tpu.parallel.cluster.coordinator import _WorkerHandle
+
+        coord = ClusterCoordinator(1, 4)
+        a, b = _socket.socketpair()
+        handle = _WorkerHandle(0, MessageSocket(a))
+        peer = MessageSocket(b)
+        try:
+            coord._pass_t0 = 0.0
+            assert coord._send_fragment(
+                handle, 1, 0, np.zeros(2, dtype=np.float32), [0, 1]
+            )
+            msg = peer.recv()
+            # no telemetry key, nothing beyond the PR 17 vocabulary
+            assert set(msg) == {"type", "pass_id", "frag", "w", "blocks"}
+            assert coord._frag_meta == {}
+
+            coord.enable_telemetry()
+            import time as _time
+
+            coord._pass_t0 = _time.monotonic()
+            assert coord._send_fragment(
+                handle, 1, 1, np.zeros(2, dtype=np.float32), [2, 3]
+            )
+            msg = peer.recv()
+            assert msg["telemetry"] is True
+            assert (0, 1) in coord._frag_meta
+        finally:
+            handle.msock.close()
+            peer.close()
+            coord.shutdown()
+
+    def test_stray_partials_are_counted_not_silent(self, cluster_dataset):
+        reg = get_registry()
+        stray0 = reg.counter_value("cluster.stray_partials")
+        dim = _open_source(cluster_dataset).plan.shard_dims["global"]
+        plane = _plane(cluster_dataset, hosts=2)
+        plane.enable_telemetry()
+        # a reply from an abandoned pass sits in the inbox when the next
+        # pass starts draining
+        plane._inbox.put((0, {
+            "type": "partial", "pass_id": -99, "frag": 0, "host": 0,
+            "f": 0.0, "g": np.zeros(dim, dtype=np.float64),
+            "block_stats": [],
+        }))
+        try:
+            plane.distributed_pass(np.zeros(dim, dtype=np.float32))
+        finally:
+            plane.shutdown()
+        assert reg.counter_value("cluster.stray_partials") == stray0 + 1
+        (profile,) = plane.drain_pass_profiles()
+        assert profile["stray_partials"] == 1
+
+    def test_heartbeat_check_not_starved_by_busy_inbox(self):
+        """A chatty inbox must not defer dead-host detection: host 1
+        wedges (never replies, never heartbeats) while host 0 floods the
+        inbox; the interval check must still lose host 1 and requeue."""
+        import socket as _socket
+        import threading
+        import time as _time
+
+        from photon_ml_tpu.parallel.cluster.coordinator import _WorkerHandle
+
+        reg = get_registry()
+        hb0 = reg.counter_value("cluster.host_failures")
+        rq0 = reg.counter_value("cluster.requeued_blocks")
+        coord = ClusterCoordinator(2, 4, heartbeat_timeout_s=0.3)
+        peers = {}
+        for h in range(2):
+            a, b = _socket.socketpair()
+            handle = _WorkerHandle(h, MessageSocket(a))
+            coord.workers[h] = handle
+            peers[h] = MessageSocket(b)
+            threading.Thread(
+                target=coord._reader, args=(handle,), daemon=True
+            ).start()
+        coord.workers[1].last_seen = _time.monotonic() - 10.0
+        stop = threading.Event()
+
+        def _host0():
+            try:
+                while not stop.is_set():
+                    msg = peers[0].recv()
+                    if msg.get("type") != "pass":
+                        return
+                    peers[0].send({
+                        "type": "partial", "pass_id": msg["pass_id"],
+                        "frag": msg["frag"], "host": 0,
+                        "f": 0.0, "g": np.zeros(3, dtype=np.float64),
+                        "block_stats": [
+                            {"block": int(blk), "partial_loss": 0.0,
+                             "partial_grad_norm": 0.0, "gap": 0.0}
+                            for blk in msg["blocks"]
+                        ],
+                    })
+            except (EOFError, OSError):
+                pass
+
+        def _flood():
+            # keep the inbox non-empty so queue.Empty (the old, starved
+            # check site) never fires
+            try:
+                while not stop.is_set():
+                    peers[0].send({
+                        "type": "partial", "pass_id": -1, "frag": 0,
+                        "host": 0, "f": 0.0,
+                        "g": np.zeros(3, dtype=np.float64),
+                        "block_stats": [],
+                    })
+                    _time.sleep(0.002)
+            except (EOFError, OSError):
+                pass
+
+        threading.Thread(target=_host0, daemon=True).start()
+        threading.Thread(target=_flood, daemon=True).start()
+        try:
+            t0 = _time.monotonic()
+            f, g, gaps, stats = coord.distributed_pass(
+                np.zeros(3, dtype=np.float32)
+            )
+            elapsed = _time.monotonic() - t0
+        finally:
+            stop.set()
+            coord.shutdown()
+            for p in peers.values():
+                p.close()
+        assert not coord.workers[1].alive
+        assert sorted(gaps) == [0, 1, 2, 3]
+        assert reg.counter_value("cluster.host_failures") == hb0 + 1
+        assert reg.counter_value("cluster.requeued_blocks") >= rq0 + 2
+        # detection happened on the interval, not after the flood ended
+        assert elapsed < 5.0
+
+    def test_heartbeat_interarrival_gauge(self):
+        import socket as _socket
+        import threading
+        import time as _time
+
+        from photon_ml_tpu.parallel.cluster.coordinator import _WorkerHandle
+
+        coord = ClusterCoordinator(1, 4)
+        a, b = _socket.socketpair()
+        handle = _WorkerHandle(0, MessageSocket(a))
+        coord.workers[0] = handle
+        peer = MessageSocket(b)
+        threading.Thread(
+            target=coord._reader, args=(handle,), daemon=True
+        ).start()
+        try:
+            for _ in range(3):
+                peer.send({"type": "heartbeat", "host": 0})
+                _time.sleep(0.03)
+            deadline = _time.monotonic() + 5.0
+            while (
+                len(handle.beat_deltas) < 2
+                and _time.monotonic() < deadline
+            ):
+                _time.sleep(0.01)
+        finally:
+            peer.close()
+            coord.shutdown()
+        assert len(handle.beat_deltas) >= 2
+        snap = get_registry().snapshot()
+        name = 'cluster.heartbeat_interarrival_p99_s{host="0"}'
+        assert name in snap["gauges"]
+        assert snap["gauges"][name]["last"] > 0.0
+
+    def test_profiles_reach_progress_ledger_and_cluster_json(
+        self, cluster_dataset
+    ):
+        from photon_ml_tpu.telemetry import ConvergenceTracker
+
+        tracker = ConvergenceTracker(abort_on_divergence=False)
+        plane = _plane(cluster_dataset, hosts=2)
+        plane.enable_telemetry()
+        try:
+            _estimator().fit_streaming(
+                _open_source(cluster_dataset),
+                prefetch_depth=2,
+                cluster=plane,
+                progress=tracker,
+            )
+        finally:
+            plane.shutdown()
+        tracker.finish()
+        pass_recs = [
+            r for r in tracker.records if r.get("kind") == "cluster_pass"
+        ]
+        host_recs = [
+            r for r in tracker.records if r.get("kind") == "host_pass"
+        ]
+        assert pass_recs, "skew profiles must reach the progress ledger"
+        assert {r["host"] for r in host_recs} == {0, 1}
+        for r in pass_recs:
+            assert r["busy_s"] + r["allreduce_wait_s"] + r["bubble_s"] == (
+                pytest.approx(r["wall_s"], rel=1e-6)
+            )
+            assert r["hosts"] == 2
+        doc = tracker.cluster_json()
+        assert doc["num_passes"] == len(pass_recs)
+        assert doc["straggler_index_last"] >= 1.0
+
+
 class TestCoordinatorHandshake:
     def test_block_plan_skew_rejected_at_hello(self, cluster_dataset):
         import threading
